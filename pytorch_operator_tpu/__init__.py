@@ -1,0 +1,9 @@
+"""pytorch_operator_tpu — a TPU-native job orchestration framework.
+
+A brand-new implementation of the capability set of the Kubeflow PyTorch
+operator (reference studied in /root/repo/SURVEY.md): a PyTorchJob CRD, a
+controller that reconciles Master/Worker pods with TPU/PJRT rendezvous
+wiring, a Python SDK, and a JAX/XLA data plane for the example workloads.
+"""
+
+__version__ = "0.1.0"

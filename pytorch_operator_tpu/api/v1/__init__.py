@@ -1,0 +1,32 @@
+"""PyTorchJob v1 API: types, constants, defaulting, validation.
+
+First-party equivalent of the reference's pkg/apis/pytorch/v1 +
+pkg/apis/pytorch/validation packages.
+"""
+
+from . import constants
+from .defaults import set_defaults
+from .types import (
+    JobCondition,
+    JobStatus,
+    PyTorchJob,
+    PyTorchJobSpec,
+    ReplicaSpec,
+    ReplicaStatus,
+    SchedulingPolicy,
+)
+from .validation import ValidationError, validate_spec
+
+__all__ = [
+    "constants",
+    "set_defaults",
+    "validate_spec",
+    "ValidationError",
+    "PyTorchJob",
+    "PyTorchJobSpec",
+    "JobStatus",
+    "JobCondition",
+    "ReplicaSpec",
+    "ReplicaStatus",
+    "SchedulingPolicy",
+]

@@ -1,0 +1,89 @@
+"""API constants for the TPU-native PyTorchJob operator.
+
+Mirrors the reference's pkg/apis/pytorch/v1/constants.go:21-34 (container
+name, port name, default port 23456, default restart policy) and the label
+vocabulary from pkg/controller.v1/pytorch/controller.go:55-58 plus the
+vendored jobcontroller label keys (jobcontroller.go:138-147), extended with
+the TPU/PJRT coordination environment that replaces the GPU-era
+MASTER_ADDR/RANK wiring (north star in /root/repo/BASELINE.json).
+"""
+
+# --- CRD identity (reference: pkg/apis/pytorch/v1/register.go:31-44) ------
+GROUP_NAME = "kubeflow.org"
+VERSION = "v1"
+KIND = "PyTorchJob"
+SINGULAR = "pytorchjob"
+PLURAL = "pytorchjobs"
+CRD_NAME = PLURAL + "." + GROUP_NAME
+API_VERSION = GROUP_NAME + "/" + VERSION
+
+# --- Container & port defaults (reference: constants.go:21-34) ------------
+DEFAULT_CONTAINER_NAME = "pytorch"
+DEFAULT_PORT_NAME = "pytorchjob-port"
+DEFAULT_PORT = 23456
+
+# Env var the operator namespace is read from (reference: constants.go:33).
+ENV_KUBEFLOW_NAMESPACE = "KUBEFLOW_NAMESPACE"
+
+# --- Replica types (reference: types.go:74-83) -----------------------------
+REPLICA_TYPE_MASTER = "Master"
+REPLICA_TYPE_WORKER = "Worker"
+VALID_REPLICA_TYPES = (REPLICA_TYPE_MASTER, REPLICA_TYPE_WORKER)
+
+# --- Restart policies (reference: kubeflow/common types.go:131-155) --------
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_EXIT_CODE = "ExitCode"
+DEFAULT_RESTART_POLICY = RESTART_POLICY_ON_FAILURE
+
+# --- Clean pod policies (reference: kubeflow/common types.go:120-129) ------
+CLEAN_POD_POLICY_ALL = "All"
+CLEAN_POD_POLICY_RUNNING = "Running"
+CLEAN_POD_POLICY_NONE = "None"
+DEFAULT_CLEAN_POD_POLICY = CLEAN_POD_POLICY_NONE
+
+# --- Job condition types (reference: kubeflow/common types.go:101-127) -----
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+
+# --- Labels (reference: controller.go:55-58, jobcontroller.go:138-147) -----
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_PYTORCH_JOB_NAME = "pytorch-job-name"  # deprecated but kept for parity
+LABEL_CONTROLLER_NAME = "controller-name"
+LABEL_REPLICA_TYPE = "pytorch-replica-type"
+LABEL_REPLICA_INDEX = "pytorch-replica-index"
+LABEL_JOB_ROLE = "job-role"
+
+CONTROLLER_NAME = "pytorch-operator"
+
+# Gang scheduling annotation (reference: pod.go:37).
+GANG_SCHEDULING_POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+
+# --- Rendezvous environment ------------------------------------------------
+# Reference c10d wiring (pod.go:234-281), kept for backend='xla'
+# MASTER_ADDR compatibility in torch_xla workloads:
+ENV_MASTER_PORT = "MASTER_PORT"
+ENV_MASTER_ADDR = "MASTER_ADDR"
+ENV_WORLD_SIZE = "WORLD_SIZE"
+ENV_RANK = "RANK"
+ENV_PYTHONUNBUFFERED = "PYTHONUNBUFFERED"
+
+# TPU/PJRT coordination env this operator injects natively
+# (BASELINE.json north star; torch_xla + JAX multi-host bootstrap):
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_XRT_TPU_CONFIG = "XRT_TPU_CONFIG"
+ENV_JAX_COORDINATOR_ADDRESS = "COORDINATOR_ADDRESS"
+ENV_JAX_NUM_PROCESSES = "NUM_PROCESSES"
+ENV_JAX_PROCESS_ID = "PROCESS_ID"
+ENV_PJRT_DEVICE = "PJRT_DEVICE"
+
+# TPU resource & GKE node-selector keys.
+TPU_RESOURCE = "google.com/tpu"
+NODE_SELECTOR_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+NODE_SELECTOR_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"

@@ -1,0 +1,65 @@
+"""Defaulting for PyTorchJob resources.
+
+Behavioral mirror of the reference's pkg/apis/pytorch/v1/defaults.go:36-106:
+  * cleanPodPolicy defaults to ``None``;
+  * replica-type map keys are normalized to CamelCase (``master`` ->
+    ``Master``) via case-insensitive comparison;
+  * replicas default to 1 and restartPolicy to ``OnFailure`` per replica
+    spec;
+  * the Master's ``pytorch`` container gets the named default port 23456
+    appended when no port named ``pytorchjob-port`` exists.
+"""
+
+from __future__ import annotations
+
+from ...k8s.objects import ContainerPort, PodSpec
+from . import constants
+from .types import PyTorchJob, ReplicaSpec
+
+
+def _set_default_port(spec: PodSpec) -> None:
+    # Find the container named "pytorch", falling back to the first one —
+    # same index-0 fallback as the reference (defaults.go:36-47).
+    if not spec.containers:
+        return
+    index = 0
+    for i, container in enumerate(spec.containers):
+        if container.name == constants.DEFAULT_CONTAINER_NAME:
+            index = i
+            break
+    for port in spec.containers[index].ports:
+        if port.name == constants.DEFAULT_PORT_NAME:
+            return
+    spec.containers[index].ports.append(
+        ContainerPort(name=constants.DEFAULT_PORT_NAME, container_port=constants.DEFAULT_PORT)
+    )
+
+
+def _set_default_replicas(spec: ReplicaSpec) -> None:
+    if spec.replicas is None:
+        spec.replicas = 1
+    if not spec.restart_policy:
+        spec.restart_policy = constants.DEFAULT_RESTART_POLICY
+
+
+def _set_type_names_to_camel_case(job: PyTorchJob) -> None:
+    for canonical in constants.VALID_REPLICA_TYPES:
+        for existing in list(job.spec.pytorch_replica_specs):
+            if existing != canonical and existing.lower() == canonical.lower():
+                job.spec.pytorch_replica_specs[canonical] = (
+                    job.spec.pytorch_replica_specs.pop(existing)
+                )
+                break
+
+
+def set_defaults(job: PyTorchJob) -> None:
+    """Apply all PyTorchJob defaults in place (SetDefaults_PyTorchJob)."""
+    if job.spec.clean_pod_policy is None:
+        job.spec.clean_pod_policy = constants.DEFAULT_CLEAN_POD_POLICY
+
+    _set_type_names_to_camel_case(job)
+
+    for rtype, spec in job.spec.pytorch_replica_specs.items():
+        _set_default_replicas(spec)
+        if rtype == constants.REPLICA_TYPE_MASTER:
+            _set_default_port(spec.template.spec)

@@ -1,0 +1,110 @@
+"""PyTorchJob CRD types.
+
+First-party equivalents of the reference's pkg/apis/pytorch/v1/types.go:27-98
+and the shared vocabulary from
+vendor/github.com/kubeflow/common/job_controller/api/v1/types.go:23-191
+(ReplicaSpec, ReplicaStatus, JobStatus, JobCondition, RestartPolicy,
+CleanPodPolicy, SchedulingPolicy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...k8s import serde
+from ...k8s.objects import ObjectMeta, PodTemplateSpec
+from . import constants
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica set of the job (kubeflow/common types.go:23-43)."""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: str = ""
+
+
+@dataclass
+class ReplicaStatus:
+    """Observed per-replica-type counts (kubeflow/common types.go:45-57)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobCondition:
+    """One observed job condition (kubeflow/common types.go:75-99)."""
+
+    type: str = ""
+    status: str = ""  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class JobStatus:
+    """Observed state of the job (kubeflow/common types.go:59-73)."""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (kubeflow/common types.go:180-191)."""
+
+    min_available: Optional[int] = None
+
+
+@dataclass
+class PyTorchJobSpec:
+    """Desired state (reference types.go:42-72 + RunPolicy fields)."""
+
+    # RunPolicy (embedded in the v1 spec in the reference).
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+    # Map keyed "Master" / "Worker" (reference types.go:74-98).
+    pytorch_replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"k8s": "pytorchReplicaSpecs"}
+    )
+
+
+@dataclass
+class PyTorchJob:
+    """The PyTorchJob custom resource (reference types.go:27-40)."""
+
+    api_version: str = constants.API_VERSION
+    kind: str = constants.KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PyTorchJobSpec = field(default_factory=PyTorchJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    # -- convenience -------------------------------------------------------
+    def to_dict(self) -> dict:
+        return serde.to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PyTorchJob":
+        return serde.from_dict(cls, data)
+
+    def deep_copy(self) -> "PyTorchJob":
+        return serde.deep_copy(self)
+
+    @property
+    def key(self) -> str:
+        """The workqueue key ``namespace/name``."""
+        if self.metadata.namespace:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name

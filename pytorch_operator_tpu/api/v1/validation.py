@@ -1,0 +1,60 @@
+"""Validation for PyTorchJob specs.
+
+Behavioral mirror of the reference's
+pkg/apis/pytorch/validation/validation.go:23-77:
+  * the replica-spec map must be present and non-empty entries valid;
+  * only ``Master`` / ``Worker`` replica types are accepted;
+  * every replica spec needs at least one container, every container an
+    image, and one container must be named ``pytorch``;
+  * a Master spec must exist with exactly one replica.
+"""
+
+from __future__ import annotations
+
+from . import constants
+from .types import PyTorchJobSpec
+
+
+class ValidationError(ValueError):
+    """Raised when a PyTorchJobSpec is invalid."""
+
+
+def validate_spec(spec: PyTorchJobSpec) -> None:
+    if not spec.pytorch_replica_specs or not isinstance(spec.pytorch_replica_specs, dict):
+        raise ValidationError("PyTorchJobSpec is not valid")
+
+    master_exists = False
+    for rtype, replica in spec.pytorch_replica_specs.items():
+        if replica is None or not replica.template.spec.containers:
+            raise ValidationError(
+                f"PyTorchJobSpec is not valid: containers definition expected in {rtype}"
+            )
+        if rtype not in constants.VALID_REPLICA_TYPES:
+            raise ValidationError(
+                f"PyTorchReplicaType is {rtype} but must be one of "
+                f"{list(constants.VALID_REPLICA_TYPES)}"
+            )
+        default_container_present = False
+        for container in replica.template.spec.containers:
+            if not container.image:
+                raise ValidationError(
+                    f"PyTorchJobSpec is not valid: Image is undefined in the container of {rtype}"
+                )
+            if container.name == constants.DEFAULT_CONTAINER_NAME:
+                default_container_present = True
+        if not default_container_present:
+            raise ValidationError(
+                "PyTorchJobSpec is not valid: There is no container named "
+                f"{constants.DEFAULT_CONTAINER_NAME} in {rtype}"
+            )
+        if rtype == constants.REPLICA_TYPE_MASTER:
+            master_exists = True
+            if replica.replicas is not None and replica.replicas != 1:
+                raise ValidationError(
+                    "PyTorchJobSpec is not valid: There must be only 1 master replica"
+                )
+
+    if not master_exists:
+        raise ValidationError(
+            "PyTorchJobSpec is not valid: Master ReplicaSpec must be present"
+        )

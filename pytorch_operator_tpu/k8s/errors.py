@@ -1,0 +1,37 @@
+"""Kubernetes-style API errors shared by the real and fake clients."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.__class__.__name__)
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+
+
+class ConflictError(ApiError):
+    """Update rejected due to a stale resourceVersion."""
+
+    code = 409
+
+
+class InvalidError(ApiError):
+    code = 422
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, AlreadyExistsError)

@@ -1,0 +1,281 @@
+"""In-memory fake Kubernetes API server.
+
+The reference tests multi-node behavior without a cluster by injecting
+state into informer indexers and recording side effects through fake
+controls (SURVEY.md §4 tier 2).  This module goes one step further and
+provides a small but faithful API-server simulation — namespaced stores
+with resourceVersions, label-selector lists, watch fan-out, owner-reference
+garbage collection — so the same controller code paths run against either
+the real REST client or this fake.
+
+Objects are stored as plain dicts in the camelCase wire format
+(equivalent of ``unstructured.Unstructured`` in the reference's dynamic
+informer, pkg/common/util/v1/unstructured/informer.go:25-63).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .errors import AlreadyExistsError, ConflictError, InvalidError, NotFoundError
+from .objects import match_labels
+
+WatchEvent = Tuple[str, dict]  # ("ADDED"|"MODIFIED"|"DELETED", object)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _match_selector(selector: Optional[Dict[str, str]], obj: dict) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return match_labels(selector, labels)
+
+
+class FakeResourceStore:
+    """One namespaced resource collection (e.g. all Pods)."""
+
+    def __init__(self, cluster: "FakeCluster", kind: str):
+        self._cluster = cluster
+        self.kind = kind
+        self._objects: Dict[Tuple[str, str], dict] = {}
+        self._listeners: List[Callable[[str, dict], None]] = []
+
+    # -- internal helpers --------------------------------------------------
+    def _key(self, namespace: str, name: str) -> Tuple[str, str]:
+        return (namespace or "default", name)
+
+    def _notify(self, event_type: str, obj: dict) -> None:
+        for listener in list(self._listeners):
+            listener(event_type, copy.deepcopy(obj))
+
+    # -- watch -------------------------------------------------------------
+    def add_listener(self, fn: Callable[[str, dict], None]) -> None:
+        """Register a watch callback invoked for every store mutation."""
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, dict], None]) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, namespace: str, obj: dict) -> dict:
+        with self._cluster.lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            if namespace and meta.get("namespace") and meta["namespace"] != namespace:
+                raise InvalidError(
+                    f'namespace mismatch: request {namespace!r} vs object {meta["namespace"]!r}'
+                )
+            meta.setdefault("namespace", namespace or "default")
+            if not meta.get("name") and meta.get("generateName"):
+                meta["name"] = meta["generateName"] + uuid.uuid4().hex[:5]
+            if not meta.get("name"):
+                raise InvalidError(f"{self.kind}: metadata.name or generateName required")
+            key = self._key(meta["namespace"], meta["name"])
+            if key in self._objects:
+                raise AlreadyExistsError(f'{self.kind} "{meta["name"]}" already exists')
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = str(self._cluster.next_rv())
+            meta.setdefault("creationTimestamp", _now_iso())
+            self._objects[key] = obj
+            self._notify(ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, namespace: str, name: str) -> dict:
+        with self._cluster.lock:
+            key = self._key(namespace, name)
+            if key not in self._objects:
+                raise NotFoundError(f'{self.kind} "{name}" not found')
+            return copy.deepcopy(self._objects[key])
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        with self._cluster.lock:
+            out = []
+            for (ns, _), obj in sorted(self._objects.items()):
+                if namespace and ns != namespace:
+                    continue
+                if _match_selector(label_selector, obj):
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj: dict, subresource: Optional[str] = None) -> dict:
+        """Replace an object; enforces resourceVersion optimistic locking."""
+        with self._cluster.lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.get("metadata") or {}
+            key = self._key(meta.get("namespace", "default"), meta.get("name", ""))
+            existing = self._objects.get(key)
+            if existing is None:
+                raise NotFoundError(f'{self.kind} "{meta.get("name")}" not found')
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv and sent_rv != existing["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f'{self.kind} "{meta.get("name")}": resourceVersion conflict'
+                )
+            if subresource == "status":
+                # Status updates only replace .status.
+                new_obj = copy.deepcopy(existing)
+                new_obj["status"] = obj.get("status", {})
+            else:
+                new_obj = obj
+                # Server-managed metadata survives updates.
+                new_obj["metadata"]["uid"] = existing["metadata"]["uid"]
+                new_obj["metadata"]["creationTimestamp"] = existing["metadata"].get(
+                    "creationTimestamp"
+                )
+                if "status" not in new_obj and "status" in existing:
+                    new_obj["status"] = existing["status"]
+            new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
+            self._objects[key] = new_obj
+            self._notify(MODIFIED, new_obj)
+            return copy.deepcopy(new_obj)
+
+    def patch(self, namespace: str, name: str, patch: dict, subresource: Optional[str] = None) -> dict:
+        """Strategic-merge-ish patch: dicts merge recursively, lists replace."""
+        with self._cluster.lock:
+            key = self._key(namespace, name)
+            existing = self._objects.get(key)
+            if existing is None:
+                raise NotFoundError(f'{self.kind} "{name}" not found')
+            new_obj = copy.deepcopy(existing)
+            target = new_obj
+            if subresource == "status":
+                patch = {"status": patch.get("status", patch)}
+            _merge(target, patch)
+            new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
+            self._objects[key] = new_obj
+            self._notify(MODIFIED, new_obj)
+            return copy.deepcopy(new_obj)
+
+    def delete(self, namespace: str, name: str) -> None:
+        with self._cluster.lock:
+            key = self._key(namespace, name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f'{self.kind} "{name}" not found')
+            self._notify(DELETED, obj)
+        self._cluster._collect_garbage(obj)
+
+    def set_status(self, namespace: str, name: str, status: dict) -> dict:
+        """Test helper: overwrite .status directly (as a kubelet would)."""
+        with self._cluster.lock:
+            key = self._key(namespace, name)
+            existing = self._objects.get(key)
+            if existing is None:
+                raise NotFoundError(f'{self.kind} "{name}" not found')
+            new_obj = copy.deepcopy(existing)
+            new_obj["status"] = status
+            new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
+            self._objects[key] = new_obj
+            self._notify(MODIFIED, new_obj)
+            return copy.deepcopy(new_obj)
+
+
+def _merge(dst: dict, patch: dict) -> None:
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        elif v is None:
+            dst.pop(k, None)
+        else:
+            dst[k] = copy.deepcopy(v)
+
+
+class FakeCluster:
+    """The whole fake API server: one store per resource kind.
+
+    Kinds are addressed by their lowercase plural, matching REST paths:
+    ``pods``, ``services``, ``events``, ``pytorchjobs``, ``podgroups``,
+    ``endpoints``, ``leases``.
+    """
+
+    KINDS = {
+        "pods": "Pod",
+        "services": "Service",
+        "endpoints": "Endpoints",
+        "events": "Event",
+        "pytorchjobs": "PyTorchJob",
+        "podgroups": "PodGroup",
+        "leases": "Lease",
+    }
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._rv = 0
+        self.stores: Dict[str, FakeResourceStore] = {
+            plural: FakeResourceStore(self, kind) for plural, kind in self.KINDS.items()
+        }
+
+    def next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def resource(self, plural: str) -> FakeResourceStore:
+        return self.stores[plural]
+
+    @property
+    def pods(self) -> FakeResourceStore:
+        return self.stores["pods"]
+
+    @property
+    def services(self) -> FakeResourceStore:
+        return self.stores["services"]
+
+    @property
+    def events(self) -> FakeResourceStore:
+        return self.stores["events"]
+
+    @property
+    def jobs(self) -> FakeResourceStore:
+        return self.stores["pytorchjobs"]
+
+    @property
+    def podgroups(self) -> FakeResourceStore:
+        return self.stores["podgroups"]
+
+    # -- owner-reference garbage collection --------------------------------
+    def _collect_garbage(self, deleted_owner: dict) -> None:
+        """Cascade-delete objects owned (with controller ref) by the object.
+
+        Mirrors the kube-controller-manager GC that the reference e2e test
+        relies on (test/e2e/v1/default/defaults.go:169-187).
+        """
+        owner_uid = (deleted_owner.get("metadata") or {}).get("uid")
+        if not owner_uid:
+            return
+        for store in self.stores.values():
+            doomed: List[Tuple[str, str]] = []
+            with self.lock:
+                for (ns, name), obj in store._objects.items():
+                    meta = obj.get("metadata") or {}
+                    refs = meta.get("ownerReferences") or []
+                    if not any(r.get("uid") == owner_uid for r in refs):
+                        continue
+                    # Real GC semantics: drop the dangling reference; the
+                    # object is only deleted once no owners remain.
+                    remaining = [r for r in refs if r.get("uid") != owner_uid]
+                    if remaining:
+                        meta["ownerReferences"] = remaining
+                        meta["resourceVersion"] = str(self.next_rv())
+                    else:
+                        doomed.append((ns, name))
+            for ns, name in doomed:
+                try:
+                    store.delete(ns, name)
+                except NotFoundError:
+                    pass

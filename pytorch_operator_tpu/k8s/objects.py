@@ -1,0 +1,243 @@
+"""Typed subset of the Kubernetes core/v1 object model.
+
+The reference operator consumes these types from k8s.io/api/core/v1; this
+framework carries its own first-party definitions covering exactly the
+surface the controller touches: Pods, Services, Events, owner references
+and the kube-batch PodGroup used for gang scheduling (reference:
+vendor/github.com/kubernetes-sigs/kube-batch/pkg/apis/scheduling/v1alpha1/types.go).
+
+All types round-trip through :mod:`pytorch_operator_tpu.k8s.serde` to the
+camelCase JSON wire format used by the Kubernetes API server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import serde
+
+# ---------------------------------------------------------------------------
+# Pod phases (k8s.io/api/core/v1 PodPhase)
+# ---------------------------------------------------------------------------
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+# Container restart policies (pod-level).
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: Optional[str] = None
+    deletion_timestamp: Optional[str] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+    protocol: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    image_pull_policy: str = ""
+    working_dir: str = ""
+    volume_mounts: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    restart_policy: str = ""
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    host_network: Optional[bool] = None
+    volumes: List[dict] = field(default_factory=list)
+    tolerations: List[dict] = field(default_factory=list)
+    affinity: Optional[dict] = None
+    subdomain: str = ""
+    hostname: str = ""
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerState:
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    restart_count: int = 0
+    state: Optional[ContainerState] = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+    reason: str = ""
+    message: str = ""
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    init_container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: Optional[Any] = None
+    protocol: str = ""
+
+
+@dataclass
+class ServiceSpec:
+    cluster_ip: str = field(default="", metadata={"k8s": "clusterIP"})
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class ObjectReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = ""
+    count: int = 1
+    source: Dict[str, str] = field(default_factory=dict)
+    first_timestamp: Optional[str] = None
+    last_timestamp: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Gang scheduling: PodGroup (kube-batch / volcano scheduling.incubator.k8s.io)
+# Reference: vendor/.../kube-batch/pkg/apis/scheduling/v1alpha1/types.go
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodGroupSpec:
+    min_member: int = 0
+
+
+@dataclass
+class PodGroup:
+    api_version: str = "scheduling.incubator.k8s.io/v1alpha1"
+    kind: str = "PodGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: Dict[str, Any] = field(default_factory=dict)
+
+
+def to_dict(obj: Any) -> dict:
+    return serde.to_dict(obj)
+
+
+def from_dict(cls, data):
+    return serde.from_dict(cls, data)
+
+
+def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    """Equality-based label selector match (the only kind the operator uses)."""
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def is_controlled_by(obj_meta: ObjectMeta, owner_uid: str) -> bool:
+    for ref in obj_meta.owner_references:
+        if ref.controller and ref.uid == owner_uid:
+            return True
+    return False
+
+
+def get_controller_of(obj_meta: ObjectMeta) -> Optional[OwnerReference]:
+    """Return the controlling OwnerReference, if any (metav1.GetControllerOf)."""
+    for ref in obj_meta.owner_references:
+        if ref.controller:
+            return ref
+    return None

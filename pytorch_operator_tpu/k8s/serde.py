@@ -1,0 +1,126 @@
+"""Dataclass <-> Kubernetes-style JSON object conversion.
+
+The reference operator relies on k8s.io/apimachinery generated code
+(``zz_generated.deepcopy.go``, swagger models) to move between typed Go
+structs and the JSON wire format.  This module is the first-party
+equivalent: a small reflection layer that maps ``snake_case`` dataclass
+fields to ``camelCase`` JSON keys, recursing through ``Optional``,
+``List``, ``Dict`` and nested dataclasses.
+
+Conventions (matching Kubernetes marshalling):
+  * ``None`` values and empty containers are omitted on output.
+  * Unknown keys on input are ignored (forward compatibility).
+  * A field may override its wire name via
+    ``field(metadata={"k8s": "wireName"})``.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import typing
+from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def camel_case(snake: str) -> str:
+    parts = snake.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _wire_name(f: dataclasses.Field) -> str:
+    return f.metadata.get("k8s", camel_case(f.name))
+
+
+def _hints(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _is_optional(tp: Any) -> bool:
+    return get_origin(tp) is Union and type(None) in get_args(tp)
+
+
+def _encode_value(v: Any) -> Any:
+    if dataclasses.is_dataclass(v):
+        return to_dict(v)
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    return v
+
+
+def to_dict(obj: Any) -> dict:
+    """Serialize a dataclass to a camelCase JSON-ready dict."""
+    out: dict[str, Any] = {}
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if v is None:
+            continue
+        encoded = _encode_value(v)
+        # Go-style omitempty: drop empty strings/lists/dicts (and nested
+        # dataclasses that serialized to nothing); keep 0 and False.
+        if encoded == "" or (isinstance(encoded, (list, dict)) and not encoded):
+            continue
+        out[_wire_name(f)] = encoded
+    return out
+
+
+def _decode_value(tp: Any, v: Any) -> Any:
+    tp = _unwrap_optional(tp)
+    if v is None:
+        return None
+    if dataclasses.is_dataclass(tp) and isinstance(tp, type):
+        if not isinstance(v, dict):
+            return v
+        return from_dict(tp, v)
+    origin = get_origin(tp)
+    if origin in (list, tuple) and isinstance(v, list):
+        (elem,) = get_args(tp) or (Any,)
+        return [_decode_value(elem, x) for x in v]
+    if origin is dict and isinstance(v, dict):
+        args = get_args(tp)
+        elem = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(elem, x) for k, x in v.items()}
+    return v
+
+
+def from_dict(cls: Type[T], data: Optional[dict]) -> T:
+    """Deserialize a camelCase dict into dataclass ``cls``.
+
+    Unknown keys are ignored; missing keys fall back to field defaults.
+    """
+    if data is None:
+        data = {}
+    hints = _hints(cls)
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        wire = _wire_name(f)
+        if wire in data:
+            value = data[wire]
+            if value is None and not _is_optional(hints[f.name]):
+                # Explicit JSON null on a non-Optional field: keep the
+                # field default rather than violating the type contract.
+                continue
+            kwargs[f.name] = _decode_value(hints[f.name], value)
+    return cls(**kwargs)
+
+
+def deep_copy(obj: T) -> T:
+    """Equivalent of the generated DeepCopy methods."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,178 @@
+"""API-layer tests: serde round-trips, defaulting, validation.
+
+Mirrors the reference's tier-1 pure-function tests
+(pkg/apis/pytorch/validation/validation_test.go:26 and the defaulting
+behavior of pkg/apis/pytorch/v1/defaults.go).
+"""
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants, set_defaults, validate_spec
+from pytorch_operator_tpu.api.v1.types import (
+    PyTorchJob,
+    PyTorchJobSpec,
+    ReplicaSpec,
+)
+from pytorch_operator_tpu.api.v1.validation import ValidationError
+from pytorch_operator_tpu.k8s import serde
+from pytorch_operator_tpu.k8s.objects import Container, PodSpec, PodTemplateSpec
+
+from testutil import new_job, new_replica_spec
+
+
+# --------------------------------------------------------------------------
+# serde
+# --------------------------------------------------------------------------
+
+
+def test_serde_round_trip():
+    job = new_job(workers=3)
+    data = job.to_dict()
+    assert data["kind"] == "PyTorchJob"
+    assert data["apiVersion"] == "kubeflow.org/v1"
+    assert "pytorchReplicaSpecs" in data["spec"]
+    back = PyTorchJob.from_dict(data)
+    assert back == job
+
+
+def test_serde_omits_empty_and_ignores_unknown():
+    data = PyTorchJob.from_dict(
+        {
+            "metadata": {"name": "j", "namespace": "ns", "bogusField": 1},
+            "spec": {
+                "pytorchReplicaSpecs": {
+                    "Master": {
+                        "replicas": 1,
+                        "template": {
+                            "spec": {
+                                "containers": [{"name": "pytorch", "image": "img"}]
+                            }
+                        },
+                    }
+                }
+            },
+        }
+    )
+    assert data.metadata.name == "j"
+    master = data.spec.pytorch_replica_specs["Master"]
+    assert master.replicas == 1
+    assert master.template.spec.containers[0].image == "img"
+    out = data.to_dict()
+    assert "status" not in out  # empty status omitted
+    assert "labels" not in out["metadata"]
+
+
+def test_serde_camel_case_override():
+    from pytorch_operator_tpu.k8s.objects import ServiceSpec
+
+    spec = ServiceSpec(cluster_ip="None")
+    assert serde.to_dict(spec) == {"clusterIP": "None"}
+    assert serde.from_dict(ServiceSpec, {"clusterIP": "None"}).cluster_ip == "None"
+
+
+def test_deep_copy_is_independent():
+    job = new_job(workers=1)
+    cp = job.deep_copy()
+    cp.spec.pytorch_replica_specs["Worker"].replicas = 99
+    assert job.spec.pytorch_replica_specs["Worker"].replicas == 1
+
+
+# --------------------------------------------------------------------------
+# defaulting (reference defaults.go:36-106)
+# --------------------------------------------------------------------------
+
+
+def test_defaults_clean_pod_policy_and_replicas():
+    job = new_job()
+    job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_MASTER].replicas = None
+    set_defaults(job)
+    assert job.spec.clean_pod_policy == "None"
+    master = job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_MASTER]
+    assert master.replicas == 1
+    assert master.restart_policy == constants.RESTART_POLICY_ON_FAILURE
+
+
+def test_defaults_camel_case_normalization():
+    job = new_job()
+    specs = job.spec.pytorch_replica_specs
+    specs["master"] = specs.pop(constants.REPLICA_TYPE_MASTER)
+    specs["WORKER"] = new_replica_spec(2)
+    set_defaults(job)
+    assert set(job.spec.pytorch_replica_specs) == {"Master", "Worker"}
+    assert job.spec.pytorch_replica_specs["Worker"].replicas == 2
+
+
+def test_defaults_master_port_appended():
+    job = new_job()
+    master = job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_MASTER]
+    master.template.spec.containers[0].ports = []
+    set_defaults(job)
+    ports = master.template.spec.containers[0].ports
+    assert len(ports) == 1
+    assert ports[0].name == constants.DEFAULT_PORT_NAME
+    assert ports[0].container_port == constants.DEFAULT_PORT
+
+
+def test_defaults_port_not_duplicated():
+    job = new_job()
+    set_defaults(job)
+    master = job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_MASTER]
+    assert len(master.template.spec.containers[0].ports) == 1
+
+
+# --------------------------------------------------------------------------
+# validation (reference validation.go:23-77, validation_test.go table)
+# --------------------------------------------------------------------------
+
+
+def _spec_with(containers, rtype="Master", replicas=1):
+    return PyTorchJobSpec(
+        pytorch_replica_specs={
+            rtype: ReplicaSpec(
+                replicas=replicas,
+                template=PodTemplateSpec(spec=PodSpec(containers=containers)),
+            )
+        }
+    )
+
+
+def test_validate_ok():
+    validate_spec(new_job(workers=2).spec)
+
+
+def test_validate_nil_specs():
+    with pytest.raises(ValidationError):
+        validate_spec(PyTorchJobSpec())
+
+
+def test_validate_no_containers():
+    with pytest.raises(ValidationError, match="containers definition expected"):
+        validate_spec(_spec_with([]))
+
+
+def test_validate_empty_image():
+    with pytest.raises(ValidationError, match="Image is undefined"):
+        validate_spec(_spec_with([Container(name="pytorch", image="")]))
+
+
+def test_validate_missing_pytorch_container():
+    with pytest.raises(ValidationError, match="no container named pytorch"):
+        validate_spec(_spec_with([Container(name="other", image="img")]))
+
+
+def test_validate_invalid_replica_type():
+    spec = _spec_with([Container(name="pytorch", image="img")], rtype="Chief")
+    with pytest.raises(ValidationError, match="must be one of"):
+        validate_spec(spec)
+
+
+def test_validate_master_replicas_must_be_one():
+    spec = _spec_with([Container(name="pytorch", image="img")], replicas=2)
+    with pytest.raises(ValidationError, match="only 1 master"):
+        validate_spec(spec)
+
+
+def test_validate_master_required():
+    spec = _spec_with([Container(name="pytorch", image="img")], rtype="Worker")
+    with pytest.raises(ValidationError, match="Master ReplicaSpec must be present"):
+        validate_spec(spec)

@@ -1,0 +1,97 @@
+"""Tests for the in-memory fake API server."""
+
+import pytest
+
+from pytorch_operator_tpu.k8s.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from pytorch_operator_tpu.k8s.fake import ADDED, DELETED, MODIFIED, FakeCluster
+
+
+def _pod(name, ns="default", labels=None, owner_uid=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    if owner_uid:
+        meta["ownerReferences"] = [
+            {"uid": owner_uid, "controller": True, "kind": "PyTorchJob", "name": "j"}
+        ]
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": {}}
+
+
+def test_create_get_list_delete():
+    c = FakeCluster()
+    c.pods.create("default", _pod("a", labels={"x": "1"}))
+    c.pods.create("default", _pod("b", labels={"x": "2"}))
+    c.pods.create("other", _pod("c", ns="other", labels={"x": "1"}))
+
+    assert c.pods.get("default", "a")["metadata"]["uid"]
+    assert len(c.pods.list()) == 3
+    assert len(c.pods.list(namespace="default")) == 2
+    assert len(c.pods.list(label_selector={"x": "1"})) == 2
+    c.pods.delete("default", "a")
+    with pytest.raises(NotFoundError):
+        c.pods.get("default", "a")
+
+
+def test_duplicate_create_rejected():
+    c = FakeCluster()
+    c.pods.create("default", _pod("a"))
+    with pytest.raises(AlreadyExistsError):
+        c.pods.create("default", _pod("a"))
+
+
+def test_resource_version_conflict():
+    c = FakeCluster()
+    created = c.pods.create("default", _pod("a"))
+    stale = dict(created)
+    c.pods.update(created)  # bumps rv
+    with pytest.raises(ConflictError):
+        c.pods.update(stale)
+
+
+def test_status_update_only_touches_status():
+    c = FakeCluster()
+    created = c.jobs.create("default", {"kind": "PyTorchJob", "metadata": {"name": "j"}, "spec": {"a": 1}})
+    created["spec"] = {"a": 999}
+    created["status"] = {"phase": "Running"}
+    updated = c.jobs.update(created, subresource="status")
+    assert updated["status"] == {"phase": "Running"}
+    assert updated["spec"] == {"a": 1}
+
+
+def test_patch_merges():
+    c = FakeCluster()
+    c.jobs.create("default", {"kind": "PyTorchJob", "metadata": {"name": "j"}, "spec": {"a": 1}})
+    out = c.jobs.patch("default", "j", {"status": {"phase": "Failed"}})
+    assert out["status"]["phase"] == "Failed"
+    assert out["spec"] == {"a": 1}
+
+
+def test_watch_events():
+    c = FakeCluster()
+    events = []
+    c.pods.add_listener(lambda t, o: events.append((t, o["metadata"]["name"])))
+    c.pods.create("default", _pod("a"))
+    c.pods.set_status("default", "a", {"phase": "Running"})
+    c.pods.delete("default", "a")
+    assert events == [(ADDED, "a"), (MODIFIED, "a"), (DELETED, "a")]
+
+
+def test_owner_reference_gc():
+    """Deleting a job cascades to its controlled pods/services
+    (what test/e2e/v1/default/defaults.go:169-187 asserts on a real cluster)."""
+    c = FakeCluster()
+    job = c.jobs.create("default", {"kind": "PyTorchJob", "metadata": {"name": "j"}})
+    uid = job["metadata"]["uid"]
+    c.pods.create("default", _pod("j-master-0", owner_uid=uid))
+    c.pods.create("default", _pod("unrelated"))
+    svc = _pod("j-master-0", owner_uid=uid)
+    svc["kind"] = "Service"
+    c.services.create("default", svc)
+
+    c.jobs.delete("default", "j")
+    assert [p["metadata"]["name"] for p in c.pods.list()] == ["unrelated"]
+    assert c.services.list() == []

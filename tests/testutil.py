@@ -1,0 +1,64 @@
+"""Fixture factories shared across the test suite.
+
+Equivalent of the reference's pkg/common/util/v1/testutil/job.go:28-145
+(NewPyTorchJobWithMaster, NewPyTorchJobWithCleanPolicy, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.api.v1.types import PyTorchJob, PyTorchJobSpec, ReplicaSpec
+from pytorch_operator_tpu.k8s.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+
+TEST_IMAGE = "test-image-for-pytorch-operator:latest"
+TEST_JOB_NAME = "test-pytorchjob"
+TEST_NAMESPACE = "default"
+
+
+def new_pod_template() -> PodTemplateSpec:
+    return PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name=constants.DEFAULT_CONTAINER_NAME,
+                    image=TEST_IMAGE,
+                    ports=[
+                        ContainerPort(
+                            name=constants.DEFAULT_PORT_NAME,
+                            container_port=constants.DEFAULT_PORT,
+                        )
+                    ],
+                )
+            ]
+        )
+    )
+
+
+def new_replica_spec(replicas: Optional[int] = None) -> ReplicaSpec:
+    return ReplicaSpec(replicas=replicas, template=new_pod_template())
+
+
+def new_job(
+    workers: int = 0,
+    with_master: bool = True,
+    name: str = TEST_JOB_NAME,
+    namespace: str = TEST_NAMESPACE,
+) -> PyTorchJob:
+    """NewPyTorchJobWithMaster equivalent (testutil/job.go)."""
+    specs = {}
+    if with_master:
+        specs[constants.REPLICA_TYPE_MASTER] = new_replica_spec(1)
+    if workers > 0 or not with_master:
+        specs[constants.REPLICA_TYPE_WORKER] = new_replica_spec(workers)
+    return PyTorchJob(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid="test-uid-" + name),
+        spec=PyTorchJobSpec(pytorch_replica_specs=specs),
+    )
