@@ -1,0 +1,13 @@
+"""TPU-native PyTorchJob controller (reference: pkg/controller.v1/pytorch/)."""
+
+from .pytorch_controller import PyTorchController
+from .tpu_env import build_cluster_env, replica_hostnames, set_cluster_spec
+from .train_util import is_retryable_exit_code
+
+__all__ = [
+    "PyTorchController",
+    "build_cluster_env",
+    "replica_hostnames",
+    "set_cluster_spec",
+    "is_retryable_exit_code",
+]

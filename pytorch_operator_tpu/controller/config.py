@@ -1,0 +1,49 @@
+"""Init-container configuration.
+
+Equivalent of the reference's pkg/common/config/config.go:9-34: the worker
+pods get an init container that blocks until the master's headless-service
+DNS resolves, acting as a startup-ordering barrier before the rendezvous.
+The template can be overridden by a config file
+(/etc/config/initContainer.yaml in-cluster).
+"""
+
+from __future__ import annotations
+
+import os
+import string
+from typing import List, Optional
+
+import yaml
+
+INIT_CONTAINER_TEMPLATE_FILE = "/etc/config/initContainer.yaml"
+
+# ${masterAddr} / ${initContainerImage} are substituted at pod-build time.
+DEFAULT_INIT_CONTAINER_TEMPLATE = """
+- name: init-pytorch
+  image: ${initContainerImage}
+  command: ['sh', '-c', 'until nslookup ${masterAddr}; do echo waiting for master; sleep 2; done;']
+  resources:
+    limits:
+      cpu: 100m
+      memory: 20Mi
+    requests:
+      cpu: 50m
+      memory: 10Mi
+"""
+
+
+def get_init_container_template(config_path: Optional[str] = None) -> str:
+    path = config_path or INIT_CONTAINER_TEMPLATE_FILE
+    if os.path.isfile(path):
+        with open(path) as f:
+            return f.read()
+    return DEFAULT_INIT_CONTAINER_TEMPLATE
+
+
+def render_init_containers(
+    master_addr: str, init_container_image: str, template: Optional[str] = None
+) -> List[dict]:
+    """Render the template into container dicts (util.go:60-78)."""
+    tpl = string.Template(template or get_init_container_template())
+    rendered = tpl.substitute(masterAddr=master_addr, initContainerImage=init_container_image)
+    return yaml.safe_load(rendered) or []

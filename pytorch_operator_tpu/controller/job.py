@@ -1,0 +1,186 @@
+"""Job lifecycle: informer handlers, terminal cleanup, TTL.
+
+Behavioral mirror of pkg/controller.v1/pytorch/job.go:35-227, with two
+deliberate deviations (documented at the call sites):
+  * the Created condition is persisted via a status patch instead of being
+    written back into the informer cache;
+  * CleanPodPolicy=Running actually deletes running pods (the reference's
+    v1 code treats Running like None — job.go:153-161).
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from typing import List, Optional
+
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob
+from ..api.v1.validation import ValidationError
+from ..k8s.errors import ApiError, NotFoundError
+from ..runtime.recorder import EVENT_TYPE_WARNING
+from . import status as status_machine
+
+FAILED_MARSHAL_REASON = "FailedInvalidPyTorchJobSpec"
+
+
+def parse_time(ts: Optional[str]) -> Optional[float]:
+    if not ts:
+        return None
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+
+
+class JobLifecycleMixin:
+    # -- informer handlers -------------------------------------------------
+    def add_job(self, obj: dict) -> None:
+        """job.go:35-112: validate/convert; invalid specs are marked Failed
+        via a raw status patch; valid jobs get a Created condition and are
+        enqueued."""
+        try:
+            job = self._job_from_unstructured(obj)
+        except ValidationError as e:
+            self.mark_job_invalid(obj, e)
+            return
+
+        msg = f"PyTorchJob {job.metadata.name} is created."
+        self.logger.info(msg)
+        status_machine.update_job_conditions(
+            job.status, constants.JOB_CREATED, status_machine.JOB_CREATED_REASON, msg
+        )
+        # Deviation from job.go:97-109 (which writes the condition back into
+        # the informer cache): persist through the API so every observer
+        # sees it.
+        try:
+            self.cluster.jobs.patch(
+                job.metadata.namespace,
+                job.metadata.name,
+                {"status": {"conditions": [_cond_dict(c) for c in job.status.conditions]}},
+                subresource="status",
+            )
+        except ApiError:
+            pass
+        self.jobs_created_counter.inc()
+        self.enqueue_job(obj)
+
+    def mark_job_invalid(self, obj: dict, err: Exception) -> None:
+        """Patch an invalid job's status to Failed (job.go:46-85)."""
+        msg = f"Failed to unmarshal the object to PyTorchJob: Spec is invalid {err}"
+        self.logger.warning(msg)
+        self.recorder.event(obj, EVENT_TYPE_WARNING, FAILED_MARSHAL_REASON, msg)
+        status = {
+            "conditions": [
+                {
+                    "type": constants.JOB_FAILED,
+                    "status": "True",
+                    "lastUpdateTime": status_machine.now_iso(),
+                    "lastTransitionTime": status_machine.now_iso(),
+                    "reason": FAILED_MARSHAL_REASON,
+                    "message": msg,
+                }
+            ]
+        }
+        meta = obj.get("metadata", {})
+        try:
+            self.cluster.jobs.patch(
+                meta.get("namespace", "default"),
+                meta.get("name", ""),
+                {"status": status},
+                subresource="status",
+            )
+        except ApiError as patch_err:
+            self.logger.error("Could not update the PyTorchJob: %s", patch_err)
+
+    def update_job(self, old_obj: dict, new_obj: dict) -> None:
+        """job.go:114-150: enqueue; reschedule the deadline wake-up when
+        ActiveDeadlineSeconds changes on a started job."""
+        self.enqueue_job(new_obj)
+        try:
+            new_job = self._job_from_unstructured(new_obj)
+            old_job = self._job_from_unstructured(old_obj)
+        except ValidationError:
+            return
+        if new_job.status.start_time is None:
+            return
+        new_ads = new_job.spec.active_deadline_seconds
+        if new_ads is None:
+            return
+        old_ads = old_job.spec.active_deadline_seconds
+        if old_ads is None or old_ads != new_ads:
+            start = parse_time(new_job.status.start_time) or time.time()
+            passed = time.time() - start
+            self.work_queue.add_after(new_job.key, new_ads - passed)
+            self.logger.info(
+                "job ActiveDeadlineSeconds updated, will rsync after %s seconds",
+                new_ads - passed,
+            )
+
+    # -- terminal cleanup --------------------------------------------------
+    def delete_pods_and_services(
+        self, job: PyTorchJob, job_dict: dict, pods: List[dict], services: List[dict]
+    ) -> None:
+        """job.go:153-181.  Unlike the reference (which no-ops for Running
+        too), CleanPodPolicy=Running deletes only still-active pods."""
+        if not pods and not services:
+            return
+        policy = job.spec.clean_pod_policy or constants.CLEAN_POD_POLICY_NONE
+        if policy == constants.CLEAN_POD_POLICY_NONE:
+            return
+        for pod in pods:
+            phase = (pod.get("status") or {}).get("phase")
+            if policy == constants.CLEAN_POD_POLICY_RUNNING and phase not in (
+                "Running",
+                "Pending",
+            ):
+                continue
+            self.pod_control.delete_pod(
+                pod["metadata"].get("namespace", ""),
+                pod["metadata"].get("name", ""),
+                job_dict,
+            )
+        # TPU deviation: every replica has a service; delete them all (the
+        # reference removes only the master's, service filter in
+        # job.go:171-180).
+        for service in services:
+            self.service_control.delete_service(
+                service["metadata"].get("namespace", ""),
+                service["metadata"].get("name", ""),
+                job_dict,
+            )
+
+    def cleanup_job(self, job: PyTorchJob) -> None:
+        """TTLSecondsAfterFinished enforcement (job.go:184-206)."""
+        ttl = job.spec.ttl_seconds_after_finished
+        if ttl is None:
+            return
+        completion = parse_time(job.status.completion_time)
+        if completion is None:
+            return
+        remaining = completion + ttl - time.time()
+        if remaining <= 0:
+            try:
+                self.delete_job_handler(job)
+            except ApiError as e:
+                self.logger.warning("Cleanup PyTorchJob error: %s", e)
+                raise
+            return
+        self.work_queue.add_after(job.key, remaining)
+
+    def _delete_job(self, job: PyTorchJob) -> None:
+        try:
+            self.cluster.jobs.delete(job.metadata.namespace, job.metadata.name)
+        except NotFoundError:
+            pass
+
+
+def _cond_dict(c) -> dict:
+    from ..k8s import serde
+
+    return serde.to_dict(c)
+
+
+def get_total_replicas(job: PyTorchJob) -> int:
+    return sum(int(s.replicas or 0) for s in job.spec.pytorch_replica_specs.values())
+
+
+def get_total_failed_replicas(job: PyTorchJob) -> int:
+    return sum(rs.failed for rs in job.status.replica_statuses.values())

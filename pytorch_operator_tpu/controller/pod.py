@@ -1,0 +1,177 @@
+"""Pod reconciliation for PyTorchJob replicas.
+
+Behavioral mirror of the reference's pkg/controller.v1/pytorch/pod.go with
+the TPU-native cluster spec (tpu_env.py) in place of the c10d wiring:
+per-index pod slices, missing-index creation with deterministic labels and
+owner refs, ExitCode retry handling, restart-policy mapping, the worker
+DNS-wait init container, and gang-scheduler annotations.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List
+
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob, ReplicaSpec
+from ..k8s import serde
+from ..runtime.expectations import expectation_pods_key
+from ..runtime.job_controller import gen_general_name, gen_pod_group_name
+from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+from . import config as initconfig
+from . import status as status_machine
+from . import train_util
+from .tpu_env import set_cluster_spec
+
+POD_TEMPLATE_RESTART_POLICY_REASON = "SettedPodTemplateRestartPolicy"
+EXITED_WITH_CODE_REASON = "ExitedWithCode"
+POD_TEMPLATE_SCHEDULER_NAME_REASON = "SettedPodTemplateSchedulerName"
+
+
+class PodReconcilerMixin:
+    def reconcile_pods(
+        self,
+        job: PyTorchJob,
+        job_dict: dict,
+        pods: List[dict],
+        rtype: str,
+        spec: ReplicaSpec,
+    ) -> None:
+        """pod.go:49-117."""
+        rt = rtype.lower()
+        pods = self.filter_pods_for_replica_type(pods, rt)
+        replicas = int(spec.replicas or 0)
+        restart = False
+
+        status_machine.initialize_replica_statuses(job.status, rtype)
+
+        pod_slices = self.get_pod_slices(pods, replicas)
+        for index, pod_slice in enumerate(pod_slices):
+            if len(pod_slice) > 1:
+                self.logger.warning("We have too many pods for %s %d", rt, index)
+            elif len(pod_slice) == 0:
+                self.logger.info("Need to create new pod: %s-%d", rt, index)
+                master_role = rtype == constants.REPLICA_TYPE_MASTER
+                self.create_new_pod(job, job_dict, rtype, str(index), spec, master_role)
+            else:
+                pod = pod_slice[0]
+                phase = (pod.get("status") or {}).get("phase")
+                if spec.restart_policy == constants.RESTART_POLICY_EXIT_CODE:
+                    exit_code = 0
+                    for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+                        terminated = (cs.get("state") or {}).get("terminated")
+                        if cs.get("name") == constants.DEFAULT_CONTAINER_NAME and terminated:
+                            exit_code = terminated.get("exitCode", 0)
+                            self.recorder.eventf(
+                                job_dict,
+                                EVENT_TYPE_NORMAL,
+                                EXITED_WITH_CODE_REASON,
+                                "Pod: %s.%s exited with code %s",
+                                pod["metadata"].get("namespace", ""),
+                                pod["metadata"].get("name", ""),
+                                exit_code,
+                            )
+                    if phase == "Failed" and train_util.is_retryable_exit_code(exit_code):
+                        self.logger.info(
+                            "Need to restart the pod: %s", pod["metadata"].get("name")
+                        )
+                        self.pod_control.delete_pod(
+                            pod["metadata"].get("namespace", ""),
+                            pod["metadata"].get("name", ""),
+                            job_dict,
+                        )
+                        restart = True
+                status_machine.update_replica_statuses(job.status, rtype, pod)
+
+        self.update_status_single(job, job_dict, rtype, replicas, restart)
+
+    # ------------------------------------------------------------------
+    def create_new_pod(
+        self,
+        job: PyTorchJob,
+        job_dict: dict,
+        rtype: str,
+        index: str,
+        spec: ReplicaSpec,
+        master_role: bool,
+    ) -> None:
+        """pod.go:140-232."""
+        rt = rtype.lower()
+        job_key = job.key
+        self.expectations.expect_creations(expectation_pods_key(job_key, rt), 1)
+
+        controller_ref = self.gen_owner_reference(job_dict)
+        labels = self.gen_labels(job.metadata.name)
+        labels[constants.LABEL_REPLICA_TYPE] = rt
+        labels[constants.LABEL_REPLICA_INDEX] = index
+        if master_role:
+            labels[constants.LABEL_JOB_ROLE] = "master"
+
+        template = serde.to_dict(spec.template)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": copy.deepcopy(template.get("metadata") or {}),
+            "spec": copy.deepcopy(template.get("spec") or {}),
+        }
+        pod["metadata"]["name"] = gen_general_name(job.metadata.name, rt, index)
+        pod_labels = pod["metadata"].setdefault("labels", {})
+        pod_labels.update(labels)
+
+        set_cluster_spec(pod, job, index, rtype)
+
+        if pod["spec"].get("restartPolicy"):
+            msg = (
+                "Restart policy in pod template will be overwritten by"
+                " restart policy in replica spec"
+            )
+            self.logger.warning(msg)
+            self.recorder.event(
+                job_dict, EVENT_TYPE_WARNING, POD_TEMPLATE_RESTART_POLICY_REASON, msg
+            )
+        _set_restart_policy(pod, spec)
+
+        if not master_role:
+            master_addr = gen_general_name(
+                job.metadata.name, constants.REPLICA_TYPE_MASTER.lower(), 0
+            )
+            init_containers = initconfig.render_init_containers(
+                master_addr, self.config.init_container_image
+            )
+            pod["spec"].setdefault("initContainers", []).extend(init_containers)
+
+        if self.config.enable_gang_scheduling:
+            if self._is_non_gang_scheduler_set(job):
+                msg = (
+                    "Another scheduler is specified when gang-scheduling is"
+                    " enabled and it will not be overwritten"
+                )
+                self.logger.warning(msg)
+                self.recorder.event(
+                    job_dict, EVENT_TYPE_WARNING, POD_TEMPLATE_SCHEDULER_NAME_REASON, msg
+                )
+            else:
+                pod["spec"]["schedulerName"] = self.config.gang_scheduler_name
+            pod["metadata"].setdefault("annotations", {})[
+                constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION
+            ] = gen_pod_group_name(job.metadata.name)
+
+        self.pod_control.create_pod_with_controller_ref(
+            job.metadata.namespace, pod, job_dict, controller_ref
+        )
+
+    def _is_non_gang_scheduler_set(self, job: PyTorchJob) -> bool:
+        for spec in job.spec.pytorch_replica_specs.values():
+            name = spec.template.spec.scheduler_name
+            if name and name != self.config.gang_scheduler_name:
+                return True
+        return False
+
+
+def _set_restart_policy(pod: dict, spec: ReplicaSpec) -> None:
+    """pod.go:283-297: ExitCode maps to Never (the controller implements
+    the retry itself); other policies pass through to the pod."""
+    if spec.restart_policy == constants.RESTART_POLICY_EXIT_CODE:
+        pod["spec"]["restartPolicy"] = "Never"
+    else:
+        pod["spec"]["restartPolicy"] = spec.restart_policy

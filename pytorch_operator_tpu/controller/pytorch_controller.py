@@ -1,0 +1,373 @@
+"""The TPU-native PyTorchJob controller.
+
+First-party equivalent of the reference's
+pkg/controller.v1/pytorch/controller.go: event handlers feed a
+rate-limited workqueue; worker threads run ``sync_job``; expectations gate
+re-syncs; reconcile enforces backoff limits and active deadlines, drives
+per-replica pod/service reconciliation and the status machine, and
+persists status when it changed.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from ..api.v1 import constants
+from ..api.v1.defaults import set_defaults
+from ..api.v1.types import PyTorchJob
+from ..api.v1.validation import ValidationError, validate_spec
+from ..k8s import serde
+from ..k8s.errors import NotFoundError
+from ..metrics import default_registry
+from ..runtime.expectations import (
+    expectation_pods_key,
+    expectation_services_key,
+)
+from ..runtime.informer import Informer, split_meta_namespace_key
+from ..runtime.job_controller import JobController, JobControllerConfig
+from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+from . import status as status_machine
+from .job import JobLifecycleMixin, get_total_failed_replicas, get_total_replicas, parse_time
+from .pod import PodReconcilerMixin
+from .service import ServiceReconcilerMixin
+
+
+class PyTorchController(
+    JobLifecycleMixin, PodReconcilerMixin, ServiceReconcilerMixin, JobController
+):
+    def __init__(
+        self,
+        cluster,
+        config: Optional[JobControllerConfig] = None,
+        recorder=None,
+        registry=None,
+    ):
+        super().__init__(cluster, config, recorder)
+        self.logger = logging.getLogger(constants.CONTROLLER_NAME)
+        self.job_informer = Informer(cluster.jobs)
+        self.job_informer.add_event_handler(
+            on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
+        )
+        registry = registry or default_registry
+        self.jobs_created_counter = registry.counter(
+            "pytorch_operator_jobs_created_total", "Counts number of PyTorch jobs created"
+        )
+        self.jobs_deleted_counter = registry.counter(
+            "pytorch_operator_jobs_deleted_total", "Counts number of PyTorch jobs deleted"
+        )
+        self.jobs_successful_counter = registry.counter(
+            "pytorch_operator_jobs_successful_total", "Counts number of PyTorch jobs successful"
+        )
+        self.jobs_failed_counter = registry.counter(
+            "pytorch_operator_jobs_failed_total", "Counts number of PyTorch jobs failed"
+        )
+        self.jobs_restarted_counter = registry.counter(
+            "pytorch_operator_jobs_restarted_total", "Counts number of PyTorch jobs restarted"
+        )
+        # Handlers are attributes so tier-2 tests can stub the status write
+        # (reference controller_test.go:214-217).
+        self.update_status_handler = self._update_job_status
+        self.delete_job_handler = self._delete_job
+
+    # -- plumbing ----------------------------------------------------------
+    def _job_from_unstructured(self, obj: dict) -> PyTorchJob:
+        """informer.go:83-104: convert + validate."""
+        job = PyTorchJob.from_dict(obj)
+        validate_spec(job.spec)
+        return job
+
+    def _get_job_from_cache(self, namespace: str, name: str) -> Optional[dict]:
+        return self.job_informer.store.get_by_key(f"{namespace}/{name}")
+
+    def _job_deleted(self, obj: dict) -> None:
+        self.enqueue_job(obj)
+
+    def _update_job_status(self, job: PyTorchJob) -> None:
+        self.cluster.jobs.update(job.to_dict(), subresource="status")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_informers(self) -> None:
+        self.job_informer.start()
+        self.pod_informer.start()
+        self.service_informer.start()
+
+    def run(self, threadiness: int = 1, stop_event: Optional[threading.Event] = None):
+        """controller.go:185-213."""
+        stop_event = stop_event or threading.Event()
+        self.start_informers()
+        workers = []
+        for _ in range(threadiness):
+            t = threading.Thread(target=self._run_worker, args=(stop_event,), daemon=True)
+            t.start()
+            workers.append(t)
+        return workers
+
+    def _run_worker(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            if not self.process_next_work_item(timeout=0.5):
+                return
+
+    def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
+        """controller.go:222-274."""
+        key, shutdown = self.work_queue.get(timeout=timeout)
+        if shutdown:
+            return False
+        if key is None:
+            return True
+        try:
+            forget, err = self.sync_job(key)
+            if err is None and forget:
+                self.work_queue.forget(key)
+            elif err is not None:
+                self.logger.warning("reconcile error for %s: %s", key, err)
+                self.work_queue.add_rate_limited(key)
+        finally:
+            self.work_queue.done(key)
+        return True
+
+    # -- sync --------------------------------------------------------------
+    def sync_job(self, key: str):
+        """controller.go:290-334. Returns (forget, error)."""
+        start = time.monotonic()
+        try:
+            namespace, name = split_meta_namespace_key(key)
+        except ValueError as e:
+            return False, e
+        if not namespace or not name:
+            return False, ValueError(
+                f"invalid job key {key!r}: either namespace or name is missing"
+            )
+        obj = self._get_job_from_cache(namespace, name)
+        if obj is None:
+            self.logger.info("PyTorchJob has been deleted: %s", key)
+            self.jobs_deleted_counter.inc()
+            for rtype in constants.VALID_REPLICA_TYPES:
+                self.expectations.delete_expectations(expectation_pods_key(key, rtype))
+                self.expectations.delete_expectations(expectation_services_key(key, rtype))
+            return True, None
+        try:
+            job = self._job_from_unstructured(obj)
+        except ValidationError as e:
+            self.logger.error("Failed to convert the PyTorchJob: %s", e)
+            # A job can also become invalid via an update after a valid
+            # admission — mark it Failed here too, then stop reconciling.
+            self.mark_job_invalid(obj, e)
+            return True, None
+
+        set_defaults(job)
+        job_needs_sync = self.satisfied_expectations(job)
+
+        err = None
+        if job_needs_sync and not job.metadata.deletion_timestamp:
+            try:
+                self.reconcile(job, obj)
+            except Exception as e:  # reconcile errors requeue the job
+                err = e
+        self.logger.debug(
+            "Finished syncing job %s (%.3fs)", key, time.monotonic() - start
+        )
+        if err is not None:
+            return False, err
+        return True, None
+
+    def satisfied_expectations(self, job: PyTorchJob) -> bool:
+        """controller.go:497-516."""
+        satisfied = False
+        job_key = job.key
+        for rtype in job.spec.pytorch_replica_specs:
+            satisfied = satisfied or self.expectations.satisfied(
+                expectation_pods_key(job_key, rtype)
+            )
+            satisfied = satisfied or self.expectations.satisfied(
+                expectation_services_key(job_key, rtype)
+            )
+        return satisfied
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self, job: PyTorchJob, job_dict: dict) -> None:
+        """controller.go:336-492."""
+        job_key = job.key
+        old_status = serde.deep_copy(job.status)
+
+        pods = self.get_pods_for_job(job_dict)
+        services = self.get_services_for_job(job_dict)
+
+        # Terminal: clean up and freeze status.
+        if status_machine.is_succeeded(job.status) or status_machine.is_failed(job.status):
+            self.delete_pods_and_services(job, job_dict, pods, services)
+            self.cleanup_job(job)
+            if self.config.enable_gang_scheduling:
+                self.delete_pod_group(job_dict)
+            if status_machine.is_succeeded(job.status):
+                for rtype in job.status.replica_statuses:
+                    rs = job.status.replica_statuses[rtype]
+                    rs.succeeded += rs.active
+                    rs.active = 0
+            if job.status != old_status:
+                self.update_status_handler(job)
+            return
+
+        previous_retry = self.work_queue.num_requeues(job_key)
+        active = sum(
+            1
+            for p in pods
+            if (p.get("status") or {}).get("phase") in ("Running", "Pending")
+        )
+        failed = sum(
+            1 for p in pods if (p.get("status") or {}).get("phase") == "Failed"
+        )
+        total = get_total_replicas(job)
+        prev_failed = get_total_failed_replicas(job)
+
+        job_exceeds_limit = False
+        failure_message = ""
+        if job.spec.backoff_limit is not None:
+            job_has_new_failure = failed > prev_failed
+            exceeds_backoff_limit = (
+                job_has_new_failure
+                and active != total
+                and previous_retry + 1 > job.spec.backoff_limit
+            )
+            if exceeds_backoff_limit or self.past_backoff_limit(job, pods):
+                job_exceeds_limit = True
+                failure_message = (
+                    f"PyTorchJob {job.metadata.name} has failed because it has"
+                    " reached the specified backoff limit"
+                )
+        if not job_exceeds_limit and self.past_active_deadline(job):
+            job_exceeds_limit = True
+            failure_message = (
+                f"PyTorchJob {job.metadata.name} has failed because it was"
+                " active longer than specified deadline"
+            )
+
+        if job_exceeds_limit:
+            self.delete_pods_and_services(job, job_dict, pods, services)
+            self.cleanup_job(job)
+            if self.config.enable_gang_scheduling:
+                self.delete_pod_group(job_dict)
+            self.recorder.event(
+                job_dict, EVENT_TYPE_NORMAL, status_machine.JOB_FAILED_REASON, failure_message
+            )
+            if job.status.completion_time is None:
+                job.status.completion_time = status_machine.now_iso()
+            status_machine.update_job_conditions(
+                job.status, constants.JOB_FAILED, status_machine.JOB_FAILED_REASON,
+                failure_message,
+            )
+            self.jobs_failed_counter.inc()
+        else:
+            if self.config.enable_gang_scheduling:
+                self.sync_pod_group(job_dict, get_total_replicas(job))
+            for rtype, spec in job.spec.pytorch_replica_specs.items():
+                self.reconcile_pods(job, job_dict, pods, rtype, spec)
+                # TPU deviation: services for EVERY replica type (the
+                # reference skips non-Master, controller.go:474-477) — all
+                # hosts need DNS for TPU_WORKER_HOSTNAMES.
+                self.reconcile_services(job, job_dict, services, rtype, spec)
+
+        if job.status != old_status:
+            self.update_status_handler(job)
+
+    # -- status (status.go:63-146) -----------------------------------------
+    def update_status_single(
+        self, job: PyTorchJob, job_dict: dict, rtype: str, replicas: int, restart: bool
+    ) -> None:
+        rs = job.status.replica_statuses.get(rtype)
+        expected = replicas - (rs.succeeded if rs else 0)
+        running = rs.active if rs else 0
+        failed = rs.failed if rs else 0
+
+        if job.status.start_time is None:
+            job.status.start_time = status_machine.now_iso()
+            if job.spec.active_deadline_seconds is not None:
+                self.logger.info(
+                    "Job with ActiveDeadlineSeconds will sync after %s seconds",
+                    job.spec.active_deadline_seconds,
+                )
+                self.work_queue.add_after(job.key, job.spec.active_deadline_seconds)
+
+        if constants.REPLICA_TYPE_MASTER not in job.spec.pytorch_replica_specs:
+            raise ValueError("invalid config: Job must contain master replica spec")
+
+        if rtype == constants.REPLICA_TYPE_MASTER:
+            if running > 0:
+                msg = f"PyTorchJob {job.metadata.name} is running."
+                status_machine.update_job_conditions(
+                    job.status, constants.JOB_RUNNING, status_machine.JOB_RUNNING_REASON, msg
+                )
+            if expected == 0:
+                msg = f"PyTorchJob {job.metadata.name} is successfully completed."
+                self.recorder.event(
+                    job_dict, EVENT_TYPE_NORMAL, status_machine.JOB_SUCCEEDED_REASON, msg
+                )
+                if job.status.completion_time is None:
+                    job.status.completion_time = status_machine.now_iso()
+                status_machine.update_job_conditions(
+                    job.status, constants.JOB_SUCCEEDED, status_machine.JOB_SUCCEEDED_REASON, msg
+                )
+                self.jobs_successful_counter.inc()
+
+        if failed > 0:
+            if restart:
+                msg = (
+                    f"PyTorchJob {job.metadata.name} is restarting because"
+                    f" {failed} {rtype} replica(s) failed."
+                )
+                self.recorder.event(
+                    job_dict, EVENT_TYPE_WARNING, status_machine.JOB_RESTARTING_REASON, msg
+                )
+                status_machine.update_job_conditions(
+                    job.status, constants.JOB_RESTARTING, status_machine.JOB_RESTARTING_REASON, msg
+                )
+                self.jobs_failed_counter.inc()
+                self.jobs_restarted_counter.inc()
+            else:
+                msg = (
+                    f"PyTorchJob {job.metadata.name} is failed because"
+                    f" {failed} {rtype} replica(s) failed."
+                )
+                self.recorder.event(
+                    job_dict, EVENT_TYPE_NORMAL, status_machine.JOB_FAILED_REASON, msg
+                )
+                if job.status.completion_time is None:
+                    job.status.completion_time = status_machine.now_iso()
+                status_machine.update_job_conditions(
+                    job.status, constants.JOB_FAILED, status_machine.JOB_FAILED_REASON, msg
+                )
+                self.jobs_failed_counter.inc()
+
+    # -- limits (controller.go:518-569) ------------------------------------
+    def past_backoff_limit(self, job: PyTorchJob, pods: List[dict]) -> bool:
+        if job.spec.backoff_limit is None:
+            return False
+        result = 0
+        for rtype, spec in job.spec.pytorch_replica_specs.items():
+            if spec.restart_policy not in (
+                constants.RESTART_POLICY_ON_FAILURE,
+                constants.RESTART_POLICY_ALWAYS,
+            ):
+                continue
+            for pod in self.filter_pods_for_replica_type(pods, rtype.lower()):
+                phase = (pod.get("status") or {}).get("phase")
+                if phase not in ("Running", "Pending"):
+                    continue
+                pod_status = pod.get("status") or {}
+                for cs in (pod_status.get("initContainerStatuses") or []) + (
+                    pod_status.get("containerStatuses") or []
+                ):
+                    result += cs.get("restartCount", 0)
+        if job.spec.backoff_limit == 0:
+            return result > 0
+        return result >= job.spec.backoff_limit
+
+    def past_active_deadline(self, job: PyTorchJob) -> bool:
+        if job.spec.active_deadline_seconds is None or job.status.start_time is None:
+            return False
+        start = parse_time(job.status.start_time)
+        if start is None:
+            return False
+        return time.time() - start >= job.spec.active_deadline_seconds

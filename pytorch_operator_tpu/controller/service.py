@@ -1,0 +1,72 @@
+"""Service reconciliation.
+
+The reference creates a headless Service only for the Master
+(pkg/controller.v1/pytorch/service.go + controller.go:474-479).  The
+TPU-native build creates one headless Service PER REPLICA — master and
+every worker — because the PJRT/XRT rendezvous needs stable DNS for all
+hosts in TPU_WORKER_HOSTNAMES before libtpu init (SURVEY.md §5
+"distributed communication backend").
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob, ReplicaSpec
+from ..runtime.expectations import expectation_services_key
+from ..runtime.job_controller import gen_general_name
+from .tpu_env import get_port_from_job
+
+
+class ServiceReconcilerMixin:
+    def reconcile_services(
+        self,
+        job: PyTorchJob,
+        job_dict: dict,
+        services: List[dict],
+        rtype: str,
+        spec: ReplicaSpec,
+    ) -> None:
+        """service.go:36-71, generalized to any replica type."""
+        rt = rtype.lower()
+        services = self.filter_services_for_replica_type(services, rt)
+        replicas = int(spec.replicas or 0)
+        service_slices = self.get_service_slices(services, replicas)
+        for index, service_slice in enumerate(service_slices):
+            if len(service_slice) > 1:
+                self.logger.warning("We have too many services for %s %d", rt, index)
+            elif len(service_slice) == 0:
+                self.logger.info("Need to create new service: %s-%d", rt, index)
+                self.create_new_service(job, job_dict, rtype, str(index))
+
+    def create_new_service(
+        self, job: PyTorchJob, job_dict: dict, rtype: str, index: str
+    ) -> None:
+        """service.go:95-159."""
+        rt = rtype.lower()
+        self.expectations.expect_creations(
+            expectation_services_key(job.key, rt), 1
+        )
+        controller_ref = self.gen_owner_reference(job_dict)
+        labels = self.gen_labels(job.metadata.name)
+        labels[constants.LABEL_REPLICA_TYPE] = rt
+        labels[constants.LABEL_REPLICA_INDEX] = index
+
+        port = get_port_from_job(job, constants.REPLICA_TYPE_MASTER)
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": gen_general_name(job.metadata.name, rt, index),
+                "labels": dict(labels),
+            },
+            "spec": {
+                "clusterIP": "None",
+                "selector": dict(labels),
+                "ports": [{"name": constants.DEFAULT_PORT_NAME, "port": port}],
+            },
+        }
+        self.service_control.create_service_with_controller_ref(
+            job.metadata.namespace, service, job_dict, controller_ref
+        )

@@ -1,0 +1,124 @@
+"""Job status condition machine.
+
+Behavioral mirror of the reference's
+pkg/controller.v1/pytorch/status.go:155-273: condition de-duplication,
+Running<->Restarting mutual exclusion, Running set False on terminal
+states, and the completed-status freeze (no transitions out of
+Succeeded/Failed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api.v1 import constants
+from ..api.v1.types import JobCondition, JobStatus, ReplicaStatus
+
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+
+# Condition reasons (status.go:30-46).
+JOB_CREATED_REASON = "PyTorchJobCreated"
+JOB_SUCCEEDED_REASON = "PyTorchJobSucceeded"
+JOB_RUNNING_REASON = "PyTorchJobRunning"
+JOB_FAILED_REASON = "PyTorchJobFailed"
+JOB_RESTARTING_REASON = "PyTorchJobRestarting"
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def new_condition(cond_type: str, reason: str, message: str) -> JobCondition:
+    return JobCondition(
+        type=cond_type,
+        status=CONDITION_TRUE,
+        reason=reason,
+        message=message,
+        last_update_time=now_iso(),
+        last_transition_time=now_iso(),
+    )
+
+
+def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
+    for condition in status.conditions:
+        if condition.type == cond_type:
+            return condition
+    return None
+
+
+def has_condition(status: JobStatus, cond_type: str) -> bool:
+    return any(
+        c.type == cond_type and c.status == CONDITION_TRUE for c in status.conditions
+    )
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, constants.JOB_SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, constants.JOB_FAILED)
+
+
+def set_condition(status: JobStatus, condition: JobCondition) -> None:
+    """status.go:226-248."""
+    if is_failed(status) or is_succeeded(status):
+        return
+    current = get_condition(status, condition.type)
+    if (
+        current is not None
+        and current.status == condition.status
+        and current.reason == condition.reason
+    ):
+        return
+    if current is not None and current.status == condition.status:
+        condition.last_transition_time = current.last_transition_time
+    status.conditions = _filter_out_condition(status.conditions, condition.type) + [
+        condition
+    ]
+
+
+def _filter_out_condition(
+    conditions: List[JobCondition], cond_type: str
+) -> List[JobCondition]:
+    """status.go:250-272: drops the same-type condition, enforces
+    Running<->Restarting exclusivity, and falsifies Running on terminal."""
+    out: List[JobCondition] = []
+    for c in conditions:
+        if cond_type == constants.JOB_RESTARTING and c.type == constants.JOB_RUNNING:
+            continue
+        if cond_type == constants.JOB_RUNNING and c.type == constants.JOB_RESTARTING:
+            continue
+        if c.type == cond_type:
+            continue
+        if (
+            cond_type in (constants.JOB_FAILED, constants.JOB_SUCCEEDED)
+            and c.type == constants.JOB_RUNNING
+        ):
+            c.status = CONDITION_FALSE
+        out.append(c)
+    return out
+
+
+def update_job_conditions(
+    status: JobStatus, cond_type: str, reason: str, message: str
+) -> None:
+    set_condition(status, new_condition(cond_type, reason, message))
+
+
+def initialize_replica_statuses(status: JobStatus, rtype: str) -> None:
+    status.replica_statuses[rtype] = ReplicaStatus()
+
+
+def update_replica_statuses(status: JobStatus, rtype: str, pod: dict) -> None:
+    """Tally one pod's phase into the replica status (status.go:172-182)."""
+    phase = (pod.get("status") or {}).get("phase")
+    rs = status.replica_statuses.setdefault(rtype, ReplicaStatus())
+    if phase == "Running":
+        rs.active += 1
+    elif phase == "Succeeded":
+        rs.succeeded += 1
+    elif phase == "Failed":
+        rs.failed += 1

@@ -1,0 +1,142 @@
+"""TPU/PJRT cluster-spec injection.
+
+This replaces the reference's ``setClusterSpec``
+(pkg/controller.v1/pytorch/pod.go:234-281).  Where the reference wires the
+c10d rendezvous (MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE) for gloo/nccl,
+this operator natively bootstraps TPU workloads:
+
+  * ``TPU_WORKER_ID`` — the replica's deterministic rank (master=0,
+    worker i = i+1);
+  * ``TPU_WORKER_HOSTNAMES`` — comma-joined headless-service DNS names of
+    ALL replicas ordered by rank (every replica gets its own headless
+    Service, unlike the reference's master-only service.go) — ordering
+    must match worker IDs or libtpu hangs (SURVEY.md §7 hard parts);
+  * ``XRT_TPU_CONFIG`` — the XRT fallback mesh config;
+  * ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID`` — JAX
+    ``jax.distributed.initialize`` bootstrap;
+  * ``PJRT_DEVICE=TPU`` — selects the PJRT TPU plugin in torch_xla;
+  * plus the c10d-compatible MASTER_ADDR/PORT/RANK/WORLD_SIZE so
+    ``torch.distributed`` with ``backend='xla'`` keeps working unchanged.
+
+Collectives then run over ICI/DCN executed by libtpu/XLA — the operator
+never touches them, exactly as the reference never touches NCCL rings.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob
+from ..runtime.job_controller import gen_general_name
+
+XRT_TPU_MESH_PORT = 8470
+
+
+class InvalidClusterSpecError(ValueError):
+    pass
+
+
+def get_port_from_job(job: PyTorchJob, rtype: str) -> int:
+    """Find the named rendezvous port on the ``pytorch`` container
+    (reference util.go:34-47)."""
+    spec = job.spec.pytorch_replica_specs.get(rtype)
+    if spec is None:
+        raise InvalidClusterSpecError(f"no replica spec for {rtype}")
+    for container in spec.template.spec.containers:
+        if container.name == constants.DEFAULT_CONTAINER_NAME:
+            for port in container.ports:
+                if port.name == constants.DEFAULT_PORT_NAME:
+                    return port.container_port
+    raise InvalidClusterSpecError("failed to find the port")
+
+
+def total_replicas(job: PyTorchJob) -> int:
+    return sum(int(s.replicas or 0) for s in job.spec.pytorch_replica_specs.values())
+
+
+def replica_hostnames(job: PyTorchJob) -> List[str]:
+    """Headless-service DNS names of every replica, ordered by rank.
+
+    Rank 0 is the Master; worker i has rank i+1.  The names are the
+    per-replica Service names ``{job}-{rtype}-{index}`` which resolve via
+    the services this controller creates for ALL replica types.
+    """
+    name = job.metadata.name
+    hostnames = [gen_general_name(name, constants.REPLICA_TYPE_MASTER.lower(), 0)]
+    worker_spec = job.spec.pytorch_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+    n_workers = int(worker_spec.replicas or 0) if worker_spec else 0
+    for i in range(n_workers):
+        hostnames.append(gen_general_name(name, constants.REPLICA_TYPE_WORKER.lower(), i))
+    return hostnames
+
+
+def build_cluster_env(job: PyTorchJob, rtype: str, index: str) -> List[dict]:
+    """Compute the full env-var list for one replica."""
+    try:
+        rank = int(index)
+    except ValueError as e:
+        raise InvalidClusterSpecError(f"invalid replica index {index!r}") from e
+
+    master_port = get_port_from_job(job, constants.REPLICA_TYPE_MASTER)
+    master_service = gen_general_name(
+        job.metadata.name, constants.REPLICA_TYPE_MASTER.lower(), 0
+    )
+
+    if rtype == constants.REPLICA_TYPE_MASTER:
+        if rank != 0:
+            raise InvalidClusterSpecError(
+                "invalid config: There should be only a single master with index=0"
+            )
+        master_addr = "localhost"  # reference pod.go:246-249 parity
+    else:
+        master_addr = master_service
+        rank = rank + 1
+
+    hostnames = replica_hostnames(job)
+    world_size = total_replicas(job)
+    env = [
+        # c10d compatibility block (backend='xla' / gloo fallback).
+        {"name": constants.ENV_MASTER_PORT, "value": str(master_port)},
+        {"name": constants.ENV_MASTER_ADDR, "value": master_addr},
+        {"name": constants.ENV_WORLD_SIZE, "value": str(world_size)},
+        {"name": constants.ENV_RANK, "value": str(rank)},
+        {"name": constants.ENV_PYTHONUNBUFFERED, "value": "1"},
+        # TPU/PJRT native block.
+        {"name": constants.ENV_PJRT_DEVICE, "value": "TPU"},
+        {"name": constants.ENV_TPU_WORKER_ID, "value": str(rank)},
+        {"name": constants.ENV_TPU_WORKER_HOSTNAMES, "value": ",".join(hostnames)},
+        {
+            "name": constants.ENV_XRT_TPU_CONFIG,
+            "value": "tpu_worker;{};{}".format(
+                rank, ",".join(f"{h}:{XRT_TPU_MESH_PORT}" for h in hostnames)
+            ),
+        },
+        # JAX multi-host bootstrap (jax.distributed.initialize).
+        {
+            "name": constants.ENV_JAX_COORDINATOR_ADDRESS,
+            "value": f"{master_service}:{master_port}",
+        },
+        {"name": constants.ENV_JAX_NUM_PROCESSES, "value": str(world_size)},
+        {"name": constants.ENV_JAX_PROCESS_ID, "value": str(rank)},
+    ]
+    return env
+
+
+def set_cluster_spec(pod_template: dict, job: PyTorchJob, index: str, rtype: str) -> None:
+    """Append the cluster env to every container in the template (in place)."""
+    env = build_cluster_env(job, rtype, index)
+    for container in pod_template.setdefault("spec", {}).setdefault("containers", []):
+        container.setdefault("env", []).extend(
+            [dict(e) for e in env]
+        )
+
+
+def requests_tpu(pod_template: dict) -> bool:
+    """True when any container requests google.com/tpu chips."""
+    for container in (pod_template.get("spec") or {}).get("containers") or []:
+        resources = container.get("resources") or {}
+        for section in ("limits", "requests"):
+            if constants.TPU_RESOURCE in (resources.get(section) or {}):
+                return True
+    return False

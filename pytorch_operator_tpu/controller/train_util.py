@@ -1,0 +1,43 @@
+"""Exit-code retry classification for RestartPolicy=ExitCode.
+
+Behavioral mirror of the reference's
+vendor/github.com/kubeflow/tf-operator/pkg/util/train/train_util.go:18-53,
+extended with a TPU-aware set: libtpu initialization races and device
+preemptions surface as SIGABRT (134) or SIGBUS (135) on TPU VMs, which are
+transient (another worker held the chip lock, or the slice was being
+re-gang-scheduled) — so they are classified retryable here.  The
+documented user contract is preserved: 1-127 permanent unless listed,
+128+n follows the signal semantics, 138 (SIGUSR1) is the user-defined
+retryable code.
+"""
+
+from __future__ import annotations
+
+# Permanent: general errors, shell misuse, cannot execute, not found,
+# invalid exit argument, SIGSEGV.
+_PERMANENT = frozenset({1, 2, 126, 127, 128, 139})
+
+# Transient by signal: SIGINT (130), SIGKILL (137), SIGTERM (143) —
+# typically VM reschedules or preemptions.
+_RETRYABLE_SIGNALS = frozenset({130, 137, 143})
+
+# User-defined retryable (SIGUSR1).
+USER_DEFINED_RETRYABLE_EXIT_CODE = 138
+
+# TPU-specific transients: SIGABRT (134, libtpu chip-lock contention /
+# coordinator timeouts abort the process) and SIGBUS (135, HBM mapping
+# teardown during slice preemption).
+_TPU_RETRYABLE = frozenset({134, 135})
+
+
+def is_retryable_exit_code(exit_code: int, tpu_aware: bool = True) -> bool:
+    if exit_code in _PERMANENT:
+        return False
+    if exit_code in _RETRYABLE_SIGNALS:
+        return True
+    if exit_code == USER_DEFINED_RETRYABLE_EXIT_CODE:
+        return True
+    if tpu_aware and exit_code in _TPU_RETRYABLE:
+        return True
+    # No guarantee for other exit codes: treat as permanent.
+    return False

@@ -1,0 +1,102 @@
+"""Fake kubelet: advances pod phases like a node would.
+
+The reference has no simulation tier between fake-control unit tests and a
+real GKE cluster (SURVEY.md §4).  This fills that gap: subscribed to the
+fake cluster's pod store, it walks created pods through
+Pending -> Running -> Succeeded/Failed on a background thread, so the full
+controller loop (informers, workqueue, status machine, GC) can be
+exercised end-to-end in-process — the e2e driver
+(test/e2e/v1/default/defaults.go) flow without a cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .errors import NotFoundError
+from .fake import ADDED, FakeCluster
+
+
+class FakeKubelet:
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        run_delay: float = 0.02,
+        complete_delay: float = 0.05,
+        # decide(pod) -> ("Succeeded"|"Failed", exit_code), or None to
+        # leave the pod Running forever.
+        decide: Optional[Callable[[dict], Optional[tuple]]] = None,
+    ):
+        self.cluster = cluster
+        self.run_delay = run_delay
+        self.complete_delay = complete_delay
+        self.decide = decide or (lambda pod: ("Succeeded", 0))
+        self._timers: Dict[str, threading.Timer] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def start(self) -> None:
+        self.cluster.pods.add_listener(self._on_pod_event)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for t in self._timers.values():
+                t.cancel()
+            self._timers.clear()
+        self.cluster.pods.remove_listener(self._on_pod_event)
+
+    # ------------------------------------------------------------------
+    def _on_pod_event(self, event_type: str, pod: dict) -> None:
+        if event_type != ADDED:
+            return
+        meta = pod.get("metadata", {})
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        self._set_phase(ns, name, "Pending")
+        self._schedule(f"{ns}/{name}/run", self.run_delay, self._run_pod, ns, name)
+
+    def _run_pod(self, ns: str, name: str) -> None:
+        self._set_phase(ns, name, "Running")
+        self._schedule(
+            f"{ns}/{name}/complete", self.complete_delay, self._complete_pod, ns, name
+        )
+
+    def _complete_pod(self, ns: str, name: str) -> None:
+        try:
+            pod = self.cluster.pods.get(ns, name)
+        except NotFoundError:
+            return
+        decision = self.decide(pod)
+        if decision is None:
+            return
+        phase, exit_code = decision
+        status = {
+            "phase": phase,
+            "containerStatuses": [
+                {
+                    "name": "pytorch",
+                    "restartCount": 0,
+                    "state": {"terminated": {"exitCode": exit_code}},
+                }
+            ],
+        }
+        try:
+            self.cluster.pods.set_status(ns, name, status)
+        except NotFoundError:
+            pass
+
+    def _set_phase(self, ns: str, name: str, phase: str) -> None:
+        try:
+            self.cluster.pods.set_status(ns, name, {"phase": phase})
+        except NotFoundError:
+            pass
+
+    def _schedule(self, key: str, delay: float, fn, *args) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            timer = threading.Timer(delay, fn, args=args)
+            timer.daemon = True
+            self._timers[key] = timer
+            timer.start()

@@ -1,0 +1,3 @@
+from .prometheus import Counter, Gauge, Registry, default_registry
+
+__all__ = ["Counter", "Gauge", "Registry", "default_registry"]

@@ -1,0 +1,92 @@
+"""Minimal Prometheus client: counters, gauges, text exposition.
+
+Replaces the reference's promauto/prometheus dependency
+(pkg/controller.v1/pytorch/{controller.go:60-70,job.go:26-33,status.go:47-59}
+and cmd/.../server.go:58-61).  The exposition format follows
+https://prometheus.io/docs/instrumenting/exposition_formats/ (text 0.0.4)
+so the scrape annotations in manifests/service.yaml keep working.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, metric_type: str):
+        self.name = name
+        self.help = help_text
+        self.type = metric_type
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} {self.type}\n"
+            f"{self.name} {self._format(self.value)}\n"
+        )
+
+    @staticmethod
+    def _format(v: float) -> str:
+        return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "counter")
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "gauge")
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_create(name, help_text, Counter)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_create(name, help_text, Gauge)
+
+    def _get_or_create(self, name, help_text, cls):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = cls(name, help_text)
+                self._metrics[name] = metric
+            return metric
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics: List[_Metric] = sorted(self._metrics.values(), key=lambda m: m.name)
+        return "".join(m.expose() for m in metrics)
+
+
+default_registry = Registry()

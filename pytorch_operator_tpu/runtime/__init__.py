@@ -1,0 +1,43 @@
+"""Generic job-controller runtime: workqueue, expectations, informers,
+controls, event recording, and the JobController base class.
+
+First-party reimplementation of the reference's vendored shared runtime
+(vendor/github.com/kubeflow/tf-operator/pkg/{common/jobcontroller,control,
+logger,util} — SURVEY.md §2.2)."""
+
+from .controls import (
+    FakePodControl,
+    FakeServiceControl,
+    PodControl,
+    ServiceControl,
+)
+from .expectations import (
+    ControllerExpectations,
+    expectation_pods_key,
+    expectation_services_key,
+)
+from .informer import Informer, Store, meta_namespace_key, split_meta_namespace_key
+from .job_controller import JobController, JobControllerConfig, gen_general_name
+from .recorder import EventRecorder, FakeRecorder
+from .workqueue import RateLimiter, WorkQueue
+
+__all__ = [
+    "WorkQueue",
+    "RateLimiter",
+    "ControllerExpectations",
+    "expectation_pods_key",
+    "expectation_services_key",
+    "Informer",
+    "Store",
+    "meta_namespace_key",
+    "split_meta_namespace_key",
+    "PodControl",
+    "ServiceControl",
+    "FakePodControl",
+    "FakeServiceControl",
+    "EventRecorder",
+    "FakeRecorder",
+    "JobController",
+    "JobControllerConfig",
+    "gen_general_name",
+]

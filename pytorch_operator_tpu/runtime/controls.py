@@ -1,0 +1,186 @@
+"""Pod and Service controls: typed create/delete wrappers that emit Events.
+
+First-party equivalents of the reference's
+vendor/github.com/kubeflow/tf-operator/pkg/control/{pod_control.go,
+service_control.go}: RealPodControl / RealServiceControl issue the API
+calls and record SuccessfulCreate / FailedCreate / SuccessfulDelete
+events; FakePodControl / FakeServiceControl record templates and deleted
+names for the tier-2 unit tests (service_control.go:148-210).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+from ..k8s import serde
+from ..k8s.errors import ApiError
+from ..k8s.objects import OwnerReference, Pod, Service
+from .recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+
+SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
+FAILED_CREATE_POD_REASON = "FailedCreatePod"
+SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDeletePod"
+FAILED_DELETE_POD_REASON = "FailedDeletePod"
+SUCCESSFUL_CREATE_SERVICE_REASON = "SuccessfulCreateService"
+FAILED_CREATE_SERVICE_REASON = "FailedCreateService"
+SUCCESSFUL_DELETE_SERVICE_REASON = "SuccessfulDeleteService"
+FAILED_DELETE_SERVICE_REASON = "FailedDeleteService"
+
+
+def _owner_ref_dict(ref: OwnerReference) -> dict:
+    return serde.to_dict(ref)
+
+
+class PodControl:
+    def __init__(self, pods_client, recorder):
+        self._pods = pods_client
+        self._recorder = recorder
+
+    def create_pod_with_controller_ref(
+        self, namespace: str, pod: dict, controller_obj: dict, controller_ref: OwnerReference
+    ) -> dict:
+        pod = copy.deepcopy(pod)
+        meta = pod.setdefault("metadata", {})
+        refs = meta.setdefault("ownerReferences", [])
+        refs.append(_owner_ref_dict(controller_ref))
+        try:
+            created = self._pods.create(namespace, pod)
+        except ApiError as e:
+            self._recorder.eventf(
+                controller_obj,
+                EVENT_TYPE_WARNING,
+                FAILED_CREATE_POD_REASON,
+                "Error creating: %s",
+                e,
+            )
+            raise
+        self._recorder.eventf(
+            controller_obj,
+            EVENT_TYPE_NORMAL,
+            SUCCESSFUL_CREATE_POD_REASON,
+            "Created pod: %s",
+            created["metadata"]["name"],
+        )
+        return created
+
+    def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
+        try:
+            self._pods.delete(namespace, name)
+        except ApiError as e:
+            self._recorder.eventf(
+                controller_obj, EVENT_TYPE_WARNING, FAILED_DELETE_POD_REASON,
+                "Error deleting: %s", e,
+            )
+            raise
+        self._recorder.eventf(
+            controller_obj, EVENT_TYPE_NORMAL, SUCCESSFUL_DELETE_POD_REASON,
+            "Deleted pod: %s", name,
+        )
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        return self._pods.patch(namespace, name, patch)
+
+
+class ServiceControl:
+    def __init__(self, services_client, recorder):
+        self._services = services_client
+        self._recorder = recorder
+
+    def create_service_with_controller_ref(
+        self, namespace: str, service: dict, controller_obj: dict, controller_ref: OwnerReference
+    ) -> dict:
+        service = copy.deepcopy(service)
+        meta = service.setdefault("metadata", {})
+        refs = meta.setdefault("ownerReferences", [])
+        refs.append(_owner_ref_dict(controller_ref))
+        try:
+            created = self._services.create(namespace, service)
+        except ApiError as e:
+            self._recorder.eventf(
+                controller_obj, EVENT_TYPE_WARNING, FAILED_CREATE_SERVICE_REASON,
+                "Error creating: %s", e,
+            )
+            raise
+        self._recorder.eventf(
+            controller_obj, EVENT_TYPE_NORMAL, SUCCESSFUL_CREATE_SERVICE_REASON,
+            "Created service: %s", created["metadata"]["name"],
+        )
+        return created
+
+    def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
+        try:
+            self._services.delete(namespace, name)
+        except ApiError as e:
+            self._recorder.eventf(
+                controller_obj, EVENT_TYPE_WARNING, FAILED_DELETE_SERVICE_REASON,
+                "Error deleting: %s", e,
+            )
+            raise
+        self._recorder.eventf(
+            controller_obj, EVENT_TYPE_NORMAL, SUCCESSFUL_DELETE_SERVICE_REASON,
+            "Deleted service: %s", name,
+        )
+
+    def patch_service(self, namespace: str, name: str, patch: dict) -> dict:
+        return self._services.patch(namespace, name, patch)
+
+
+class FakePodControl:
+    """Records create/delete requests without touching any store
+    (reference: kube's controller.FakePodControl used in controller_test.go:61)."""
+
+    def __init__(self):
+        self.templates: List[dict] = []
+        self.controller_refs: List[OwnerReference] = []
+        self.delete_pod_names: List[str] = []
+        self.patches: List[dict] = []
+        self.create_error: Optional[Exception] = None
+        self.delete_error: Optional[Exception] = None
+
+    def create_pod_with_controller_ref(self, namespace, pod, controller_obj, controller_ref):
+        if self.create_error is not None:
+            raise self.create_error
+        pod = copy.deepcopy(pod)
+        pod.setdefault("metadata", {}).setdefault("ownerReferences", []).append(
+            _owner_ref_dict(controller_ref)
+        )
+        self.templates.append(pod)
+        self.controller_refs.append(controller_ref)
+        return pod
+
+    def delete_pod(self, namespace, name, controller_obj):
+        if self.delete_error is not None:
+            raise self.delete_error
+        self.delete_pod_names.append(name)
+
+    def patch_pod(self, namespace, name, patch):
+        self.patches.append(patch)
+        return patch
+
+
+class FakeServiceControl:
+    """Reference: vendor/.../control/service_control.go:148-210."""
+
+    def __init__(self):
+        self.templates: List[dict] = []
+        self.delete_service_names: List[str] = []
+        self.patches: List[dict] = []
+        self.create_error: Optional[Exception] = None
+
+    def create_service_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
+        if self.create_error is not None:
+            raise self.create_error
+        service = copy.deepcopy(service)
+        service.setdefault("metadata", {}).setdefault("ownerReferences", []).append(
+            _owner_ref_dict(controller_ref)
+        )
+        self.templates.append(service)
+        return service
+
+    def delete_service(self, namespace, name, controller_obj):
+        self.delete_service_names.append(name)
+
+    def patch_service(self, namespace, name, patch):
+        self.patches.append(patch)
+        return patch

@@ -1,0 +1,94 @@
+"""Controller expectations cache.
+
+First-party equivalent of client-go's ControllerExpectations as used by
+the reference's job controller (jobcontroller.go:110-124): before issuing
+pod/service creations the controller records how many it expects, and the
+informer callbacks decrement the counters as the objects are observed.
+A sync is gated until expectations are fulfilled or expired, preventing
+duplicate creations from stale caches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+# client-go's ExpectationsTimeout.
+EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0
+
+
+class _Expectation:
+    __slots__ = ("adds", "dels", "timestamp")
+
+    def __init__(self, adds: int = 0, dels: int = 0):
+        self.adds = adds
+        self.dels = dels
+        self.timestamp = time.monotonic()
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.monotonic() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
+
+
+class ControllerExpectations:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, _Expectation] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(adds=count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(dels=count)
+
+    def raise_expectations(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp:
+                exp.adds += adds
+                exp.dels += dels
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, adds=1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, dels=1)
+
+    def _lower(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp:
+                exp.adds -= adds
+                exp.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        """True when fulfilled, expired, or never set (client-go semantics)."""
+        with self._lock:
+            exp = self._store.get(key)
+        if exp is None:
+            return True
+        if exp.fulfilled():
+            return True
+        return exp.expired()
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def get(self, key: str) -> Optional[_Expectation]:
+        with self._lock:
+            return self._store.get(key)
+
+
+def expectation_pods_key(job_key: str, replica_type: str) -> str:
+    """GenExpectationPodsKey (jobcontroller/util.go)."""
+    return f"{job_key}/{replica_type.lower()}/pods"
+
+
+def expectation_services_key(job_key: str, replica_type: str) -> str:
+    return f"{job_key}/{replica_type.lower()}/services"

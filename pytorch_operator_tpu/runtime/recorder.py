@@ -1,0 +1,77 @@
+"""Kubernetes Event recorder.
+
+Equivalent of the client-go record.EventRecorder wired in the reference at
+jobcontroller.go:160-163 — emits v1.Event objects attached to the involved
+object for every notable transition (ExitedWithCode, SuccessfulCreate...).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class EventRecorder:
+    """Writes Events to an ``events`` resource client."""
+
+    def __init__(self, events_client, component: str = "pytorch-operator"):
+        self._events = events_client
+        self.component = component
+
+    def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
+        if not isinstance(obj, dict):
+            obj = {}
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "unknown")
+        namespace = meta.get("namespace", "default")
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name}.{uuid.uuid4().hex[:10]}",
+                "namespace": namespace,
+            },
+            "involvedObject": {
+                "apiVersion": obj.get("apiVersion", ""),
+                "kind": obj.get("kind", ""),
+                "name": name,
+                "namespace": namespace,
+                "uid": meta.get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "count": 1,
+            "source": {"component": self.component},
+            "firstTimestamp": _now_iso(),
+            "lastTimestamp": _now_iso(),
+        }
+        try:
+            self._events.create(namespace, ev)
+        except Exception:
+            # Event emission must never break reconciliation.
+            pass
+
+    def eventf(self, obj: dict, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+
+class FakeRecorder:
+    """Records events in memory for unit tests."""
+
+    def __init__(self):
+        self.events: List[str] = []
+
+    def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
+        self.events.append(f"{event_type} {reason} {message}")
+
+    def eventf(self, obj: dict, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)

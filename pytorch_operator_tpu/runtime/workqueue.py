@@ -1,0 +1,146 @@
+"""Rate-limited delaying workqueue with client-go semantics.
+
+First-party equivalent of k8s.io/client-go/util/workqueue as used by the
+reference (vendor/.../jobcontroller/jobcontroller.go:110-131): the queue
+guarantees an item is never processed by two workers simultaneously
+(dirty/processing sets), supports delayed re-adds (AddAfter) and
+per-item exponential backoff (AddRateLimited / Forget / NumRequeues).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RateLimiter:
+    """Per-item exponential backoff: base * 2^failures, capped.
+
+    Matches client-go's ItemExponentialFailureRateLimiter defaults
+    (5ms base, 1000s cap).
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base_delay * (2 ** n), self.max_delay)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue:
+    """Deduplicating FIFO queue with processing-exclusion semantics."""
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+        self._lock = threading.Condition()
+        self._queue: List[Any] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutdown = False
+        self._waiting: List[Tuple[float, int, Any]] = []  # (ready_at, seq, item)
+        self._seq = 0
+        self.rate_limiter = rate_limiter or RateLimiter()
+
+    # -- core queue --------------------------------------------------------
+    def add(self, item: Any) -> None:
+        with self._lock:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return
+            self._queue.append(item)
+            self._lock.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Pop the next item. Returns (item, shutdown)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._drain_ready_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._processing.add(item)
+                    self._dirty.discard(item)
+                    return item, False
+                if self._shutdown:
+                    return None, True
+                wait = self._next_wait_locked(deadline)
+                if wait is not None and wait <= 0:
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None, False
+                    continue
+                if not self._lock.wait(timeout=wait):
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None, False
+
+    def _next_wait_locked(self, deadline: Optional[float]) -> Optional[float]:
+        candidates = []
+        if self._waiting:
+            candidates.append(self._waiting[0][0] - time.monotonic())
+        if deadline is not None:
+            candidates.append(deadline - time.monotonic())
+        return min(candidates) if candidates else None
+
+    def _drain_ready_locked(self) -> None:
+        now = time.monotonic()
+        while self._waiting and self._waiting[0][0] <= now:
+            _, _, item = heapq.heappop(self._waiting)
+            # Same dedupe semantics as add().
+            if item in self._dirty:
+                continue
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+
+    def done(self, item: Any) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- delayed / rate-limited adds ---------------------------------------
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._waiting, (time.monotonic() + delay, self._seq, item))
+            self._lock.notify()
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self.rate_limiter.num_requeues(item)

@@ -1,0 +1,450 @@
+"""Tier-2 controller tests: real PyTorchController, fake controls,
+state injected into informer stores, synchronous sync_job.
+
+Mirrors the reference's pkg/controller.v1/pytorch/controller_test.go
+pattern (SURVEY.md §4 tier 2): swap PodControl/ServiceControl for fakes,
+inject desired world state, stub the status writer, call sync, assert
+side effects.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.controller import status as status_machine
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import (
+    FakePodControl,
+    FakeRecorder,
+    FakeServiceControl,
+    JobControllerConfig,
+    gen_general_name,
+)
+
+from testutil import TEST_JOB_NAME, TEST_NAMESPACE, new_job
+
+
+def make_controller(**cfg):
+    cluster = FakeCluster()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(**cfg),
+        recorder=FakeRecorder(),
+        registry=Registry(),
+    )
+    ctl.pod_control = FakePodControl()
+    ctl.service_control = FakeServiceControl()
+    captured = []
+    ctl.update_status_handler = captured.append
+    return ctl, cluster, captured
+
+
+def inject_job(ctl, job):
+    data = job.to_dict()
+    ctl.job_informer.store.add(data)
+    return data
+
+
+def set_pod(ctl, cluster, job, rtype, index, phase, restart_count=0, exit_code=None):
+    """testutil/pod.go:67-95 equivalent: place a pod owned by the job."""
+    rt = rtype.lower()
+    labels = ctl.gen_labels(job.metadata.name)
+    labels[constants.LABEL_REPLICA_TYPE] = rt
+    labels[constants.LABEL_REPLICA_INDEX] = str(index)
+    status = {
+        "phase": phase,
+        "containerStatuses": [
+            {"name": constants.DEFAULT_CONTAINER_NAME, "restartCount": restart_count}
+        ],
+    }
+    if exit_code is not None:
+        status["containerStatuses"][0]["state"] = {"terminated": {"exitCode": exit_code}}
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": gen_general_name(job.metadata.name, rt, index),
+            "namespace": job.metadata.namespace,
+            "labels": labels,
+            "ownerReferences": [
+                {
+                    "apiVersion": constants.API_VERSION,
+                    "kind": constants.KIND,
+                    "name": job.metadata.name,
+                    "uid": job.metadata.uid,
+                    "controller": True,
+                }
+            ],
+        },
+        "spec": {},
+        "status": status,
+    }
+    return cluster.pods.create(job.metadata.namespace, pod)
+
+
+def set_service(ctl, cluster, job, rtype, index):
+    rt = rtype.lower()
+    labels = ctl.gen_labels(job.metadata.name)
+    labels[constants.LABEL_REPLICA_TYPE] = rt
+    labels[constants.LABEL_REPLICA_INDEX] = str(index)
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": gen_general_name(job.metadata.name, rt, index),
+            "namespace": job.metadata.namespace,
+            "labels": labels,
+            "ownerReferences": [
+                {
+                    "apiVersion": constants.API_VERSION,
+                    "kind": constants.KIND,
+                    "name": job.metadata.name,
+                    "uid": job.metadata.uid,
+                    "controller": True,
+                }
+            ],
+        },
+        "spec": {"clusterIP": "None"},
+    }
+    return cluster.services.create(job.metadata.namespace, svc)
+
+
+KEY = f"{TEST_NAMESPACE}/{TEST_JOB_NAME}"
+
+
+# --------------------------------------------------------------------------
+# Creation path (TestNormalPath scenarios)
+# --------------------------------------------------------------------------
+
+
+def test_new_job_creates_all_pods_and_services():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=2)
+    inject_job(ctl, job)
+
+    forget, err = ctl.sync_job(KEY)
+    assert err is None and forget
+
+    names = sorted(t["metadata"]["name"] for t in ctl.pod_control.templates)
+    assert names == [
+        "test-pytorchjob-master-0",
+        "test-pytorchjob-worker-0",
+        "test-pytorchjob-worker-1",
+    ]
+    # TPU deviation: headless service per replica, not master-only.
+    svc_names = sorted(t["metadata"]["name"] for t in ctl.service_control.templates)
+    assert svc_names == names
+    # owner refs attached
+    for t in ctl.pod_control.templates:
+        refs = t["metadata"]["ownerReferences"]
+        assert refs[0]["uid"] == job.metadata.uid and refs[0]["controller"]
+    # status initialized + persisted
+    assert captured, "status should be written"
+    assert set(captured[-1].status.replica_statuses) == {"Master", "Worker"}
+
+
+def test_partial_pods_only_missing_created():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=2)
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Worker", 0, "Running")
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    pod_names = sorted(t["metadata"]["name"] for t in ctl.pod_control.templates)
+    assert pod_names == ["test-pytorchjob-master-0", "test-pytorchjob-worker-1"]
+
+
+def test_master_role_label():
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    by_name = {t["metadata"]["name"]: t for t in ctl.pod_control.templates}
+    master = by_name["test-pytorchjob-master-0"]
+    worker = by_name["test-pytorchjob-worker-0"]
+    assert master["metadata"]["labels"][constants.LABEL_JOB_ROLE] == "master"
+    assert constants.LABEL_JOB_ROLE not in worker["metadata"]["labels"]
+    # worker gets the DNS-wait init container barrier
+    assert worker["spec"]["initContainers"], "worker needs init container"
+    assert "test-pytorchjob-master-0" in str(worker["spec"]["initContainers"][0]["command"])
+    assert not master["spec"].get("initContainers")
+
+
+def test_running_condition_when_master_active():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Running")
+    set_pod(ctl, cluster, job, "Worker", 0, "Running")
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status_machine.has_condition(status, constants.JOB_RUNNING)
+    assert status.replica_statuses["Master"].active == 1
+    assert status.replica_statuses["Worker"].active == 1
+    assert status.start_time is not None
+
+
+def test_master_succeeded_job_succeeds():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Succeeded")
+    set_pod(ctl, cluster, job, "Worker", 0, "Running")
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status_machine.is_succeeded(status)
+    assert status.completion_time is not None
+    assert ctl.jobs_successful_counter.value == 1
+
+
+def test_worker_failure_fails_job():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Running")
+    set_pod(ctl, cluster, job, "Worker", 0, "Failed")
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status_machine.is_failed(status)
+    assert status.replica_statuses["Worker"].failed == 1
+
+
+def test_exit_code_retryable_restarts():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    job.spec.pytorch_replica_specs["Worker"].restart_policy = (
+        constants.RESTART_POLICY_EXIT_CODE
+    )
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Running")
+    set_pod(ctl, cluster, job, "Worker", 0, "Failed", exit_code=137)
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    assert ctl.pod_control.delete_pod_names == ["test-pytorchjob-worker-0"]
+    status = captured[-1].status
+    assert status_machine.has_condition(status, constants.JOB_RESTARTING)
+    assert not status_machine.is_failed(status)
+
+
+def test_exit_code_permanent_fails():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    job.spec.pytorch_replica_specs["Worker"].restart_policy = (
+        constants.RESTART_POLICY_EXIT_CODE
+    )
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Running")
+    set_pod(ctl, cluster, job, "Worker", 0, "Failed", exit_code=1)
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+
+    ctl.sync_job(KEY)
+    assert ctl.pod_control.delete_pod_names == []
+    assert status_machine.is_failed(captured[-1].status)
+
+
+# --------------------------------------------------------------------------
+# Terminal-state handling
+# --------------------------------------------------------------------------
+
+
+def _terminal_job(ctl, cluster, policy):
+    job = new_job(workers=1)
+    job.spec.clean_pod_policy = policy
+    status_machine.update_job_conditions(
+        job.status, constants.JOB_SUCCEEDED, "done", "done"
+    )
+    job.status.completion_time = status_machine.now_iso()
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Succeeded")
+    set_pod(ctl, cluster, job, "Worker", 0, "Running")
+    set_service(ctl, cluster, job, "Master", 0)
+    set_service(ctl, cluster, job, "Worker", 0)
+    return job
+
+
+def test_clean_pod_policy_all():
+    ctl, cluster, _ = make_controller()
+    _terminal_job(ctl, cluster, constants.CLEAN_POD_POLICY_ALL)
+    ctl.sync_job(KEY)
+    assert sorted(ctl.pod_control.delete_pod_names) == [
+        "test-pytorchjob-master-0",
+        "test-pytorchjob-worker-0",
+    ]
+    assert sorted(ctl.service_control.delete_service_names) == [
+        "test-pytorchjob-master-0",
+        "test-pytorchjob-worker-0",
+    ]
+
+
+def test_clean_pod_policy_running_deletes_only_running():
+    ctl, cluster, _ = make_controller()
+    _terminal_job(ctl, cluster, constants.CLEAN_POD_POLICY_RUNNING)
+    ctl.sync_job(KEY)
+    assert ctl.pod_control.delete_pod_names == ["test-pytorchjob-worker-0"]
+
+
+def test_clean_pod_policy_none_keeps_everything():
+    ctl, cluster, _ = make_controller()
+    _terminal_job(ctl, cluster, constants.CLEAN_POD_POLICY_NONE)
+    ctl.sync_job(KEY)
+    assert ctl.pod_control.delete_pod_names == []
+    assert ctl.service_control.delete_service_names == []
+
+
+def test_succeeded_active_counts_folded():
+    ctl, cluster, captured = make_controller()
+    job = _terminal_job(ctl, cluster, constants.CLEAN_POD_POLICY_ALL)
+    job.status.replica_statuses["Worker"] = __import__(
+        "pytorch_operator_tpu.api.v1.types", fromlist=["ReplicaStatus"]
+    ).ReplicaStatus(active=1)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status.replica_statuses["Worker"].active == 0
+    assert status.replica_statuses["Worker"].succeeded == 1
+
+
+def test_ttl_deletes_finished_job():
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=0)
+    job.spec.ttl_seconds_after_finished = 10
+    status_machine.update_job_conditions(
+        job.status, constants.JOB_SUCCEEDED, "done", "done"
+    )
+    job.status.completion_time = "2000-01-01T00:00:00Z"  # long past
+    inject_job(ctl, job)
+    deleted = []
+    ctl.delete_job_handler = lambda j: deleted.append(j.metadata.name)
+    ctl.sync_job(KEY)
+    assert deleted == [TEST_JOB_NAME]
+
+
+# --------------------------------------------------------------------------
+# Backoff / deadline
+# --------------------------------------------------------------------------
+
+
+def test_backoff_limit_by_restart_count():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=1)
+    job.spec.backoff_limit = 2
+    inject_job(ctl, job)
+    set_pod(ctl, cluster, job, "Master", 0, "Running", restart_count=2)
+    set_pod(ctl, cluster, job, "Worker", 0, "Running")
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status_machine.is_failed(status)
+    assert "backoff limit" in status.conditions[-1].message
+
+
+def test_active_deadline_exceeded():
+    ctl, cluster, captured = make_controller()
+    job = new_job(workers=0)
+    job.spec.active_deadline_seconds = 5
+    job.status.start_time = "2000-01-01T00:00:00Z"
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    status = captured[-1].status
+    assert status_machine.is_failed(status)
+    assert "deadline" in status.conditions[-1].message
+
+
+# --------------------------------------------------------------------------
+# Gang scheduling
+# --------------------------------------------------------------------------
+
+
+def test_gang_scheduling_creates_podgroup_and_annotations():
+    ctl, cluster, _ = make_controller(
+        enable_gang_scheduling=True, gang_scheduler_name="volcano"
+    )
+    job = new_job(workers=2)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    pg = cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    assert pg["spec"]["minMember"] == 3  # all-or-nothing TPU slice semantics
+    for t in ctl.pod_control.templates:
+        assert (
+            t["metadata"]["annotations"][constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION]
+            == TEST_JOB_NAME
+        )
+        assert t["spec"]["schedulerName"] == "volcano"
+
+
+# --------------------------------------------------------------------------
+# Admission / deletion bookkeeping
+# --------------------------------------------------------------------------
+
+
+def test_add_job_invalid_spec_marked_failed():
+    ctl, cluster, _ = make_controller()
+    bad = {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": "bad-job", "namespace": TEST_NAMESPACE},
+        "spec": {"pytorchReplicaSpecs": {"Worker": {"replicas": 1, "template": {
+            "spec": {"containers": [{"name": "pytorch", "image": "img"}]}}}}},
+    }
+    cluster.jobs.create(TEST_NAMESPACE, bad)
+    ctl.add_job(cluster.jobs.get(TEST_NAMESPACE, "bad-job"))
+    stored = cluster.jobs.get(TEST_NAMESPACE, "bad-job")
+    conds = stored["status"]["conditions"]
+    assert conds[0]["type"] == constants.JOB_FAILED
+
+
+def test_add_job_sets_created_condition():
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    cluster.jobs.create(TEST_NAMESPACE, job.to_dict())
+    ctl.add_job(cluster.jobs.get(TEST_NAMESPACE, TEST_JOB_NAME))
+    stored = cluster.jobs.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    assert stored["status"]["conditions"][0]["type"] == constants.JOB_CREATED
+    assert ctl.jobs_created_counter.value == 1
+    assert len(ctl.work_queue) == 1
+
+
+def test_sync_deleted_job_counts_and_clears():
+    ctl, cluster, _ = make_controller()
+    forget, err = ctl.sync_job(KEY)
+    assert forget and err is None
+    assert ctl.jobs_deleted_counter.value == 1
+
+
+def test_expectations_gate_resync():
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    data = inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    n = len(ctl.pod_control.templates)
+    assert n == 2
+    # Unsatisfied expectations (creations not yet observed): no-op sync.
+    ctl.sync_job(KEY)
+    assert len(ctl.pod_control.templates) == n
+
+    # Observe the creations via the informer callbacks: next sync proceeds.
+    for t in ctl.pod_control.templates:
+        t["metadata"]["namespace"] = TEST_NAMESPACE
+        ctl.add_pod(t)
+    for t in ctl.service_control.templates:
+        t["metadata"]["namespace"] = TEST_NAMESPACE
+        ctl.add_service(t)
+    ctl.sync_job(KEY)
+    # no pods exist in the cluster store → it recreates (fake controls don't
+    # persist), proving the gate opened
+    assert len(ctl.pod_control.templates) > n

@@ -1,0 +1,147 @@
+"""End-to-end simulation: full controller loop against the fake cluster
+with a fake kubelet advancing pod phases.
+
+Mirrors the reference's e2e drivers:
+  * test/e2e/v1/default/defaults.go:80-248 — create a 1 Master + 3 Worker
+    job, wait for Succeeded, verify every expected pod existed, delete the
+    job, verify GC removed the dependents;
+  * test/e2e/v1/cleanpolicy/cleanpolicy_all.go — same with
+    CleanPodPolicy=All.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.controller import status as sm
+from pytorch_operator_tpu.k8s.errors import NotFoundError
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import JobControllerConfig
+
+from testutil import new_job
+
+TIMEOUT = 15.0
+
+
+def wait_for(predicate, timeout=TIMEOUT, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def job_condition(cluster, ns, name, cond_type):
+    try:
+        job = cluster.jobs.get(ns, name)
+    except NotFoundError:
+        return False
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c["type"] == cond_type and c["status"] == "True":
+            return True
+    return False
+
+
+@pytest.fixture
+def world():
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(cluster, config=JobControllerConfig(), registry=registry)
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    yield cluster, ctl, registry, kubelet
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+
+
+def test_defaults_e2e(world):
+    """defaults.go flow: submit, run to Succeeded, check pods, GC."""
+    cluster, ctl, registry, _ = world
+    job = new_job(workers=3, name="e2e-job")
+    cluster.jobs.create("default", job.to_dict())
+
+    assert wait_for(
+        lambda: job_condition(cluster, "default", "e2e-job", constants.JOB_SUCCEEDED)
+    ), "job did not reach Succeeded"
+
+    # All expected pods and per-replica services were created.
+    expected = {
+        "e2e-job-master-0",
+        "e2e-job-worker-0",
+        "e2e-job-worker-1",
+        "e2e-job-worker-2",
+    }
+    pods = {p["metadata"]["name"] for p in cluster.pods.list()}
+    services = {s["metadata"]["name"] for s in cluster.services.list()}
+    assert expected <= pods
+    assert expected <= services
+
+    # CleanPodPolicy defaults to None: nothing deleted on success.
+    stored = cluster.jobs.get("default", "e2e-job")
+    statuses = stored["status"]["replicaStatuses"]
+    assert statuses["Master"]["succeeded"] == 1
+    assert statuses["Worker"]["succeeded"] == 3
+
+    # Events were emitted through the real recorder.
+    reasons = {e["reason"] for e in cluster.events.list()}
+    assert "SuccessfulCreatePod" in reasons
+    assert "PyTorchJobSucceeded" in reasons
+
+    # Delete the job: owner-ref GC removes pods and services.
+    cluster.jobs.delete("default", "e2e-job")
+    assert wait_for(lambda: not cluster.pods.list() and not cluster.services.list())
+
+
+def test_clean_pod_policy_all_e2e(world):
+    """cleanpolicy_all.go flow: pods and services removed on completion."""
+    cluster, ctl, registry, _ = world
+    job = new_job(workers=1, name="clean-job")
+    job.spec.clean_pod_policy = constants.CLEAN_POD_POLICY_ALL
+    cluster.jobs.create("default", job.to_dict())
+
+    assert wait_for(
+        lambda: job_condition(cluster, "default", "clean-job", constants.JOB_SUCCEEDED)
+    )
+    assert wait_for(lambda: not cluster.pods.list() and not cluster.services.list()), (
+        "CleanPodPolicy=All should delete pods and services"
+    )
+    # The job object itself remains.
+    assert cluster.jobs.get("default", "clean-job")
+
+
+def test_failing_worker_fails_job(world):
+    cluster, ctl, registry, kubelet = world
+    # Worker fails; master keeps running (None) so the failure is observed
+    # before the job could complete.
+    kubelet.decide = lambda pod: (
+        ("Failed", 1) if "worker" in pod["metadata"]["name"] else None
+    )
+    job = new_job(workers=1, name="fail-job")
+    job.spec.pytorch_replica_specs["Worker"].restart_policy = constants.RESTART_POLICY_NEVER
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(
+        lambda: job_condition(cluster, "default", "fail-job", constants.JOB_FAILED)
+    ), "job should fail when a worker fails"
+
+
+def test_metrics_counters(world):
+    cluster, ctl, registry, _ = world
+    job = new_job(workers=0, name="metrics-job")
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(
+        lambda: job_condition(cluster, "default", "metrics-job", constants.JOB_SUCCEEDED)
+    )
+    text = registry.expose()
+    assert "pytorch_operator_jobs_created_total 1" in text
+    assert "pytorch_operator_jobs_successful_total 1" in text

@@ -1,0 +1,238 @@
+"""Runtime machinery tests: workqueue, expectations, informer, adoption.
+
+Mirrors the reference's pod_test.go:34 / service_test.go:33 expectation
+bookkeeping tests plus client-go workqueue semantics.
+"""
+
+import threading
+import time
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import (
+    ControllerExpectations,
+    FakeRecorder,
+    Informer,
+    JobControllerConfig,
+    WorkQueue,
+    expectation_pods_key,
+)
+
+from testutil import TEST_NAMESPACE, new_job
+
+
+# --------------------------------------------------------------------------
+# workqueue
+# --------------------------------------------------------------------------
+
+
+def test_workqueue_dedup_while_queued():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+
+
+def test_workqueue_no_concurrent_processing():
+    """An item re-added while processing is deferred until done()."""
+    q = WorkQueue()
+    q.add("a")
+    item, _ = q.get(timeout=0.1)
+    assert item == "a"
+    q.add("a")  # while processing
+    got, _ = q.get(timeout=0.05)
+    assert got is None  # not handed out again yet
+    q.done("a")
+    item2, _ = q.get(timeout=0.1)
+    assert item2 == "a"
+
+
+def test_workqueue_add_after():
+    q = WorkQueue()
+    q.add_after("x", 0.05)
+    got, _ = q.get(timeout=0.01)
+    assert got is None
+    got, _ = q.get(timeout=0.5)
+    assert got == "x"
+
+
+def test_workqueue_rate_limit_backoff_and_forget():
+    q = WorkQueue()
+    assert q.num_requeues("k") == 0
+    q.add_rate_limited("k")
+    assert q.num_requeues("k") == 1
+    q.add_rate_limited("k")
+    assert q.num_requeues("k") == 2
+    q.forget("k")
+    assert q.num_requeues("k") == 0
+
+
+def test_workqueue_shutdown_unblocks():
+    q = WorkQueue()
+    results = []
+
+    def worker():
+        results.append(q.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shutdown()
+    t.join(timeout=1)
+    assert results == [(None, True)]
+
+
+# --------------------------------------------------------------------------
+# expectations
+# --------------------------------------------------------------------------
+
+
+def test_expectations_lifecycle():
+    e = ControllerExpectations()
+    key = expectation_pods_key("ns/job", "Worker")
+    assert e.satisfied(key)  # never set
+    e.expect_creations(key, 2)
+    assert not e.satisfied(key)
+    e.creation_observed(key)
+    assert not e.satisfied(key)
+    e.creation_observed(key)
+    assert e.satisfied(key)
+    e.expect_deletions(key, 1)
+    assert not e.satisfied(key)
+    e.deletion_observed(key)
+    assert e.satisfied(key)
+
+
+# --------------------------------------------------------------------------
+# informer
+# --------------------------------------------------------------------------
+
+
+def test_informer_sync_and_watch():
+    c = FakeCluster()
+    c.pods.create("ns", {"metadata": {"name": "pre", "namespace": "ns"}})
+    inf = Informer(c.pods)
+    adds, updates, deletes = [], [], []
+    inf.add_event_handler(
+        on_add=lambda o: adds.append(o["metadata"]["name"]),
+        on_update=lambda old, new: updates.append(
+            (old["metadata"]["resourceVersion"], new["metadata"]["resourceVersion"])
+        ),
+        on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+    )
+    inf.start()
+    assert inf.has_synced()
+    assert adds == ["pre"]
+
+    c.pods.create("ns", {"metadata": {"name": "live", "namespace": "ns"}})
+    c.pods.set_status("ns", "live", {"phase": "Running"})
+    c.pods.delete("ns", "live")
+    assert adds == ["pre", "live"]
+    assert len(updates) == 1 and updates[0][0] != updates[0][1]
+    assert deletes == ["live"]
+    assert inf.store.get_by_key("ns/pre") is not None
+    assert inf.store.get_by_key("ns/live") is None
+
+
+# --------------------------------------------------------------------------
+# adoption / orphaning (jobcontroller/pod.go:165-241)
+# --------------------------------------------------------------------------
+
+
+def _controller():
+    cluster = FakeCluster()
+    ctl = PyTorchController(
+        cluster, config=JobControllerConfig(), recorder=FakeRecorder(), registry=Registry()
+    )
+    return ctl, cluster
+
+
+def test_orphan_adoption():
+    ctl, cluster = _controller()
+    job = new_job(workers=1)
+    job_dict = job.to_dict()
+    labels = ctl.gen_labels(job.metadata.name)
+    labels[constants.LABEL_REPLICA_TYPE] = "worker"
+    labels[constants.LABEL_REPLICA_INDEX] = "0"
+    cluster.pods.create(
+        TEST_NAMESPACE,
+        {"metadata": {"name": "orphan", "namespace": TEST_NAMESPACE, "labels": labels}},
+    )
+    pods = ctl.get_pods_for_job(job_dict)
+    assert len(pods) == 1
+    refs = pods[0]["metadata"]["ownerReferences"]
+    assert refs[0]["uid"] == job.metadata.uid and refs[0]["controller"]
+    # persisted in the cluster too
+    stored = cluster.pods.get(TEST_NAMESPACE, "orphan")
+    assert stored["metadata"]["ownerReferences"][0]["uid"] == job.metadata.uid
+
+
+def test_foreign_controlled_pod_not_claimed():
+    ctl, cluster = _controller()
+    job = new_job(workers=1)
+    labels = ctl.gen_labels(job.metadata.name)
+    cluster.pods.create(
+        TEST_NAMESPACE,
+        {
+            "metadata": {
+                "name": "foreign",
+                "namespace": TEST_NAMESPACE,
+                "labels": labels,
+                "ownerReferences": [{"uid": "other-uid", "controller": True}],
+            }
+        },
+    )
+    assert ctl.get_pods_for_job(job.to_dict()) == []
+
+
+def test_label_mismatch_not_listed():
+    """An owned pod whose labels no longer match the job selector is out of
+    scope: the selector-list never returns it (reference pod.go:165-178)."""
+    ctl, cluster = _controller()
+    job = new_job(workers=1)
+    cluster.pods.create(
+        TEST_NAMESPACE,
+        {
+            "metadata": {
+                "name": "mismatched",
+                "namespace": TEST_NAMESPACE,
+                "labels": {"unrelated": "yes"},
+                "ownerReferences": [
+                    {"uid": job.metadata.uid, "controller": True, "kind": constants.KIND}
+                ],
+            }
+        },
+    )
+    assert ctl.get_pods_for_job(job.to_dict()) == []
+
+
+def test_informer_callbacks_enqueue_owner():
+    """add_pod resolves the controller ref through the job cache, observes
+    the expectation and enqueues (pod.go:20-67)."""
+    ctl, cluster = _controller()
+    job = new_job(workers=1)
+    ctl.job_informer.store.add(job.to_dict())
+    key = job.key
+    ctl.expectations.expect_creations(expectation_pods_key(key, "worker"), 1)
+    pod = {
+        "metadata": {
+            "name": "p",
+            "namespace": TEST_NAMESPACE,
+            "labels": {constants.LABEL_REPLICA_TYPE: "worker"},
+            "ownerReferences": [
+                {
+                    "kind": constants.KIND,
+                    "name": job.metadata.name,
+                    "uid": job.metadata.uid,
+                    "controller": True,
+                }
+            ],
+        }
+    }
+    ctl.add_pod(pod)
+    assert ctl.expectations.satisfied(expectation_pods_key(key, "worker"))
+    item, _ = ctl.work_queue.get(timeout=0.1)
+    assert item == key

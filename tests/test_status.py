@@ -1,0 +1,88 @@
+"""Status condition-machine tests (reference status_test.go:35,88)."""
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.api.v1.types import JobStatus
+from pytorch_operator_tpu.controller import status as sm
+from pytorch_operator_tpu.controller.train_util import is_retryable_exit_code
+
+
+def cond_types(status):
+    return [(c.type, c.status) for c in status.conditions]
+
+
+def test_created_then_running():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_CREATED, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    assert cond_types(s) == [("Created", "True"), ("Running", "True")]
+
+
+def test_running_replaces_restarting():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_RESTARTING, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    assert cond_types(s) == [("Running", "True")]
+
+
+def test_restarting_replaces_running():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RESTARTING, "r", "m")
+    assert cond_types(s) == [("Restarting", "True")]
+
+
+def test_succeeded_falsifies_running():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_CREATED, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_SUCCEEDED, "r", "m")
+    assert ("Running", "False") in cond_types(s)
+    assert ("Succeeded", "True") in cond_types(s)
+
+
+def test_terminal_status_frozen():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_FAILED, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    assert cond_types(s) == [("Failed", "True")]
+    assert sm.is_failed(s) and not sm.is_succeeded(s)
+
+
+def test_same_condition_not_duplicated():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m")
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r", "m2")
+    assert len(s.conditions) == 1
+
+
+def test_transition_time_preserved_on_same_status():
+    s = JobStatus()
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r1", "m")
+    first_transition = s.conditions[0].last_transition_time
+    sm.update_job_conditions(s, constants.JOB_RUNNING, "r2", "m")
+    assert s.conditions[0].last_transition_time == first_transition
+    assert s.conditions[0].reason == "r2"
+
+
+def test_replica_status_tally():
+    s = JobStatus()
+    sm.initialize_replica_statuses(s, "Worker")
+    for phase in ("Running", "Running", "Succeeded", "Failed", "Pending"):
+        sm.update_replica_statuses(s, "Worker", {"status": {"phase": phase}})
+    rs = s.replica_statuses["Worker"]
+    assert (rs.active, rs.succeeded, rs.failed) == (2, 1, 1)
+
+
+# Exit-code table (reference train_util.go:18-53 + TPU extension).
+def test_exit_codes():
+    for code in (1, 2, 126, 127, 128, 139):
+        assert not is_retryable_exit_code(code)
+    for code in (130, 137, 143, 138):
+        assert is_retryable_exit_code(code)
+    # TPU-aware additions
+    assert is_retryable_exit_code(134)
+    assert is_retryable_exit_code(135)
+    assert not is_retryable_exit_code(134, tpu_aware=False)
+    # unknown codes are permanent
+    assert not is_retryable_exit_code(3)
+    assert not is_retryable_exit_code(255)

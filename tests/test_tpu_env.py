@@ -1,0 +1,103 @@
+"""TPU cluster-spec injection tests.
+
+Mirrors the reference's pod_test.go:100 TestClusterSpec (exact env-map
+assertions) translated to the TPU/PJRT environment.
+"""
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants, set_defaults
+from pytorch_operator_tpu.controller.tpu_env import (
+    InvalidClusterSpecError,
+    build_cluster_env,
+    get_port_from_job,
+    replica_hostnames,
+    set_cluster_spec,
+)
+
+from testutil import new_job
+
+
+def env_map(env_list):
+    return {e["name"]: e["value"] for e in env_list}
+
+
+def test_worker_env_exact():
+    """Worker index 1 of a 2-worker job: rank 2, world 3 — the same
+    scenario the reference asserts (RANK=2, WORLD_SIZE=3)."""
+    job = new_job(workers=2)
+    set_defaults(job)
+    env = env_map(build_cluster_env(job, "Worker", "1"))
+    assert env == {
+        "MASTER_PORT": "23456",
+        "MASTER_ADDR": "test-pytorchjob-master-0",
+        "WORLD_SIZE": "3",
+        "RANK": "2",
+        "PYTHONUNBUFFERED": "1",
+        "PJRT_DEVICE": "TPU",
+        "TPU_WORKER_ID": "2",
+        "TPU_WORKER_HOSTNAMES": (
+            "test-pytorchjob-master-0,test-pytorchjob-worker-0,test-pytorchjob-worker-1"
+        ),
+        "XRT_TPU_CONFIG": (
+            "tpu_worker;2;test-pytorchjob-master-0:8470,"
+            "test-pytorchjob-worker-0:8470,test-pytorchjob-worker-1:8470"
+        ),
+        "COORDINATOR_ADDRESS": "test-pytorchjob-master-0:23456",
+        "NUM_PROCESSES": "3",
+        "PROCESS_ID": "2",
+    }
+
+
+def test_master_env():
+    job = new_job(workers=2)
+    set_defaults(job)
+    env = env_map(build_cluster_env(job, "Master", "0"))
+    assert env["MASTER_ADDR"] == "localhost"  # reference pod.go:246-249 parity
+    assert env["RANK"] == "0"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["WORLD_SIZE"] == "3"
+
+
+def test_hostnames_ordered_by_rank():
+    job = new_job(workers=3)
+    set_defaults(job)
+    assert replica_hostnames(job) == [
+        "test-pytorchjob-master-0",
+        "test-pytorchjob-worker-0",
+        "test-pytorchjob-worker-1",
+        "test-pytorchjob-worker-2",
+    ]
+
+
+def test_master_nonzero_index_rejected():
+    job = new_job(workers=1)
+    set_defaults(job)
+    with pytest.raises(InvalidClusterSpecError, match="single master"):
+        build_cluster_env(job, "Master", "1")
+
+
+def test_missing_port_rejected():
+    job = new_job(workers=0)
+    job.spec.pytorch_replica_specs["Master"].template.spec.containers[0].ports = []
+    with pytest.raises(InvalidClusterSpecError, match="port"):
+        get_port_from_job(job, "Master")
+
+
+def test_set_cluster_spec_appends_to_all_containers():
+    job = new_job(workers=1)
+    set_defaults(job)
+    pod = {
+        "spec": {
+            "containers": [
+                {"name": "pytorch", "env": [{"name": "KEEP", "value": "1"}]},
+                {"name": "sidecar"},
+            ]
+        }
+    }
+    set_cluster_spec(pod, job, "0", "Worker")
+    for c in pod["spec"]["containers"]:
+        names = [e["name"] for e in c["env"]]
+        assert "TPU_WORKER_ID" in names
+        assert "MASTER_ADDR" in names
+    assert pod["spec"]["containers"][0]["env"][0] == {"name": "KEEP", "value": "1"}
