@@ -52,7 +52,9 @@ def get_port_from_job(job: PyTorchJob, rtype: str) -> int:
 
 
 def total_replicas(job: PyTorchJob) -> int:
-    return sum(int(s.replicas or 0) for s in job.spec.pytorch_replica_specs.values())
+    from .job import get_total_replicas  # deferred: job imports this module's peers
+
+    return get_total_replicas(job)
 
 
 def replica_hostnames(job: PyTorchJob) -> List[str]:

@@ -1,0 +1,12 @@
+"""TPU-native model zoo (the data plane of the framework).
+
+The reference ships its data plane as example workloads only
+(reference: examples/mnist/mnist.py, plus the ResNet-50 and Llama-2-7B
+FSDP configs named in BASELINE.json).  Here the models are first-class
+library code: pure-JAX pytrees + forward functions with explicit
+PartitionSpec trees so they drop straight onto a `jax.sharding.Mesh`.
+"""
+
+from pytorch_operator_tpu.models import llama, mnist_cnn
+
+__all__ = ["llama", "mnist_cnn"]

@@ -1,0 +1,227 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Flagship model of the framework (the reference's headline scale config is
+Llama-2-7B FSDP on v5p-128 — BASELINE.json config 5; the reference itself
+contains no model code beyond examples, see SURVEY.md §0).
+
+Design choices for the MXU/XLA:
+  * layers are *stacked* (leading n_layers axis) and iterated with
+    `lax.scan` — one compiled layer body regardless of depth;
+  * all matmuls are einsums over bf16 weights, f32 accumulation left to
+    XLA's default dot algorithm;
+  * optional `jax.checkpoint` rematerialisation per layer (cfg.remat)
+    trades FLOPs for HBM;
+  * GQA (n_kv_heads <= n_heads), RoPE, RMSNorm, SwiGLU — standard Llama;
+  * every parameter has a PartitionSpec in `param_specs()` so the same
+    code runs single-chip or sharded dp/fsdp/tp without edits.
+
+Sharding convention (axes from parallel.mesh):
+  dim (model width)   -> fsdp    (ZeRO-3 style weight sharding)
+  heads / ffn hidden  -> tp      (tensor parallelism)
+  batch               -> dp+fsdp
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_operator_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_TP
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def __post_init__(self):
+        if self.dim % self.n_heads:
+            raise ValueError("dim must be divisible by n_heads")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must be divisible by n_kv_heads")
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    """The BASELINE.json config-5 model (Llama-2-7B)."""
+    return LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=32, ffn_dim=11008, max_seq_len=4096, **kw,
+    )
+
+
+def tiny(**kw) -> LlamaConfig:
+    """Small config for tests / compile checks / virtual-device dryruns."""
+    defaults = dict(
+        vocab_size=512, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        ffn_dim=256, max_seq_len=256, dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialise a parameter pytree; layer params stacked on axis 0."""
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    k_embed, k_layers = jax.random.split(key)
+
+    def dense(key, shape, fan_in):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    def layer_stack(key, shape, fan_in):
+        # one independent draw per layer, stacked
+        keys = jax.random.split(key, cfg.n_layers)
+        return jnp.stack([dense(k, shape, fan_in) for k in keys])
+
+    ks = jax.random.split(k_layers, 7)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": layer_stack(ks[0], (D, nh * hd), D),
+            "wk": layer_stack(ks[1], (D, nkv * hd), D),
+            "wv": layer_stack(ks[2], (D, nkv * hd), D),
+            "wo": layer_stack(ks[3], (nh * hd, D), nh * hd),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "w_gate": layer_stack(ks[4], (D, F), D),
+            "w_up": layer_stack(ks[5], (D, F), D),
+            "w_down": layer_stack(ks[6], (F, D), F),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec tree matching init_params output.
+
+    2-D weights shard (dim -> fsdp, heads/ffn -> tp); stacked layer
+    weights carry a leading unsharded layer axis; norms replicate.
+    """
+    del cfg
+    fsdp, tp = AXIS_FSDP, AXIS_TP
+    return {
+        "embed": P(tp, fsdp),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fsdp, tp),
+            "wk": P(None, fsdp, tp),
+            "wv": P(None, fsdp, tp),
+            "wo": P(None, tp, fsdp),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fsdp, tp),
+            "w_up": P(None, fsdp, tp),
+            "w_down": P(None, tp, fsdp),
+        },
+        "final_norm": P(None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_table(cfg: LlamaConfig, seq_len: int) -> tuple[jax.Array, jax.Array]:
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)  # each (T, head_dim//2)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    # x: (B, T, H, Dh); rotate pairs (x1, x2) in the last dim.
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: LlamaConfig):
+    """Dense causal attention (B,T,H,Dh)x(B,T,KV,Dh) with GQA broadcast."""
+    B, T, H, Dh = q.shape
+    groups = cfg.n_heads // cfg.n_kv_heads
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+    scores = scores * (Dh ** -0.5)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _layer(h, lp, cfg: LlamaConfig, cos, sin):
+    B, T, D = h.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("btd,dk->btk", x, lp["wq"]).reshape(B, T, nh, hd)
+    k = jnp.einsum("btd,dk->btk", x, lp["wk"]).reshape(B, T, nkv, hd)
+    v = jnp.einsum("btd,dk->btk", x, lp["wv"]).reshape(B, T, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attention(q, k, v, cfg).reshape(B, T, nh * hd)
+    h = h + jnp.einsum("btk,kd->btd", attn, lp["wo"])
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(jnp.einsum("btd,df->btf", x, lp["w_gate"]))
+    up = jnp.einsum("btd,df->btf", x, lp["w_up"])
+    h = h + jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
+    return h
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
+    T = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_table(cfg, T)
+
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(h, lp):
+        return body(h, lp), None
+
+    h, _ = lax.scan(scan_fn, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    # weight-tied output head
+    return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
+
+
+def activation_spec() -> P:
+    """Spec for (B, T, D) activations under the (dp, fsdp, tp) mesh."""
+    return P((AXIS_DP, AXIS_FSDP), None, AXIS_TP)

@@ -1,0 +1,80 @@
+"""MNIST CNN matching the reference example's architecture.
+
+Reference: examples/mnist/mnist.py:25-42 — conv(1->10,k5) + maxpool +
+relu, conv(10->20,k5) + dropout2d + maxpool + relu, fc(320->50),
+fc(50->10), log_softmax.  Re-expressed NHWC + lax.conv for the MXU; the
+DDP wrapper (mnist.py:135-138) is replaced by sharding the batch over
+the mesh's dp axis and letting XLA all-reduce gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+def init_params(key: jax.Array, dtype=jnp.float32) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def conv_init(key, shape):  # HWIO
+        fan_in = shape[0] * shape[1] * shape[2]
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+
+    def fc_init(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * (shape[0] ** -0.5)
+
+    p = {
+        "conv1": {"w": conv_init(k1, (5, 5, 1, 10)), "b": jnp.zeros((10,))},
+        "conv2": {"w": conv_init(k2, (5, 5, 10, 20)), "b": jnp.zeros((20,))},
+        "fc1": {"w": fc_init(k3, (320, 50)), "b": jnp.zeros((50,))},
+        "fc2": {"w": fc_init(k4, (50, 10)), "b": jnp.zeros((10,))},
+    }
+    return jax.tree.map(lambda x: x.astype(dtype), p)
+
+
+def _conv(x, p):
+    y = lax.conv_general_dilated(
+        x, p["w"], window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["b"]
+
+
+def _maxpool2(x):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def forward(
+    params: Params,
+    images: jax.Array,
+    *,
+    train: bool = False,
+    dropout_rng: jax.Array | None = None,
+) -> jax.Array:
+    """images (B, 28, 28, 1) -> log-probs (B, 10)."""
+    x = jax.nn.relu(_maxpool2(_conv(images, params["conv1"])))
+    x = _conv(x, params["conv2"])
+    if train and dropout_rng is not None:
+        # dropout2d: drop whole channels, p=0.5 (mnist.py:31 Dropout2d)
+        keep = jax.random.bernoulli(dropout_rng, 0.5, (x.shape[0], 1, 1, x.shape[3]))
+        x = jnp.where(keep, x / 0.5, 0.0)
+    x = jax.nn.relu(_maxpool2(x))
+    x = x.reshape(x.shape[0], -1)  # (B, 320)
+    x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    x = x @ params["fc2"]["w"] + params["fc2"]["b"]
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def nll_loss(log_probs: jax.Array, labels: jax.Array) -> jax.Array:
+    return -jnp.mean(jnp.take_along_axis(log_probs, labels[:, None], axis=1))
+
+
+def accuracy(log_probs: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.argmax(log_probs, axis=-1) == labels)

@@ -1,0 +1,42 @@
+"""TPU parallelism layer: device meshes, sharding rules, train steps.
+
+The reference operator is topology-agnostic above the rank/world-size
+level — it only injects MASTER_ADDR/RANK/WORLD_SIZE for c10d rendezvous
+(reference: pkg/controller.v1/pytorch/pod.go:234-281).  The TPU-native
+data plane expresses parallelism directly as a `jax.sharding.Mesh` with
+named axes (dp/fsdp/tp/sp); XLA GSPMD inserts the collectives that the
+reference delegates to gloo/nccl/mpi (reference:
+examples/mnist/mnist.py:99-138).
+"""
+
+from pytorch_operator_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    batch_spec,
+    factor_devices,
+    make_mesh,
+    make_sp_mesh,
+)
+from pytorch_operator_tpu.parallel.ring_attention import ring_attention
+from pytorch_operator_tpu.parallel.train import (
+    cross_entropy_loss,
+    make_train_step,
+    sharded_init,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_SP",
+    "AXIS_TP",
+    "batch_spec",
+    "factor_devices",
+    "make_mesh",
+    "make_sp_mesh",
+    "ring_attention",
+    "cross_entropy_loss",
+    "make_train_step",
+    "sharded_init",
+]

@@ -1,0 +1,80 @@
+"""Device-mesh construction for dp/fsdp/tp/sp parallelism.
+
+Axis meanings:
+  dp    pure data parallelism (gradients all-reduced over this axis)
+  fsdp  data parallelism with parameters sharded along it (ZeRO-3 style;
+        XLA all-gathers weights per layer, reduce-scatters grads)
+  tp    tensor parallelism (attention heads / MLP hidden sharded)
+  sp    sequence/context parallelism (ring attention over this axis)
+
+The reference has only dp (DistributedDataParallel,
+reference: examples/mnist/mnist.py:135-138); tp/sp/fsdp are what a TPU
+mesh gives for free via GSPMD — see SURVEY.md §2.4.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+
+
+def factor_devices(n: int, tp_max: int = 8) -> tuple[int, int, int]:
+    """Factor ``n`` devices into (dp, fsdp, tp), preferring tp then fsdp.
+
+    tp rides the fastest interconnect (intra-chip / ICI neighbours), so it
+    gets small power-of-two factors first; the remainder splits between
+    fsdp and dp.
+    """
+    if n < 1:
+        raise ValueError(f"need at least one device, got {n}")
+    tp = 1
+    while tp * 2 <= tp_max and n % (tp * 2) == 0:
+        tp *= 2
+    rest = n // tp
+    fsdp = 1
+    while fsdp * 2 <= rest and rest % (fsdp * 2) == 0 and fsdp < 4:
+        fsdp *= 2
+    dp = rest // fsdp
+    return dp, fsdp, tp
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    tp: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a (dp, fsdp, tp) mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * fsdp * tp
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh ({dp},{fsdp},{tp}) needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, tp)
+    return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_TP))
+
+
+def make_sp_mesh(dp: int = 1, sp: int = 1, *, devices=None) -> Mesh:
+    """Build a (dp, sp) mesh for ring-attention sequence parallelism."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * sp
+    if len(devices) < n:
+        raise ValueError(f"mesh ({dp},{sp}) needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, sp)
+    return Mesh(arr, (AXIS_DP, AXIS_SP))
+
+
+def batch_spec() -> P:
+    """Sharding for a (batch, ...) array: batch split over dp and fsdp."""
+    return P((AXIS_DP, AXIS_FSDP))

@@ -1,0 +1,108 @@
+"""Ring attention: causal self-attention over a sequence-sharded mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY.md §2.4: "TP /
+PP / SP / EP / CP / ring-attention — ABSENT").  Each device holds a
+contiguous chunk of the sequence; K/V chunks rotate around the ring via
+`lax.ppermute` while a flash-style online softmax (running max + running
+denominator) accumulates exact attention output.  Communication is
+neighbour-to-neighbour, so on TPU it rides ICI links and overlaps with
+the per-chunk matmuls.
+
+Layout: q/k/v are the *local* (B, T_local, H, Dh) chunks inside a
+`jax.shard_map` over ``axis_name``; global position of local row i on
+ring rank r is r*T_local + i.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attn(q, k, v, q_off, k_off, scale, causal):
+    """Scores + masked row-stats for one (q-chunk, kv-chunk) pair.
+
+    Returns (o_part, row_max, row_sum) with shapes
+    (B,H,Tq,Dh), (B,H,Tq), (B,H,Tq) — all f32.
+    """
+    s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(Tq)
+        kpos = k_off + jnp.arange(Tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B,H,Tq)
+    p = jnp.exp(s - m[..., None])
+    # rows that are fully masked: zero them out rather than exp(-inf - -inf)
+    p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhts,bshd->bhtd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def _ring_body(q, k, v, axis_name, causal, scale):
+    """Runs on each device inside shard_map."""
+    B, Tl, H, Dh = q.shape
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # fresh constants are "unvarying" under shard_map's manual-axes
+    # tracking; mark them device-varying so the fori_loop carry types match
+    o0 = lax.pvary(jnp.zeros((B, H, Tl, Dh), jnp.float32), axis_name)
+    m0 = lax.pvary(jnp.full((B, H, Tl), NEG_INF, jnp.float32), axis_name)
+    l0 = lax.pvary(jnp.zeros((B, H, Tl), jnp.float32), axis_name)
+
+    def body(s, carry):
+        o, m, l, kc, vc = carry
+        src = (rank - s) % n  # which global chunk kc currently holds
+        o_p, m_p, l_p = _chunk_attn(
+            q, kc, vc, rank * Tl, src * Tl, scale, causal
+        )
+        m_new = jnp.maximum(m, m_p)
+        a = jnp.exp(m - m_new)
+        b = jnp.exp(m_p - m_new)
+        o = o * a[..., None] + o_p * b[..., None]
+        l = l * a + l_p * b
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return o, m_new, l, kc, vc
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # (B,Tl,H,Dh)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact causal attention with sequence sharded over ``axis_name``.
+
+    q/k/v: global-view (B, T, H, Dh) arrays; T must divide evenly by the
+    mesh's ``axis_name`` size.  Returns (B, T, H, Dh).
+    """
+    Dh = q.shape[-1]
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(
+            _ring_body, axis_name=axis_name, causal=causal, scale=Dh ** -0.5
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

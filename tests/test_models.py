@@ -1,0 +1,128 @@
+"""Data-plane model tests (CPU, tiny shapes).
+
+Mirrors the reference's pure-function test tier (SURVEY.md §4 tier 1)
+for the model zoo the reference only ships as examples.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_operator_tpu.models import llama, mnist_cnn
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return llama.tiny()
+
+
+class TestLlama:
+    def test_forward_shape(self, tiny_cfg):
+        params = llama.init_params(jax.random.key(0), tiny_cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = llama.forward(params, tokens, tiny_cfg)
+        assert logits.shape == (2, 16, tiny_cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_causality(self, tiny_cfg):
+        """Changing a future token must not change past logits."""
+        params = llama.init_params(jax.random.key(0), tiny_cfg)
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, 7].set(3)
+        l1 = llama.forward(params, t1, tiny_cfg)
+        l2 = llama.forward(params, t2, tiny_cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :7]), np.asarray(l2[0, :7]), rtol=1e-5
+        )
+        assert not np.allclose(np.asarray(l1[0, 7]), np.asarray(l2[0, 7]))
+
+    def test_gqa_matches_mha_shapes(self):
+        cfg = llama.tiny(n_heads=8, n_kv_heads=2)
+        params = llama.init_params(jax.random.key(0), cfg)
+        logits = llama.forward(params, jnp.zeros((1, 4), jnp.int32), cfg)
+        assert logits.shape == (1, 4, cfg.vocab_size)
+
+    def test_remat_matches(self, tiny_cfg):
+        cfg_r = llama.tiny(remat=True)
+        params = llama.init_params(jax.random.key(0), tiny_cfg)
+        tokens = jnp.arange(16, dtype=jnp.int32)[None]
+        a = llama.forward(params, tokens, tiny_cfg)
+        b = llama.forward(params, tokens, cfg_r)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+    def test_param_specs_cover_params(self, tiny_cfg):
+        params = llama.init_params(jax.random.key(0), tiny_cfg)
+        specs = llama.param_specs(tiny_cfg)
+        p_struct = jax.tree.structure(params)
+        s_struct = jax.tree.structure(specs, is_leaf=lambda x: x is None or hasattr(x, "index"))
+        assert p_struct.num_leaves == s_struct.num_leaves
+
+    def test_loss_decreases_single_device(self, tiny_cfg):
+        opt = optax.adam(1e-2)
+        params = llama.init_params(jax.random.key(0), tiny_cfg)
+        opt_state = opt.init(params)
+        batch = jax.random.randint(jax.random.key(1), (4, 17), 0, tiny_cfg.vocab_size)
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            def loss_fn(p):
+                logits = llama.forward(p, batch[:, :-1], tiny_cfg)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, batch[:, 1:, None], axis=-1)
+                )
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestMnistCNN:
+    def test_forward_shape(self):
+        params = mnist_cnn.init_params(jax.random.key(0))
+        x = jnp.zeros((4, 28, 28, 1))
+        out = mnist_cnn.forward(params, x)
+        assert out.shape == (4, 10)
+        # log_softmax rows sum to ~1 in prob space
+        np.testing.assert_allclose(
+            np.exp(np.asarray(out)).sum(-1), np.ones(4), rtol=1e-5
+        )
+
+    def test_overfits_tiny_batch(self):
+        params = mnist_cnn.init_params(jax.random.key(0))
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+        x = jax.random.normal(jax.random.key(1), (16, 28, 28, 1))
+        y = jax.random.randint(jax.random.key(2), (16,), 0, 10)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                return mnist_cnn.nll_loss(mnist_cnn.forward(p, x), y)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for _ in range(60):
+            params, opt_state, loss = step(params, opt_state)
+        acc = float(mnist_cnn.accuracy(mnist_cnn.forward(params, x), y))
+        assert acc > 0.9, (acc, float(loss))
+
+    def test_dropout_only_in_train(self):
+        params = mnist_cnn.init_params(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 28, 28, 1))
+        a = mnist_cnn.forward(params, x)
+        b = mnist_cnn.forward(params, x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = mnist_cnn.forward(
+            params, x, train=True, dropout_rng=jax.random.key(3)
+        )
+        assert not np.allclose(np.asarray(a), np.asarray(c))
