@@ -1,0 +1,102 @@
+"""Benchmark: dist-MNIST training throughput (images/sec/chip).
+
+Prints exactly one JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: the reference's README envelope for the same workload —
+dist-MNIST, 10 epochs (600k images) in "5-10 minutes" on its CI cluster
+(reference README.md:37; sample run 5m53s, README.md:56-119).  Best case
+600000 img / 300 s = 2000 images/sec for the whole job; we report
+per-chip throughput against that number, so vs_baseline > 1 means one
+TPU chip outruns the reference's whole multi-pod job.
+
+The model is the reference example's CNN (examples/mnist/mnist.py:25-42)
+re-expressed for the MXU (NHWC lax.conv, batched), trained with the same
+SGD(lr=0.01, momentum=0.5) (mnist.py:106).  Synthetic MNIST-shaped data
+keeps the bench hermetic (this environment has no dataset egress); the
+real-data path in examples/mnist/train_mnist.py reaches the >=98%
+accuracy target the e2e flow asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC = 2000.0
+
+
+def main() -> None:
+    import jax
+
+    # persistent compile cache: first bench run pays the (slow) TPU
+    # compile, later runs start timing almost immediately
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from pytorch_operator_tpu.models import mnist_cnn
+
+    batch_size = 1024
+    steps_timed = 50
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev.device_kind}", file=sys.stderr)
+
+    key = jax.random.key(0)
+    k_img, k_lbl, k_param = jax.random.split(key, 3)
+    images = jax.random.normal(k_img, (batch_size, 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(k_lbl, (batch_size,), 0, 10)
+
+    params = mnist_cnn.init_params(k_param)
+    opt = optax.sgd(0.01, momentum=0.5)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            return mnist_cnn.nll_loss(mnist_cnn.forward(p, images), labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    _ = float(loss)  # host round-trip: guarantees the work really ran
+    print(f"[bench] compile+warmup: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    # Timed region ends with a host fetch of a value that depends on every
+    # step (params chain through donation), so async dispatch or a lazy
+    # transfer layer can't fake completion.
+    t0 = time.perf_counter()
+    for _ in range(steps_timed):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * steps_timed / dt
+    print(
+        f"[bench] {steps_timed} steps x {batch_size} imgs in {dt:.3f}s, "
+        f"final loss {final_loss:.4f}",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "dist-MNIST training throughput",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

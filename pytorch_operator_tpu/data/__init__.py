@@ -1,0 +1,5 @@
+"""Input pipelines for the example workloads."""
+
+from pytorch_operator_tpu.data import mnist
+
+__all__ = ["mnist"]

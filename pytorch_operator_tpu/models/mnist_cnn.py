@@ -1,10 +1,10 @@
 """MNIST CNN matching the reference example's architecture.
 
-Reference: examples/mnist/mnist.py:25-42 — conv(1->10,k5) + maxpool +
-relu, conv(10->20,k5) + dropout2d + maxpool + relu, fc(320->50),
-fc(50->10), log_softmax.  Re-expressed NHWC + lax.conv for the MXU; the
-DDP wrapper (mnist.py:135-138) is replaced by sharding the batch over
-the mesh's dp axis and letting XLA all-reduce gradients.
+Reference: examples/mnist/mnist.py:17-33 — conv(1->20,k5) + maxpool +
+relu, conv(20->50,k5) + maxpool + relu, fc(800->500) + relu, fc(500->10),
+log_softmax.  Re-expressed NHWC + lax.conv for the MXU; the DDP wrapper
+(mnist.py:135-138) is replaced by sharding the batch over the mesh's dp
+axis and letting XLA all-reduce gradients.
 """
 
 from __future__ import annotations
@@ -29,10 +29,10 @@ def init_params(key: jax.Array, dtype=jnp.float32) -> Params:
         return jax.random.normal(key, shape, jnp.float32) * (shape[0] ** -0.5)
 
     p = {
-        "conv1": {"w": conv_init(k1, (5, 5, 1, 10)), "b": jnp.zeros((10,))},
-        "conv2": {"w": conv_init(k2, (5, 5, 10, 20)), "b": jnp.zeros((20,))},
-        "fc1": {"w": fc_init(k3, (320, 50)), "b": jnp.zeros((50,))},
-        "fc2": {"w": fc_init(k4, (50, 10)), "b": jnp.zeros((10,))},
+        "conv1": {"w": conv_init(k1, (5, 5, 1, 20)), "b": jnp.zeros((20,))},
+        "conv2": {"w": conv_init(k2, (5, 5, 20, 50)), "b": jnp.zeros((50,))},
+        "fc1": {"w": fc_init(k3, (800, 500)), "b": jnp.zeros((500,))},
+        "fc2": {"w": fc_init(k4, (500, 10)), "b": jnp.zeros((10,))},
     }
     return jax.tree.map(lambda x: x.astype(dtype), p)
 
@@ -51,22 +51,11 @@ def _maxpool2(x):
     )
 
 
-def forward(
-    params: Params,
-    images: jax.Array,
-    *,
-    train: bool = False,
-    dropout_rng: jax.Array | None = None,
-) -> jax.Array:
+def forward(params: Params, images: jax.Array) -> jax.Array:
     """images (B, 28, 28, 1) -> log-probs (B, 10)."""
-    x = jax.nn.relu(_maxpool2(_conv(images, params["conv1"])))
-    x = _conv(x, params["conv2"])
-    if train and dropout_rng is not None:
-        # dropout2d: drop whole channels, p=0.5 (mnist.py:31 Dropout2d)
-        keep = jax.random.bernoulli(dropout_rng, 0.5, (x.shape[0], 1, 1, x.shape[3]))
-        x = jnp.where(keep, x / 0.5, 0.0)
-    x = jax.nn.relu(_maxpool2(x))
-    x = x.reshape(x.shape[0], -1)  # (B, 320)
+    x = _maxpool2(jax.nn.relu(_conv(images, params["conv1"])))
+    x = _maxpool2(jax.nn.relu(_conv(x, params["conv2"])))
+    x = x.reshape(x.shape[0], -1)  # (B, 800)
     x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
     x = x @ params["fc2"]["w"] + params["fc2"]["b"]
     return jax.nn.log_softmax(x, axis=-1)

@@ -1,0 +1,15 @@
+"""Shared utilities (the reference's pkg/util + env plumbing).
+
+Reference: pkg/util/util.go:33-74 (Pformat, RandString).
+"""
+
+from pytorch_operator_tpu.utils.util import pformat, rand_string
+from pytorch_operator_tpu.utils.jaxenv import apply_platform_env
+from pytorch_operator_tpu.utils.distributed import maybe_init_distributed
+
+__all__ = [
+    "pformat",
+    "rand_string",
+    "apply_platform_env",
+    "maybe_init_distributed",
+]

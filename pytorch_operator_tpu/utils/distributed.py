@@ -1,0 +1,35 @@
+"""Multi-host initialisation from operator-injected rendezvous env.
+
+The controller injects MASTER_ADDR / MASTER_PORT / WORLD_SIZE plus
+TPU_WORKER_ID / TPU_WORKER_HOSTNAMES (see controller/tpu_env.py, the
+TPU-native replacement for the reference's setClusterSpec,
+pod.go:234-281).  Workloads call :func:`maybe_init_distributed` once at
+startup; single-process when WORLD_SIZE is absent or 1, matching the
+reference example's should_distribute() convention
+(examples/mnist/mnist.py:14,99-104).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_init_distributed() -> tuple[int, int]:
+    """Initialise `jax.distributed` when WORLD_SIZE > 1.
+
+    Returns (process_id, num_processes).
+    """
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    if world_size <= 1:
+        return 0, 1
+    import jax
+
+    worker_id = int(os.environ.get("TPU_WORKER_ID", os.environ.get("RANK", "0")))
+    coord = os.environ.get("MASTER_ADDR", "localhost")
+    port = os.environ.get("MASTER_PORT", "23456")
+    jax.distributed.initialize(
+        coordinator_address=f"{coord}:{port}",
+        num_processes=world_size,
+        process_id=worker_id,
+    )
+    return worker_id, world_size

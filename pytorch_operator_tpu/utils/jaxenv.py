@@ -1,0 +1,34 @@
+"""JAX platform-selection plumbing for example workloads.
+
+Some images register a PJRT plugin from sitecustomize and pin
+``jax_platforms`` at import time, which silently overrides the
+JAX_PLATFORMS environment variable a job manifest sets (e.g. the CPU
+variant of the mnist example).  Calling :func:`apply_platform_env` right
+after ``import jax`` makes the env var authoritative again.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import sys
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception as e:
+        print(f"[jaxenv] could not set jax_platforms={plat!r}: {e}",
+              file=sys.stderr)
+    backend = jax.default_backend()
+    want = plat.split(",")[0]
+    if backend != want:
+        raise RuntimeError(
+            f"JAX_PLATFORMS={plat!r} requested but backend initialised as "
+            f"{backend!r} — the job would silently run on the wrong platform"
+        )

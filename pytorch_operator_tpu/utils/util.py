@@ -1,0 +1,21 @@
+"""Small helpers mirroring the reference's pkg/util/util.go."""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+
+
+def pformat(obj) -> str:
+    """Pretty JSON for logging (reference: pkg/util/util.go:33-49)."""
+    try:
+        return json.dumps(obj, indent=2, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def rand_string(n: int, seed: int | None = None) -> str:
+    """DNS-safe random lowercase string (reference: pkg/util/util.go:62-74)."""
+    rng = random.Random(seed)
+    return "".join(rng.choices(string.ascii_lowercase + string.digits, k=n))

@@ -116,13 +116,26 @@ class TestMnistCNN:
         acc = float(mnist_cnn.accuracy(mnist_cnn.forward(params, x), y))
         assert acc > 0.9, (acc, float(loss))
 
-    def test_dropout_only_in_train(self):
+    def test_learns_synthetic_digits(self):
+        """End-to-end: CNN learns the synthetic fallback dataset."""
+        from pytorch_operator_tpu.data import mnist as mnist_data
+
+        xtr, ytr = mnist_data.load(None, split="train", synthetic_size=2048)
+        xte, yte = mnist_data.load(None, split="test", synthetic_size=512)
         params = mnist_cnn.init_params(jax.random.key(0))
-        x = jax.random.normal(jax.random.key(1), (2, 28, 28, 1))
-        a = mnist_cnn.forward(params, x)
-        b = mnist_cnn.forward(params, x)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-        c = mnist_cnn.forward(
-            params, x, train=True, dropout_rng=jax.random.key(3)
-        )
-        assert not np.allclose(np.asarray(a), np.asarray(c))
+        opt = optax.sgd(0.05, momentum=0.9)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            def loss_fn(p):
+                return mnist_cnn.nll_loss(mnist_cnn.forward(p, x), y)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for epoch in range(5):
+            for x, y in mnist_data.batches(xtr, ytr, 128, seed=epoch):
+                params, opt_state, _ = step(params, opt_state, x, y)
+        acc = float(mnist_cnn.accuracy(mnist_cnn.forward(params, xte), yte))
+        assert acc > 0.98, acc
