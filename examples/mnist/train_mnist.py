@@ -72,7 +72,10 @@ def main() -> int:
     repl = NamedSharding(mesh, P())
 
     if args.batch_size % len(devices):
-        args.batch_size += len(devices) - args.batch_size % len(devices)
+        rounded = args.batch_size + len(devices) - args.batch_size % len(devices)
+        print(f"[worker {pid}] --batch-size {args.batch_size} is not divisible "
+              f"by {len(devices)} devices; using {rounded}", flush=True)
+        args.batch_size = rounded
 
     xtr, ytr = mnist_data.load(args.data_dir, split="train",
                                synthetic_size=args.synthetic_size,

@@ -1,0 +1,7 @@
+"""`python -m pytorch_operator_tpu` runs the operator process."""
+
+import sys
+
+from pytorch_operator_tpu.cmd.operator import main
+
+sys.exit(main())

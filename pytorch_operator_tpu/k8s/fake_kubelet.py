@@ -27,11 +27,18 @@ class FakeKubelet:
         # decide(pod) -> ("Succeeded"|"Failed", exit_code), or None to
         # leave the pod Running forever.
         decide: Optional[Callable[[dict], Optional[tuple]]] = None,
+        # logs(pod, phase, exit_code) -> str stored on the pod, readable
+        # via the SDK's get_logs (fake.kubelet/logs annotation)
+        logs: Optional[Callable[[dict, str, int], str]] = None,
     ):
         self.cluster = cluster
         self.run_delay = run_delay
         self.complete_delay = complete_delay
         self.decide = decide or (lambda pod: ("Succeeded", 0))
+        self.logs = logs or (
+            lambda pod, phase, code:
+            f"{pod['metadata']['name']}: {phase} exit={code}\naccuracy=0.9876\n"
+        )
         self._timers: Dict[str, threading.Timer] = {}
         self._lock = threading.Lock()
         self._stopped = False
@@ -83,6 +90,11 @@ class FakeKubelet:
         }
         try:
             self.cluster.pods.set_status(ns, name, status)
+            log_text = self.logs(pod, phase, exit_code)
+            if log_text:
+                self.cluster.pods.patch(ns, name, {
+                    "metadata": {"annotations": {"fake.kubelet/logs": log_text}}
+                })
         except NotFoundError:
             pass
 

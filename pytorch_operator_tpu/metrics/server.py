@@ -1,0 +1,40 @@
+"""HTTP /metrics endpoint (the reference's startMonitoring,
+cmd/pytorch-operator.v1/main.go:31-40, promhttp on --monitoring-port)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pytorch_operator_tpu.metrics.prometheus import Registry
+
+
+def start_metrics_server(registry: Registry, port: int,
+                         host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Serve text-format metrics on /metrics in a daemon thread.
+
+    Returns the server (use .shutdown() to stop); picks a free port when
+    ``port`` is 0 (server.server_address[1] tells which).
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/metrics"):
+                body = registry.expose().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

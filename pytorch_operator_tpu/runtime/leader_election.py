@@ -1,0 +1,146 @@
+"""Lease-based leader election.
+
+The reference elects a leader with a deprecated Endpoints lock named
+``pytorch-operator`` (15s lease / 5s renew / 3s retry,
+cmd/pytorch-operator.v1/app/server.go:55-57,146-171); this is the same
+state machine over the modern Lease object.  Only the elected replica
+runs the controller workers; the ``pytorch_operator_is_leader`` gauge
+(server.go:58-61) flips with leadership.
+
+Works against any store with get/create/update (the fake cluster's
+``resource("leases")`` or a real REST client).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from pytorch_operator_tpu.k8s.errors import AlreadyExistsError, ConflictError, NotFoundError
+
+LEASE_DURATION = 15.0
+RENEW_INTERVAL = 5.0
+RETRY_INTERVAL = 3.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lease_store,
+        identity: str,
+        *,
+        name: str = "pytorch-operator",
+        namespace: str = "default",
+        lease_duration: float = LEASE_DURATION,
+        renew_interval: float = RENEW_INTERVAL,
+        retry_interval: float = RETRY_INTERVAL,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.lease_store = lease_store
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.retry_interval = retry_interval
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._active_stop = self._stop
+        self._thread: Optional[threading.Thread] = None
+        # client-go semantics: expiry is judged against the *local*
+        # observation time of the last lease change, never by comparing
+        # another process's timestamps with our clock (clocks across nodes
+        # are not comparable; monotonic clocks especially so).
+        self._observed_record: Optional[tuple] = None
+        self._observed_at: float = 0.0
+
+    # -- lease record helpers ---------------------------------------------
+
+    def _lease_obj(self) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "renewTime": self.clock(),
+            },
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS round: returns True if we hold the lease afterwards."""
+        now = self.clock()
+        try:
+            lease = self.lease_store.get(self.namespace, self.name)
+        except NotFoundError:
+            try:
+                self.lease_store.create(self.namespace, self._lease_obj())
+                return True
+            except AlreadyExistsError:
+                return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
+        record = (holder, spec.get("renewTime"))
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+        if holder != self.identity and now - self._observed_at < duration:
+            return False  # holder's record changed within leaseDuration (locally observed)
+        lease["spec"] = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "renewTime": now,
+        }
+        try:
+            updated = self.lease_store.update(lease)
+            spec = updated.get("spec") or {}
+            self._observed_record = (spec.get("holderIdentity"), spec.get("renewTime"))
+            self._observed_at = now
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    # -- run loop ----------------------------------------------------------
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Block until stopped; invokes callbacks on leadership changes."""
+        stop = stop_event or self._stop
+        self._active_stop = stop
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                if not self.is_leader:
+                    self.is_leader = True
+                    if self.on_started_leading:
+                        self.on_started_leading()
+                interval = self.renew_interval
+            else:
+                if self.is_leader:
+                    self.is_leader = False
+                    if self.on_stopped_leading:
+                        self.on_stopped_leading()
+                interval = self.retry_interval
+            stop.wait(interval)
+        if self.is_leader:
+            self.is_leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def start(self, stop_event: Optional[threading.Event] = None) -> threading.Thread:
+        self._thread = threading.Thread(
+            target=self.run, args=(stop_event,), daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._active_stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,30 @@
+"""Python client SDK for PyTorchJob (TPU-native).
+
+Mirrors the reference SDK surface
+(reference: sdk/python/kubeflow/pytorchjob/api/py_torch_job_client.py:29-393)
+without swagger codegen: the models are the same dataclasses the
+controller uses (single source of truth instead of the reference's
+parallel generated V1* model tree), and the client works against either
+a real Kubernetes API (when the `kubernetes` package is available) or
+the in-memory :class:`~pytorch_operator_tpu.k8s.fake.FakeCluster`.
+"""
+
+from pytorch_operator_tpu.api.v1.types import (
+    JobCondition as V1JobCondition,
+    JobStatus as V1JobStatus,
+    PyTorchJob as V1PyTorchJob,
+    PyTorchJobSpec as V1PyTorchJobSpec,
+    ReplicaSpec as V1ReplicaSpec,
+    ReplicaStatus as V1ReplicaStatus,
+)
+from pytorch_operator_tpu.sdk.client import PyTorchJobClient
+
+__all__ = [
+    "PyTorchJobClient",
+    "V1PyTorchJob",
+    "V1PyTorchJobSpec",
+    "V1ReplicaSpec",
+    "V1JobStatus",
+    "V1JobCondition",
+    "V1ReplicaStatus",
+]

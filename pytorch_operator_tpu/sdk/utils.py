@@ -1,0 +1,50 @@
+"""SDK helpers (reference: sdk/python/kubeflow/pytorchjob/utils/utils.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from pytorch_operator_tpu.api.v1 import constants
+
+_SA_DIR = "/var/run/secrets/kubernetes.io"
+
+
+def is_running_in_k8s() -> bool:
+    return os.path.isdir(_SA_DIR)
+
+
+def get_current_k8s_namespace() -> str:
+    with open(os.path.join(_SA_DIR, "serviceaccount", "namespace")) as f:
+        return f.readline().strip()
+
+
+def get_default_target_namespace() -> str:
+    if not is_running_in_k8s():
+        return "default"
+    return get_current_k8s_namespace()
+
+
+def get_labels(
+    name: str,
+    master: bool = False,
+    replica_type: Optional[str] = None,
+    replica_index: Optional[str] = None,
+) -> Dict[str, str]:
+    """Label selector for a job's pods (reference: utils.py:40-65)."""
+    labels = {
+        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+        constants.LABEL_CONTROLLER_NAME: constants.CONTROLLER_NAME,
+        constants.LABEL_PYTORCH_JOB_NAME: name,
+    }
+    if master:
+        labels[constants.LABEL_JOB_ROLE] = "master"
+    if replica_type:
+        labels[constants.LABEL_REPLICA_TYPE] = replica_type.lower()
+    if replica_index is not None:
+        labels[constants.LABEL_REPLICA_INDEX] = str(replica_index)
+    return labels
+
+
+def to_selector(labels: Dict[str, str]) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels.items())

@@ -1,0 +1,33 @@
+"""Table-printing watch over a PyTorchJob until it terminates.
+
+Reference: sdk/python/kubeflow/pytorchjob/api/py_torch_job_watch.py:29-60
+(tabulated NAME/STATE/TIME stream that stops on Succeeded/Failed).  The
+fake backend has no server-side watch stream for jobs exposed through
+the SDK, so this polls — same observable behavior, same output shape.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def watch(client, name: str, namespace: str, timeout_seconds: int = 600,
+          polling_interval: float = 2.0) -> None:
+    fmt = "{:<30.30} {:<20.20} {:<30.30}"
+    print(fmt.format("NAME", "STATE", "TIME"), flush=True)
+    deadline = time.monotonic() + timeout_seconds
+    last = None
+    while time.monotonic() < deadline:
+        job = client.get(name, namespace)
+        conditions = ((job.get("status") or {}).get("conditions")) or []
+        if conditions:
+            cond = conditions[-1]
+            row = (cond.get("type", ""), cond.get("lastTransitionTime", ""))
+            if row != last:
+                print(fmt.format(name, row[0], row[1]), flush=True)
+                last = row
+            if row[0] in ("Succeeded", "Failed"):
+                return
+        time.sleep(polling_interval)
+    raise RuntimeError(
+        f"timeout watching PyTorchJob {namespace}/{name}")

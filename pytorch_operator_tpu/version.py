@@ -1,0 +1,21 @@
+"""Version info (reference: version/version.go:21-40)."""
+
+from __future__ import annotations
+
+VERSION = "1.0.0"
+
+
+def git_sha() -> str:
+    """Best-effort short SHA; call sites pay the subprocess only when they
+    actually print it (--version), not at import/operator-start time."""
+    try:
+        import os
+        import subprocess
+
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=2,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"

@@ -1,0 +1,142 @@
+"""Operator process tests: flags, leader election, metrics endpoint.
+
+Covers the reference's cmd/ layer (options.go flag surface, server.go
+leader election + is_leader gauge, main.go /metrics endpoint).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.cmd.operator import build_parser, run
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime.leader_election import LeaderElector
+
+from testutil import new_job
+
+
+class TestFlags:
+    def test_defaults_match_reference(self):
+        args = build_parser().parse_args([])
+        assert args.namespace == ""
+        assert args.threadiness == 1
+        assert args.json_log_format is True
+        assert args.enable_gang_scheduling is False
+        assert args.gang_scheduler_name == "volcano"
+        assert args.monitoring_port == 8443
+        assert args.init_container_image == "alpine:3.10"
+        assert args.qps == 5.0
+        assert args.burst == 10
+
+    def test_resyc_period_alias(self):
+        # the reference flag is misspelled --resyc-period (options.go:24);
+        # both spellings must parse
+        args = build_parser().parse_args(["--resyc-period", "1h"])
+        assert args.resync_period == "1h"
+        args = build_parser().parse_args(["--resync-period", "2h"])
+        assert args.resync_period == "2h"
+
+
+class TestLeaderElection:
+    def test_single_elector_acquires(self):
+        cluster = FakeCluster()
+        el = LeaderElector(cluster.resource("leases"), "a",
+                           lease_duration=1.0, renew_interval=0.05,
+                           retry_interval=0.05)
+        assert el.try_acquire_or_renew() is True
+        assert el.try_acquire_or_renew() is True  # renew
+
+    def test_second_elector_blocked_until_expiry(self):
+        cluster = FakeCluster()
+        store = cluster.resource("leases")
+        now = [100.0]
+        clock = lambda: now[0]
+        a = LeaderElector(store, "a", lease_duration=10, clock=clock)
+        b = LeaderElector(store, "b", lease_duration=10, clock=clock)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        now[0] += 5
+        assert b.try_acquire_or_renew() is False  # lease still live
+        now[0] += 6  # past leaseDuration since last renew
+        assert b.try_acquire_or_renew() is True  # takeover
+        assert a.try_acquire_or_renew() is False  # a lost it
+
+    def test_callbacks_fire(self):
+        cluster = FakeCluster()
+        events = []
+        el = LeaderElector(
+            cluster.resource("leases"), "a",
+            lease_duration=0.5, renew_interval=0.02, retry_interval=0.02,
+            on_started_leading=lambda: events.append("started"),
+            on_stopped_leading=lambda: events.append("stopped"))
+        stop = threading.Event()
+        t = el.start(stop)
+        deadline = time.monotonic() + 5
+        while "started" not in events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "started" in events
+        stop.set()
+        t.join(timeout=5)
+        assert "stopped" in events
+
+
+class TestMetricsServer:
+    def test_scrape(self):
+        registry = Registry()
+        registry.counter("test_total", "help text").inc(3)
+        server = start_metrics_server(registry, 0, host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "test_total 3" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+        finally:
+            server.shutdown()
+
+
+class TestOperatorRun:
+    def test_fake_cluster_end_to_end(self, tmp_path):
+        seed = tmp_path / "job.json"
+        seed.write_text(json.dumps(new_job(workers=1, name="op-job").to_dict()))
+        args = build_parser().parse_args([
+            "--fake-cluster",
+            "--fake-cluster-seed-job", str(seed),
+            "--monitoring-port", "0",
+            "--threadiness", "2",
+        ])
+        cluster = FakeCluster()
+        stop = threading.Event()
+        t = threading.Thread(target=run, args=(args, stop, cluster), daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 15
+            done = False
+            while time.monotonic() < deadline and not done:
+                try:
+                    job = cluster.jobs.get("default", "op-job")
+                except Exception:
+                    time.sleep(0.05)
+                    continue
+                conds = (job.get("status") or {}).get("conditions") or []
+                done = any(c["type"] == "Succeeded" and c["status"] == "True"
+                           for c in conds)
+                time.sleep(0.05)
+            assert done, "seeded job did not reach Succeeded under the CLI"
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()
+
+    def test_no_backend_errors(self):
+        args = build_parser().parse_args(["--monitoring-port", "0"])
+        assert run(args, threading.Event()) == 1

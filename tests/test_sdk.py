@@ -1,0 +1,131 @@
+"""SDK client tests against the simulated cluster.
+
+Mirrors the reference's SDK e2e flow
+(sdk/python/test/test_e2e.py:33-81: create -> wait_for_job -> assert
+succeeded -> get logs -> delete) with the fake cluster + controller +
+kubelet standing in for GKE.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s.errors import NotFoundError
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.sdk import PyTorchJobClient
+from pytorch_operator_tpu.sdk import utils as sdk_utils
+
+from testutil import new_job
+
+
+@pytest.fixture
+def world():
+    cluster = FakeCluster()
+    ctl = PyTorchController(
+        cluster, config=JobControllerConfig(), registry=Registry())
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    yield cluster
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+
+
+@pytest.fixture
+def client(world):
+    return PyTorchJobClient(cluster=world)
+
+
+class TestSdkLifecycle:
+    def test_create_wait_logs_delete(self, world, client):
+        job = new_job(workers=1, name="sdk-job")
+        created = client.create(job.to_dict())
+        assert created["metadata"]["name"] == "sdk-job"
+
+        finished = client.wait_for_job(
+            "sdk-job", timeout_seconds=15, polling_interval=0.05)
+        assert client.is_job_succeeded("sdk-job")
+        assert finished["status"]["replicaStatuses"]["Master"]["succeeded"] == 1
+
+        # master-only by default, like the reference get_logs
+        logs = client.get_logs("sdk-job")
+        assert list(logs) == ["sdk-job-master-0"]
+        assert "accuracy=" in logs["sdk-job-master-0"]
+
+        all_pods = client.get_pod_names("sdk-job")
+        assert set(all_pods) == {"sdk-job-master-0", "sdk-job-worker-0"}
+        workers = client.get_pod_names("sdk-job", replica_type="worker")
+        assert workers == ["sdk-job-worker-0"]
+
+        client.delete("sdk-job")
+        with pytest.raises(NotFoundError):
+            client.get("sdk-job")
+
+    def test_create_dataclass_job(self, client):
+        job = new_job(workers=0, name="dc-job")
+        client.create(job)  # dataclass, not dict
+        got = client.get("dc-job")
+        assert got["kind"] == constants.KIND
+
+    def test_get_list(self, client):
+        client.create(new_job(workers=0, name="a").to_dict())
+        client.create(new_job(workers=0, name="b").to_dict())
+        items = client.get()["items"]
+        assert {j["metadata"]["name"] for j in items} >= {"a", "b"}
+
+    def test_get_job_status_progression(self, client):
+        client.create(new_job(workers=0, name="st-job").to_dict())
+        client.wait_for_job("st-job", timeout_seconds=15, polling_interval=0.05)
+        assert client.get_job_status("st-job") == constants.JOB_SUCCEEDED
+        assert not client.is_job_running("st-job")
+
+    def test_wait_timeout_raises(self, world):
+        # no kubelet progress for this job: decide() leaves pods running
+        client = PyTorchJobClient(cluster=world)
+        job = new_job(workers=0, name="stuck-job")
+        # fresh cluster object w/o kubelet interference is complex; instead
+        # wait on a nonexistent condition with a tiny timeout
+        client.create(job.to_dict())
+        with pytest.raises(RuntimeError, match="timeout"):
+            client.wait_for_condition(
+                "stuck-job", ["NeverHappens"],
+                timeout_seconds=0.2, polling_interval=0.05)
+
+    def test_patch(self, client):
+        client.create(new_job(workers=1, name="p-job").to_dict())
+        client.patch("p-job", {"metadata": {"labels": {"team": "ml"}}})
+        assert client.get("p-job")["metadata"]["labels"]["team"] == "ml"
+
+
+class TestSdkUtils:
+    def test_labels_master(self):
+        labels = sdk_utils.get_labels("j", master=True)
+        assert labels[constants.LABEL_JOB_ROLE] == "master"
+        assert labels[constants.LABEL_PYTORCH_JOB_NAME] == "j"
+
+    def test_selector_string(self):
+        s = sdk_utils.to_selector({"a": "1", "b": "2"})
+        assert s == "a=1,b=2"
+
+    def test_default_namespace(self):
+        assert sdk_utils.get_default_target_namespace() == "default"
+
+
+def test_watch_table_output(world, capsys):
+    client = PyTorchJobClient(cluster=world)
+    client.create(new_job(workers=0, name="w-job").to_dict())
+    client.wait_for_job("w-job", namespace="default", timeout_seconds=15,
+                        polling_interval=0.05)
+    client.get("w-job", watch=True, timeout_seconds=5)
+    out = capsys.readouterr().out
+    assert "NAME" in out and "STATE" in out
+    assert "w-job" in out and "Succeeded" in out
