@@ -1,0 +1,65 @@
+/* C API for the native job-controller runtime core.
+ *
+ * Native equivalent of the runtime the reference gets from its compiled
+ * Go binary (client-go workqueue + controller expectations,
+ * vendor/.../jobcontroller/jobcontroller.go:110-131). Items/keys are
+ * NUL-terminated UTF-8 strings. All functions are thread-safe; wq_get
+ * blocks without holding the Python GIL (ctypes releases it), which is
+ * the point of the native queue: sync workers contend in C++, not in
+ * the interpreter.
+ */
+
+#ifndef TPU_OPERATOR_H_
+#define TPU_OPERATOR_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- rate-limited delaying workqueue ---------------------------------- */
+
+/* base_delay/max_delay: per-item exponential backoff bounds (seconds),
+ * client-go ItemExponentialFailureRateLimiter defaults are 0.005/1000. */
+void* wq_new(double base_delay, double max_delay);
+void wq_free(void* q);
+
+void wq_add(void* q, const char* item);
+void wq_add_after(void* q, const char* item, double delay_seconds);
+void wq_add_rate_limited(void* q, const char* item);
+
+/* Pop the next item into buf (capacity buflen, NUL-terminated).
+ * timeout_seconds < 0 means block forever.
+ * Returns 1: item popped; 0: timed out; -1: queue shut down. */
+int wq_get(void* q, double timeout_seconds, char* buf, int buflen);
+
+void wq_done(void* q, const char* item);
+void wq_forget(void* q, const char* item);
+int wq_num_requeues(void* q, const char* item);
+int wq_len(void* q);
+void wq_shutdown(void* q);
+
+/* ---- controller expectations cache ------------------------------------ */
+
+/* ttl_seconds: expectation expiry (client-go ExpectationsTimeout = 300). */
+void* exp_new(double ttl_seconds);
+void exp_free(void* e);
+
+void exp_expect_creations(void* e, const char* key, int count);
+void exp_expect_deletions(void* e, const char* key, int count);
+void exp_raise(void* e, const char* key, int adds, int dels);
+void exp_creation_observed(void* e, const char* key);
+void exp_deletion_observed(void* e, const char* key);
+
+/* 1 when fulfilled, expired, or never set (client-go semantics). */
+int exp_satisfied(void* e, const char* key);
+void exp_delete(void* e, const char* key);
+
+/* Returns 1 and fills adds/dels/age_seconds when the key exists, else 0. */
+int exp_get(void* e, const char* key, int* adds, int* dels,
+            double* age_seconds);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPU_OPERATOR_H_ */

@@ -1,0 +1,118 @@
+// Controller expectations cache (client-go ControllerExpectations).
+//
+// The sync gate that prevents duplicate pod/service creations from stale
+// informer caches (reference: jobcontroller.go:110-124): record expected
+// creations/deletions before issuing them, decrement as watch events
+// arrive, gate syncs until fulfilled or expired.
+
+#include "tpu_operator.h"
+
+#include <chrono>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Expectation {
+  int adds = 0;
+  int dels = 0;
+  Clock::time_point timestamp;
+};
+
+class Expectations {
+ public:
+  explicit Expectations(double ttl_seconds) : ttl_(ttl_seconds) {}
+
+  void Set(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> lk(mu_);
+    store_[key] = Expectation{adds, dels, Clock::now()};
+  }
+
+  void Raise(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it != store_.end()) {
+      it->second.adds += adds;
+      it->second.dels += dels;
+    }
+  }
+
+  void Lower(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it != store_.end()) {
+      it->second.adds -= adds;
+      it->second.dels -= dels;
+    }
+  }
+
+  int Satisfied(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it == store_.end()) return 1;
+    const Expectation& e = it->second;
+    if (e.adds <= 0 && e.dels <= 0) return 1;
+    const double age =
+        std::chrono::duration<double>(Clock::now() - e.timestamp).count();
+    return age > ttl_ ? 1 : 0;
+  }
+
+  void Delete(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    store_.erase(key);
+  }
+
+  int Get(const std::string& key, int* adds, int* dels, double* age_seconds) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it == store_.end()) return 0;
+    *adds = it->second.adds;
+    *dels = it->second.dels;
+    *age_seconds =
+        std::chrono::duration<double>(Clock::now() - it->second.timestamp)
+            .count();
+    return 1;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, Expectation> store_;
+  double ttl_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* exp_new(double ttl_seconds) { return new Expectations(ttl_seconds); }
+void exp_free(void* e) { delete static_cast<Expectations*>(e); }
+void exp_expect_creations(void* e, const char* key, int count) {
+  static_cast<Expectations*>(e)->Set(key, count, 0);
+}
+void exp_expect_deletions(void* e, const char* key, int count) {
+  static_cast<Expectations*>(e)->Set(key, 0, count);
+}
+void exp_raise(void* e, const char* key, int adds, int dels) {
+  static_cast<Expectations*>(e)->Raise(key, adds, dels);
+}
+void exp_creation_observed(void* e, const char* key) {
+  static_cast<Expectations*>(e)->Lower(key, 1, 0);
+}
+void exp_deletion_observed(void* e, const char* key) {
+  static_cast<Expectations*>(e)->Lower(key, 0, 1);
+}
+int exp_satisfied(void* e, const char* key) {
+  return static_cast<Expectations*>(e)->Satisfied(key);
+}
+void exp_delete(void* e, const char* key) {
+  static_cast<Expectations*>(e)->Delete(key);
+}
+int exp_get(void* e, const char* key, int* adds, int* dels,
+            double* age_seconds) {
+  return static_cast<Expectations*>(e)->Get(key, adds, dels, age_seconds);
+}
+
+}  // extern "C"

@@ -1,0 +1,244 @@
+// Rate-limited delaying workqueue with client-go semantics.
+//
+// Mirrors k8s.io/client-go/util/workqueue as the reference uses it
+// (jobcontroller.go:110-131): dedupe via dirty set, processing exclusion
+// ("an item is never processed by two workers simultaneously"), delayed
+// re-adds via a min-heap, per-item exponential backoff.
+
+#include "tpu_operator.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Waiting {
+  Clock::time_point ready_at;
+  uint64_t seq;
+  std::string item;
+  bool operator>(const Waiting& o) const {
+    if (ready_at != o.ready_at) return ready_at > o.ready_at;
+    return seq > o.seq;
+  }
+};
+
+class WorkQueue {
+ public:
+  WorkQueue(double base_delay, double max_delay)
+      : base_delay_(base_delay), max_delay_(max_delay) {}
+
+  void Add(const std::string& item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    AddLocked(item);
+  }
+
+  void AddAfter(const std::string& item, double delay) {
+    if (delay <= 0) {
+      Add(item);
+      return;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    waiting_.push(Waiting{
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(delay)),
+        seq_++, item});
+    cv_.notify_one();
+  }
+
+  void AddRateLimited(const std::string& item) {
+    double delay;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      int n = failures_[item]++;
+      delay = base_delay_;
+      for (int i = 0; i < n && delay < max_delay_; i++) delay *= 2;
+      if (delay > max_delay_) delay = max_delay_;
+    }
+    AddAfter(item, delay);
+  }
+
+  // 1 = item, 0 = timeout, -1 = shutdown
+  int Get(double timeout, std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    ++active_getters_;
+    int rc = GetLocked(lk, timeout, out);
+    if (--active_getters_ == 0 && shutdown_) cv_.notify_all();
+    return rc;
+  }
+
+  // Blocks until no thread is inside Get, so deleting the queue is safe.
+  void ShutdownAndDrain() {
+    std::unique_lock<std::mutex> lk(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+    cv_.wait(lk, [this] { return active_getters_ == 0; });
+  }
+
+ private:
+  int GetLocked(std::unique_lock<std::mutex>& lk, double timeout,
+                std::string* out) {
+    const bool forever = timeout < 0;
+    const auto deadline =
+        forever ? Clock::time_point::max()
+                : Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                     std::chrono::duration<double>(timeout));
+    for (;;) {
+      DrainReadyLocked();
+      if (!queue_.empty()) {
+        *out = queue_.front();
+        queue_.pop_front();
+        processing_.insert(*out);
+        dirty_.erase(*out);
+        return 1;
+      }
+      if (shutdown_) return -1;
+      auto wake = deadline;
+      if (!waiting_.empty() && waiting_.top().ready_at < wake)
+        wake = waiting_.top().ready_at;
+      if (wake == Clock::time_point::max()) {
+        cv_.wait(lk);
+      } else {
+        if (cv_.wait_until(lk, wake) == std::cv_status::timeout &&
+            !forever && Clock::now() >= deadline) {
+          // drain anything that became ready exactly at the deadline
+          DrainReadyLocked();
+          if (!queue_.empty()) continue;
+          return 0;
+        }
+      }
+    }
+  }
+
+ public:
+  void Done(const std::string& item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    processing_.erase(item);
+    if (dirty_.count(item)) {
+      queue_.push_back(item);
+      cv_.notify_one();
+    }
+  }
+
+  void Forget(const std::string& item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    failures_.erase(item);
+  }
+
+  int NumRequeues(const std::string& item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = failures_.find(item);
+    return it == failures_.end() ? 0 : it->second;
+  }
+
+  int Len() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(queue_.size());
+  }
+
+  void Shutdown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+
+ private:
+  void AddLocked(const std::string& item) {
+    if (shutdown_ || dirty_.count(item)) return;
+    dirty_.insert(item);
+    if (processing_.count(item)) return;
+    queue_.push_back(item);
+    cv_.notify_one();
+  }
+
+  void DrainReadyLocked() {
+    const auto now = Clock::now();
+    while (!waiting_.empty() && waiting_.top().ready_at <= now) {
+      std::string item = waiting_.top().item;
+      waiting_.pop();
+      AddReadyLocked(item);
+    }
+  }
+
+  void AddReadyLocked(const std::string& item) {
+    if (dirty_.count(item)) return;
+    dirty_.insert(item);
+    if (!processing_.count(item)) queue_.push_back(item);
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::unordered_set<std::string> dirty_;
+  std::unordered_set<std::string> processing_;
+  std::priority_queue<Waiting, std::vector<Waiting>, std::greater<Waiting>>
+      waiting_;
+  std::unordered_map<std::string, int> failures_;
+  uint64_t seq_ = 0;
+  int active_getters_ = 0;
+  bool shutdown_ = false;
+  double base_delay_;
+  double max_delay_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* wq_new(double base_delay, double max_delay) {
+  return new WorkQueue(base_delay, max_delay);
+}
+void wq_free(void* q) {
+  // Wake and wait out any thread blocked in wq_get (which runs without
+  // the Python GIL) before destroying the mutex/condvar under it.
+  auto* wq = static_cast<WorkQueue*>(q);
+  wq->ShutdownAndDrain();
+  delete wq;
+}
+void wq_add(void* q, const char* item) {
+  static_cast<WorkQueue*>(q)->Add(item);
+}
+void wq_add_after(void* q, const char* item, double delay) {
+  static_cast<WorkQueue*>(q)->AddAfter(item, delay);
+}
+void wq_add_rate_limited(void* q, const char* item) {
+  static_cast<WorkQueue*>(q)->AddRateLimited(item);
+}
+int wq_get(void* q, double timeout, char* buf, int buflen) {
+  std::string out;
+  int rc = static_cast<WorkQueue*>(q)->Get(timeout, &out);
+  if (rc == 1) {
+    if (static_cast<int>(out.size()) >= buflen) {
+      // Caller buffer too small: requeue so the item is not lost (Add
+      // marks it dirty while processing; Done then re-queues it).
+      static_cast<WorkQueue*>(q)->Add(out);
+      static_cast<WorkQueue*>(q)->Done(out);
+      return -2;
+    }
+    std::memcpy(buf, out.c_str(), out.size() + 1);
+  }
+  return rc;
+}
+void wq_done(void* q, const char* item) {
+  static_cast<WorkQueue*>(q)->Done(item);
+}
+void wq_forget(void* q, const char* item) {
+  static_cast<WorkQueue*>(q)->Forget(item);
+}
+int wq_num_requeues(void* q, const char* item) {
+  return static_cast<WorkQueue*>(q)->NumRequeues(item);
+}
+int wq_len(void* q) { return static_cast<WorkQueue*>(q)->Len(); }
+void wq_shutdown(void* q) { static_cast<WorkQueue*>(q)->Shutdown(); }
+
+}  // extern "C"

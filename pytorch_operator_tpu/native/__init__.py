@@ -1,0 +1,238 @@
+"""ctypes bindings for the C++ runtime core (native/).
+
+The reference's runtime is compiled (a Go binary); here the hot
+control-plane structures — the rate-limited workqueue the sync workers
+block on and the expectations cache every watch event touches — are C++
+(native/src/*.cc), loaded via ctypes so no binding framework is needed.
+Blocking `get` calls release the GIL inside C++, so N sync workers
+contend on a native mutex instead of the interpreter lock.
+
+`load()` builds the library on first use (make -C native) and caches the
+handle; callers fall back to the pure-Python implementations when no
+toolchain is available (`native_available()` tells which).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libtpu_operator.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_error: Optional[str] = None
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_void = ctypes.c_void_p
+    c_char = ctypes.c_char_p
+    lib.wq_new.restype = c_void
+    lib.wq_new.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.wq_free.argtypes = [c_void]
+    lib.wq_add.argtypes = [c_void, c_char]
+    lib.wq_add_after.argtypes = [c_void, c_char, ctypes.c_double]
+    lib.wq_add_rate_limited.argtypes = [c_void, c_char]
+    lib.wq_get.restype = ctypes.c_int
+    lib.wq_get.argtypes = [c_void, ctypes.c_double, c_char, ctypes.c_int]
+    lib.wq_done.argtypes = [c_void, c_char]
+    lib.wq_forget.argtypes = [c_void, c_char]
+    lib.wq_num_requeues.restype = ctypes.c_int
+    lib.wq_num_requeues.argtypes = [c_void, c_char]
+    lib.wq_len.restype = ctypes.c_int
+    lib.wq_len.argtypes = [c_void]
+    lib.wq_shutdown.argtypes = [c_void]
+
+    lib.exp_new.restype = c_void
+    lib.exp_new.argtypes = [ctypes.c_double]
+    lib.exp_free.argtypes = [c_void]
+    lib.exp_expect_creations.argtypes = [c_void, c_char, ctypes.c_int]
+    lib.exp_expect_deletions.argtypes = [c_void, c_char, ctypes.c_int]
+    lib.exp_raise.argtypes = [c_void, c_char, ctypes.c_int, ctypes.c_int]
+    lib.exp_creation_observed.argtypes = [c_void, c_char]
+    lib.exp_deletion_observed.argtypes = [c_void, c_char]
+    lib.exp_satisfied.restype = ctypes.c_int
+    lib.exp_satisfied.argtypes = [c_void, c_char]
+    lib.exp_delete.argtypes = [c_void, c_char]
+    lib.exp_get.restype = ctypes.c_int
+    lib.exp_get.argtypes = [c_void, c_char,
+                            ctypes.POINTER(ctypes.c_int),
+                            ctypes.POINTER(ctypes.c_int),
+                            ctypes.POINTER(ctypes.c_double)]
+    return lib
+
+
+def load(build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _load_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            return None  # don't re-run a failed build on every call
+        if not os.path.exists(_LIB_PATH) and build:
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, text=True, timeout=120)
+            except (subprocess.CalledProcessError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                _load_error = getattr(e, "stderr", "") or str(e)
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError as e:
+            _load_error = str(e)
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return load() is not None
+
+
+def load_error() -> Optional[str]:
+    return _load_error
+
+
+class NativeWorkQueue:
+    """Drop-in for runtime.workqueue.WorkQueue over string items."""
+
+    _BUF_LEN = 4096
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self._q = lib.wq_new(base_delay, max_delay)
+
+    def add(self, item: str) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_add(q, item.encode())
+
+    def add_after(self, item: str, delay: float) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_add_after(q, item.encode(), delay)
+
+    def add_rate_limited(self, item: str) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_add_rate_limited(q, item.encode())
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Optional[str], bool]:
+        """(item, shutdown) — matching the Python WorkQueue contract."""
+        q = self._q
+        if not q:
+            return None, True
+        t = -1.0 if timeout is None else timeout
+        # each waiting thread needs its own buffer
+        buf = ctypes.create_string_buffer(self._BUF_LEN)
+        rc = self._lib.wq_get(q, t, buf, self._BUF_LEN)
+        if rc == 1:
+            return buf.value.decode(), False
+        if rc == -1:
+            return None, True
+        return None, False  # timeout (or oversized item requeued)
+
+    def done(self, item: str) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_done(q, item.encode())
+
+    def forget(self, item: str) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_forget(q, item.encode())
+
+    def num_requeues(self, item: str) -> int:
+        q = self._q
+        return self._lib.wq_num_requeues(q, item.encode()) if q else 0
+
+    def shutdown(self) -> None:
+        q = self._q
+        if q:
+            self._lib.wq_shutdown(q)
+
+    def __len__(self) -> int:
+        q = self._q
+        return self._lib.wq_len(q) if q else 0
+
+    def close(self) -> None:
+        """Shut down, wait out blocked getters, and free the C++ queue."""
+        q, self._q = getattr(self, "_q", None), None
+        if q:
+            # wq_free shuts the queue down and waits for any thread
+            # blocked in wq_get (GIL released) before destroying it
+            self._lib.wq_free(q)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeExpectations:
+    """Drop-in for runtime.expectations.ControllerExpectations."""
+
+    def __init__(self, ttl_seconds: float = 300.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self._e = lib.exp_new(ttl_seconds)
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._lib.exp_expect_creations(self._e, key.encode(), count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._lib.exp_expect_deletions(self._e, key.encode(), count)
+
+    def raise_expectations(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        self._lib.exp_raise(self._e, key.encode(), adds, dels)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.exp_creation_observed(self._e, key.encode())
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.exp_deletion_observed(self._e, key.encode())
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._lib.exp_satisfied(self._e, key.encode()))
+
+    def delete_expectations(self, key: str) -> None:
+        self._lib.exp_delete(self._e, key.encode())
+
+    def get(self, key: str):
+        adds = ctypes.c_int()
+        dels = ctypes.c_int()
+        age = ctypes.c_double()
+        if self._lib.exp_get(self._e, key.encode(), ctypes.byref(adds),
+                             ctypes.byref(dels), ctypes.byref(age)):
+            import time
+
+            from pytorch_operator_tpu.runtime.expectations import _Expectation
+
+            exp = _Expectation(adds=adds.value, dels=dels.value)
+            # carry over the native store's real age so expired() agrees
+            exp.timestamp = time.monotonic() - age.value
+            return exp
+        return None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_e", None):
+                self._lib.exp_free(self._e)
+                self._e = None
+        except Exception:
+            pass

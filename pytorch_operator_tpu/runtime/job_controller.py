@@ -60,6 +60,35 @@ class JobControllerConfig:
         self.init_container_image = init_container_image
 
 
+def _make_runtime_core():
+    """Expectations + workqueue, C++ when available (native/), Python
+    otherwise.  PYTORCH_OPERATOR_NATIVE=0 forces the Python versions;
+    =1 makes a missing native build a hard error instead of a fallback."""
+    import os
+
+    pref = os.environ.get("PYTORCH_OPERATOR_NATIVE", "auto")
+    if pref != "0":
+        try:
+            from pytorch_operator_tpu.native import (
+                NativeExpectations,
+                NativeWorkQueue,
+                native_available,
+            )
+
+            if native_available():
+                return NativeExpectations(), NativeWorkQueue()
+            if pref == "1":
+                from pytorch_operator_tpu.native import load_error
+
+                raise RuntimeError(
+                    f"PYTORCH_OPERATOR_NATIVE=1 but native core failed to "
+                    f"load: {load_error()}")
+        except ImportError:
+            if pref == "1":
+                raise
+    return ControllerExpectations(), WorkQueue()
+
+
 class JobController:
     """Generic base; a concrete controller subclasses and provides
     the GroupVersionKind identity plus reconcile logic."""
@@ -79,8 +108,7 @@ class JobController:
         self.recorder = recorder or EventRecorder(cluster.events, self.CONTROLLER_NAME)
         self.pod_control = PodControl(cluster.pods, self.recorder)
         self.service_control = ServiceControl(cluster.services, self.recorder)
-        self.expectations = ControllerExpectations()
-        self.work_queue = WorkQueue()
+        self.expectations, self.work_queue = _make_runtime_core()
         self.pod_informer = Informer(cluster.pods)
         self.service_informer = Informer(cluster.services)
         self._stop = threading.Event()

@@ -88,10 +88,15 @@ def test_defaults_e2e(world):
     assert expected <= services
 
     # CleanPodPolicy defaults to None: nothing deleted on success.
-    stored = cluster.jobs.get("default", "e2e-job")
-    statuses = stored["status"]["replicaStatuses"]
-    assert statuses["Master"]["succeeded"] == 1
-    assert statuses["Worker"]["succeeded"] == 3
+    # The Succeeded condition is set when the master completes; worker
+    # tallies may land on the following sync, so poll for them.
+    def tallies_done():
+        statuses = cluster.jobs.get("default", "e2e-job")["status"]["replicaStatuses"]
+        return (statuses["Master"]["succeeded"] == 1
+                and statuses["Worker"]["succeeded"] == 3)
+
+    assert wait_for(tallies_done), \
+        cluster.jobs.get("default", "e2e-job")["status"]["replicaStatuses"]
 
     # Events were emitted through the real recorder.
     reasons = {e["reason"] for e in cluster.events.list()}

@@ -1,0 +1,219 @@
+"""Native (C++) runtime core: same contract as the Python implementations.
+
+Runs the workqueue/expectations semantics table against BOTH
+implementations, then the full e2e simulation with the native core
+forced on, proving drop-in equivalence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.runtime import ControllerExpectations, WorkQueue
+
+native = pytest.importorskip("pytorch_operator_tpu.native")
+
+if not native.native_available():
+    pytest.skip(f"native core unavailable: {native.load_error()}",
+                allow_module_level=True)
+
+
+@pytest.fixture(params=["python", "native"])
+def queue(request):
+    if request.param == "python":
+        return WorkQueue()
+    return native.NativeWorkQueue()
+
+
+@pytest.fixture(params=["python", "native"])
+def expectations(request):
+    if request.param == "python":
+        return ControllerExpectations()
+    return native.NativeExpectations()
+
+
+class TestWorkQueueContract:
+    def test_dedupe(self, queue):
+        queue.add("k")
+        queue.add("k")
+        assert len(queue) == 1
+
+    def test_fifo(self, queue):
+        for k in ("a", "b", "c"):
+            queue.add(k)
+        got = [queue.get(1.0)[0] for _ in range(3)]
+        assert got == ["a", "b", "c"]
+
+    def test_processing_exclusion(self, queue):
+        """An item re-added while processing is deferred until done()."""
+        queue.add("k")
+        item, _ = queue.get(1.0)
+        queue.add("k")
+        assert queue.get(0.05) == (None, False)
+        queue.done("k")
+        assert queue.get(1.0)[0] == "k"
+
+    def test_done_without_reader(self, queue):
+        queue.add("k")
+        queue.get(1.0)
+        queue.done("k")
+        assert queue.get(0.05) == (None, False)
+
+    def test_add_after_delays(self, queue):
+        queue.add_after("k", 0.15)
+        assert queue.get(0.02) == (None, False)
+        t0 = time.monotonic()
+        item, _ = queue.get(2.0)
+        assert item == "k"
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_rate_limited_backoff_counts(self, queue):
+        queue.add_rate_limited("k")
+        queue.add_rate_limited("k")
+        queue.add_rate_limited("k")
+        assert queue.num_requeues("k") == 3
+        queue.forget("k")
+        assert queue.num_requeues("k") == 0
+
+    def test_shutdown_unblocks_getters(self, queue):
+        results = []
+
+        def getter():
+            results.append(queue.get(5.0))
+
+        threads = [threading.Thread(target=getter) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        queue.shutdown()
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive()
+        assert all(sd for (_, sd) in results)
+
+    def test_concurrent_workers_no_duplicates(self, queue):
+        """N workers, each item processed exactly once per add round."""
+        seen = []
+        seen_lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                item, shutdown = queue.get(0.1)
+                if shutdown:
+                    return
+                if item is None:
+                    continue
+                with seen_lock:
+                    seen.append(item)
+                queue.done(item)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(200):
+            queue.add(f"item-{i}")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with seen_lock:
+                if len(seen) >= 200:
+                    break
+            time.sleep(0.01)
+        stop.set()
+        queue.shutdown()
+        for t in threads:
+            t.join(timeout=5)
+        with seen_lock:
+            assert sorted(seen) == sorted(f"item-{i}" for i in range(200))
+
+
+class TestExpectationsContract:
+    def test_creations_cycle(self, expectations):
+        expectations.expect_creations("k", 2)
+        assert not expectations.satisfied("k")
+        expectations.creation_observed("k")
+        assert not expectations.satisfied("k")
+        expectations.creation_observed("k")
+        assert expectations.satisfied("k")
+
+    def test_deletions_cycle(self, expectations):
+        expectations.expect_deletions("k", 1)
+        assert not expectations.satisfied("k")
+        expectations.deletion_observed("k")
+        assert expectations.satisfied("k")
+
+    def test_never_set_is_satisfied(self, expectations):
+        assert expectations.satisfied("unknown")
+
+    def test_delete_expectations(self, expectations):
+        expectations.expect_creations("k", 5)
+        expectations.delete_expectations("k")
+        assert expectations.satisfied("k")
+
+    def test_raise_expectations(self, expectations):
+        expectations.expect_creations("k", 1)
+        expectations.raise_expectations("k", adds=1)
+        expectations.creation_observed("k")
+        assert not expectations.satisfied("k")
+        expectations.creation_observed("k")
+        assert expectations.satisfied("k")
+
+    def test_observe_below_zero_stays_satisfied(self, expectations):
+        expectations.expect_creations("k", 1)
+        expectations.creation_observed("k")
+        expectations.creation_observed("k")
+        assert expectations.satisfied("k")
+
+
+class TestNativeTtl:
+    def test_expired_expectation_is_satisfied(self):
+        e = native.NativeExpectations(ttl_seconds=0.1)
+        e.expect_creations("k", 5)
+        assert not e.satisfied("k")
+        time.sleep(0.15)
+        assert e.satisfied("k")
+
+
+def test_e2e_sim_with_native_core(monkeypatch):
+    """Full controller loop on the C++ queue + expectations."""
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE", "1")
+
+    from pytorch_operator_tpu.api.v1 import constants
+    from pytorch_operator_tpu.controller import PyTorchController
+    from pytorch_operator_tpu.k8s.fake import FakeCluster
+    from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+    from pytorch_operator_tpu.metrics.prometheus import Registry
+    from pytorch_operator_tpu.runtime import JobControllerConfig
+
+    from testutil import new_job
+
+    cluster = FakeCluster()
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=Registry())
+    assert isinstance(ctl.work_queue, native.NativeWorkQueue)
+    assert isinstance(ctl.expectations, native.NativeExpectations)
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=3, stop_event=stop)
+    try:
+        cluster.jobs.create("default", new_job(workers=3, name="nat-job").to_dict())
+        deadline = time.monotonic() + 15
+        done = False
+        while time.monotonic() < deadline and not done:
+            job = cluster.jobs.get("default", "nat-job")
+            conds = (job.get("status") or {}).get("conditions") or []
+            done = any(c["type"] == constants.JOB_SUCCEEDED and c["status"] == "True"
+                       for c in conds)
+            time.sleep(0.02)
+        assert done, "job did not succeed on the native core"
+        pods = {p["metadata"]["name"] for p in cluster.pods.list()}
+        assert {"nat-job-master-0", "nat-job-worker-0", "nat-job-worker-1",
+                "nat-job-worker-2"} <= pods
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
