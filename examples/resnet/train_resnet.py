@@ -1,0 +1,124 @@
+"""ResNet-50 data-parallel training on TPU — BASELINE.json config 4.
+
+The reference's "ResNet-50/ImageNet PyTorchJob, 4 Workers on v4-64"
+config, TPU-native: NHWC bf16 ResNet-50 from the model zoo, batch
+sharded over all devices (dp), SGD momentum with cosine decay.  The
+operator's rendezvous env makes the same script span multi-host slices
+via jax.distributed (see controller/tpu_env.py).
+
+Streams synthetic ImageNet-shaped batches by default so the benchmark is
+hermetic; point --data-dir at an imagenet directory loader if available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from pytorch_operator_tpu.utils import maybe_init_distributed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description="TPU ResNet-50")
+    parser.add_argument("--batch-size", type=int, default=256,
+                        help="global batch size")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--log-interval", type=int, default=10)
+    parser.add_argument("--tiny", action="store_true",
+                        help="thin model + small images (CI/smoke)")
+    args = parser.parse_args()
+
+    pid, nprocs = maybe_init_distributed()
+
+    import jax
+
+    from pytorch_operator_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_operator_tpu.models import resnet
+    from pytorch_operator_tpu.parallel.mesh import AXIS_DP
+
+    devices = jax.devices()
+    mesh = jax.sharding.Mesh(np.asarray(devices), (AXIS_DP,))
+    data_sharding = NamedSharding(mesh, P(AXIS_DP))
+    repl = NamedSharding(mesh, P())
+    print(f"[worker {pid}/{nprocs}] {len(devices)} x "
+          f"{devices[0].device_kind}", flush=True)
+
+    if args.tiny:
+        model = resnet.resnet18_thin(num_classes=args.num_classes)
+        args.image_size = min(args.image_size, 64)
+    else:
+        model = resnet.resnet50(num_classes=args.num_classes)
+
+    if args.batch_size % len(devices):
+        rounded = args.batch_size + len(devices) - args.batch_size % len(devices)
+        print(f"[worker {pid}] rounding batch {args.batch_size} -> {rounded} "
+              f"for {len(devices)} devices", flush=True)
+        args.batch_size = rounded
+
+    params, stats = resnet.init_train_state(
+        model, jax.random.key(0), image_size=args.image_size)
+    params = jax.device_put(params, repl)
+    stats = jax.device_put(stats, repl)
+    schedule = optax.cosine_decay_schedule(args.lr, args.steps)
+    opt = optax.sgd(schedule, momentum=args.momentum, nesterov=True)
+    opt_state = jax.device_put(opt.init(params), repl)
+
+    @jax.jit
+    def train_step(params, stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, new_stats = resnet.apply(model, p, stats, images, train=True)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+            return loss, new_stats
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    # Pre-generate a small pool of device-resident batches so the timed
+    # loop measures the train step, not host RNG + H2D transfer.
+    rng = np.random.default_rng(pid)
+    shape = (args.batch_size, args.image_size, args.image_size, 3)
+    pool = [
+        (jax.device_put(rng.standard_normal(shape, dtype=np.float32),
+                        data_sharding),
+         jax.device_put(rng.integers(0, args.num_classes, args.batch_size),
+                        data_sharding))
+        for _ in range(min(4, args.steps) or 1)
+    ]
+    jax.block_until_ready(pool)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        images, labels = pool[i % len(pool)]
+        params, stats, opt_state, loss = train_step(
+            params, stats, opt_state, images, labels)
+        if i % args.log_interval == 0 or i == args.steps - 1:
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            print(f"step {i}: loss={float(loss):.4f} "
+                  f"images/sec={(i + 1) * args.batch_size / dt:.0f}",
+                  flush=True)
+    print("training complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

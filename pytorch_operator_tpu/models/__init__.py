@@ -9,4 +9,14 @@ PartitionSpec trees so they drop straight onto a `jax.sharding.Mesh`.
 
 from pytorch_operator_tpu.models import llama, mnist_cnn
 
-__all__ = ["llama", "mnist_cnn"]
+__all__ = ["llama", "mnist_cnn", "resnet"]
+
+
+def __getattr__(name):
+    # resnet pulls in flax; import it lazily so the pure-jax models (and
+    # the operator control plane) don't pay the flax import cost
+    if name == "resnet":
+        from pytorch_operator_tpu.models import resnet
+
+        return resnet
+    raise AttributeError(name)

@@ -50,6 +50,8 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    use_flash: bool = False       # pallas flash-attention kernel (ops/)
+    use_fused_norm: bool = False  # pallas fused RMSNorm kernel (ops/)
 
     @property
     def head_dim(self) -> int:
@@ -146,7 +148,13 @@ def param_specs(cfg: LlamaConfig) -> Params:
 # Forward
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, fused: bool = False
+) -> jax.Array:
+    if fused:
+        from pytorch_operator_tpu.ops import rms_norm as fused_rms_norm
+
+        return fused_rms_norm(x, weight, eps)
     dtype = x.dtype
     x = x.astype(jnp.float32)
     x = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
@@ -169,12 +177,20 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _attention(q, k, v, cfg: LlamaConfig):
-    """Dense causal attention (B,T,H,Dh)x(B,T,KV,Dh) with GQA broadcast."""
+    """Causal attention (B,T,H,Dh)x(B,T,KV,Dh) with GQA broadcast.
+
+    cfg.use_flash routes through the Pallas flash kernel (ops/); the
+    dense path materialises the (T, T) scores and lets XLA fuse.
+    """
     B, T, H, Dh = q.shape
     groups = cfg.n_heads // cfg.n_kv_heads
     if groups > 1:
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
+    if cfg.use_flash:
+        from pytorch_operator_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
     scores = scores * (Dh ** -0.5)
     mask = jnp.tril(jnp.ones((T, T), bool))
@@ -187,7 +203,7 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin):
     B, T, D = h.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
 
-    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.use_fused_norm)
     q = jnp.einsum("btd,dk->btk", x, lp["wq"]).reshape(B, T, nh, hd)
     k = jnp.einsum("btd,dk->btk", x, lp["wk"]).reshape(B, T, nkv, hd)
     v = jnp.einsum("btd,dk->btk", x, lp["wv"]).reshape(B, T, nkv, hd)
@@ -196,7 +212,7 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin):
     attn = _attention(q, k, v, cfg).reshape(B, T, nh * hd)
     h = h + jnp.einsum("btk,kd->btd", attn, lp["wo"])
 
-    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.use_fused_norm)
     gate = jax.nn.silu(jnp.einsum("btd,df->btf", x, lp["w_gate"]))
     up = jnp.einsum("btd,df->btf", x, lp["w_up"])
     h = h + jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
@@ -217,7 +233,7 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
         return body(h, lp), None
 
     h, _ = lax.scan(scan_fn, h, params["layers"])
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
     # weight-tied output head
     return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
 

@@ -1,0 +1,107 @@
+"""ResNet-50 (flax.linen) — BASELINE.json config 4's model.
+
+The reference names "ResNet-50/ImageNet PyTorchJob, 4 Workers on v4-64"
+as a scale config but ships no model code; this is the TPU-native
+implementation: NHWC layout (XLA's native conv layout on TPU), bf16
+compute with f32 batch-norm statistics, and the v1.5 variant (stride on
+the 3x3) that torchvision's resnet50 uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype)
+
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.num_filters * 2 ** i, strides, conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes, dtype=dtype)
+
+
+def resnet18_thin(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    """Small variant for tests/compile checks."""
+    return ResNet(stage_sizes=[1, 1], num_classes=num_classes,
+                  num_filters=8, dtype=dtype)
+
+
+def init_train_state(
+    model: ResNet, key: jax.Array, image_size: int = 224, batch: int = 2
+):
+    variables = model.init(
+        key, jnp.zeros((batch, image_size, image_size, 3)), train=False)
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def apply(
+    model: ResNet,
+    params,
+    batch_stats,
+    images: jax.Array,
+    train: bool = False,
+) -> Tuple[jax.Array, Any]:
+    """Returns (logits, new_batch_stats)."""
+    if train:
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        return logits, updates["batch_stats"]
+    logits = model.apply(
+        {"params": params, "batch_stats": batch_stats}, images, train=False)
+    return logits, batch_stats

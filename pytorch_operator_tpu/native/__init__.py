@@ -135,14 +135,20 @@ class NativeWorkQueue:
         if not q:
             return None, True
         t = -1.0 if timeout is None else timeout
-        # each waiting thread needs its own buffer
-        buf = ctypes.create_string_buffer(self._BUF_LEN)
-        rc = self._lib.wq_get(q, t, buf, self._BUF_LEN)
-        if rc == 1:
-            return buf.value.decode(), False
-        if rc == -1:
-            return None, True
-        return None, False  # timeout (or oversized item requeued)
+        # each waiting thread needs its own buffer; -2 means the popped
+        # item didn't fit (C++ side requeued it) — retry bigger
+        buflen = self._BUF_LEN
+        while True:
+            buf = ctypes.create_string_buffer(buflen)
+            rc = self._lib.wq_get(q, t, buf, buflen)
+            if rc == 1:
+                return buf.value.decode(), False
+            if rc == -1:
+                return None, True
+            if rc == -2:
+                buflen *= 2
+                continue
+            return None, False  # timeout
 
     def done(self, item: str) -> None:
         q = self._q

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference delegates all device compute to torch/CUDA images
+(SURVEY.md §2.4 — no first-party kernels); here the flagship model's hot
+paths get TPU kernels: fused causal flash attention and fused RMSNorm,
+with jnp fallbacks and interpret-mode support so the same code runs on
+CPU test meshes.
+"""
+
+from pytorch_operator_tpu.ops.flash_attention import flash_attention
+from pytorch_operator_tpu.ops.rms_norm import rms_norm
+
+__all__ = ["flash_attention", "rms_norm"]

@@ -1,0 +1,67 @@
+"""ResNet model tests (small variant, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pytorch_operator_tpu.models import resnet
+
+
+class TestResNet:
+    def test_forward_shapes(self):
+        model = resnet.resnet18_thin(num_classes=10)
+        params, stats = resnet.init_train_state(model, jax.random.key(0),
+                                                image_size=32)
+        x = jnp.zeros((2, 32, 32, 3))
+        logits, _ = resnet.apply(model, params, stats, x, train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+    def test_batch_stats_update_in_train(self):
+        model = resnet.resnet18_thin()
+        params, stats = resnet.init_train_state(model, jax.random.key(0),
+                                                image_size=32)
+        x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+        _, new_stats = resnet.apply(model, params, stats, x, train=True)
+        diff = jax.tree_util.tree_reduce(
+            lambda acc, ab: acc + float(jnp.sum(jnp.abs(ab))),
+            jax.tree.map(lambda a, b: a - b, stats, new_stats), 0.0)
+        assert diff > 0, "batch stats should move during training"
+        _, same_stats = resnet.apply(model, params, stats, x, train=False)
+        assert same_stats is stats
+
+    def test_overfits_tiny_batch(self):
+        model = resnet.resnet18_thin(num_classes=4)
+        params, stats = resnet.init_train_state(model, jax.random.key(0),
+                                                image_size=32)
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        x = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+        y = jnp.arange(8) % 4
+
+        @jax.jit
+        def step(params, stats, opt_state):
+            def loss_fn(p):
+                logits, new_stats = resnet.apply(model, p, stats, x, train=True)
+                logp = jax.nn.log_softmax(logits)
+                loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+                return loss, new_stats
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+        for _ in range(40):
+            params, stats, opt_state, loss = step(params, stats, opt_state)
+        logits, _ = resnet.apply(model, params, stats, x, train=False)
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == y))
+        assert acc >= 0.75, (acc, float(loss))
+
+    def test_resnet50_param_count(self):
+        model = resnet.resnet50(num_classes=1000)
+        params, _ = resnet.init_train_state(model, jax.random.key(0),
+                                            image_size=64, batch=1)
+        n = sum(x.size for x in jax.tree.leaves(params))
+        # torchvision resnet50: 25.56M params
+        assert 25_000_000 < n < 26_100_000, n
