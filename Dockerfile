@@ -1,0 +1,17 @@
+# Operator image (reference: Dockerfile builds the Go binary into ubi8;
+# here the operator is Python + a C++ runtime core built at image build).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir pyyaml
+
+WORKDIR /opt/pytorch-operator
+ADD pytorch_operator_tpu ./pytorch_operator_tpu
+ADD native ./native
+RUN make -C native
+
+ENV PYTHONPATH=/opt/pytorch-operator
+ENTRYPOINT ["python", "-m", "pytorch_operator_tpu"]
+CMD ["--monitoring-port", "8443"]

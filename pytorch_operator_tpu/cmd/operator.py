@@ -111,6 +111,7 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     """
     stop_event = stop_event or threading.Event()
 
+    kubelet = None
     if args.fake_cluster:
         cluster = cluster if cluster is not None else FakeCluster()
         from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
@@ -118,13 +119,32 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         kubelet = FakeKubelet(cluster)
         kubelet.start()
         logger.info("running against in-memory fake cluster")
-    else:
-        # The REST-backed cluster client lands with the native runtime; until
-        # then the operator process supports the simulation backend only.
-        logger.error(
-            "no real-cluster backend configured; run with --fake-cluster "
-            "(REST client backend: see native/ runtime)")
-        return 1
+    elif cluster is None:
+        from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+        try:
+            if args.master:
+                kube_config = KubeConfig.from_url(args.master)
+            elif args.kubeconfig or not os.path.isdir(
+                    "/var/run/secrets/kubernetes.io"):
+                kube_config = KubeConfig.from_kubeconfig(args.kubeconfig or None)
+            else:
+                kube_config = KubeConfig.in_cluster()
+        except (OSError, KeyError, StopIteration) as e:
+            logger.error(
+                "no API server configured (%s); pass --master/--kubeconfig "
+                "or run with --fake-cluster", e)
+            return 1
+        cluster = RestCluster(kube_config, namespace=args.namespace or None)
+        # checkCRDExists (reference server.go:106-109): fail fast when the
+        # CRD isn't installed
+        if not cluster.check_crd_exists():
+            logger.error(
+                "PyTorchJob CRD not found on the API server; install "
+                "manifests/crd.yaml first")
+            return 1
+        logger.info("connected to API server %s:%d",
+                    kube_config.host, kube_config.port)
 
     registry = Registry()
     is_leader_gauge = registry.gauge(
@@ -182,8 +202,10 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         controller.work_queue.shutdown()
         if metrics_server:
             metrics_server.shutdown()
-        if args.fake_cluster:
+        if kubelet is not None:
             kubelet.stop()
+        if hasattr(cluster, "close"):
+            cluster.close()
     return 0
 
 

@@ -1,0 +1,206 @@
+"""Mini kube-apiserver: serves a FakeCluster over real HTTP.
+
+Bridges the REST client (k8s/rest.py) and the in-memory fake cluster so
+the full operator loop can be driven over actual sockets — list/CRUD,
+merge-patch, status subresource, label selectors, and streaming watch —
+without a real cluster.  Also usable as a dev sandbox:
+
+    python -m pytorch_operator_tpu.k8s.stub_server --port 8001
+    python -m pytorch_operator_tpu --master http://127.0.0.1:8001
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .errors import ApiError
+from .fake import FakeCluster
+
+_PATH_RE = re.compile(
+    r"^(?:/api/v1|/apis/[^/]+/[^/]+)"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<sub>status))?$"
+)
+
+
+class StubApiServer:
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster if cluster is not None else FakeCluster()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, e: ApiError):
+                self._send(e.code, {"message": str(e)})
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self):
+                u = urlparse(self.path)
+                m = _PATH_RE.match(u.path)
+                if not m:
+                    self._send(404, {"message": f"no route for {u.path}"})
+                    return None
+                d = m.groupdict()
+                try:
+                    store = self.cluster_store(d["plural"])
+                except KeyError:
+                    self._send(404, {"message":
+                                     f"unknown resource {d['plural']!r}"})
+                    return None
+                return (store, d["ns"], d["name"], d["sub"],
+                        parse_qs(u.query))
+
+            def cluster_store(self, plural):
+                return outer.cluster.resource(plural)
+
+            def do_GET(self):
+                r = self._route()
+                if not r:
+                    return
+                store, ns, name, _sub, q = r
+                try:
+                    if name:
+                        self._send(200, store.get(ns, name))
+                        return
+                    if q.get("watch", ["false"])[0] == "true":
+                        self._watch(store)
+                        return
+                    selector = None
+                    if "labelSelector" in q:
+                        selector = dict(
+                            pair.split("=", 1)
+                            for pair in q["labelSelector"][0].split(","))
+                    items = store.list(namespace=ns, label_selector=selector)
+                    self._send(200, {"kind": "List", "items": items})
+                except ApiError as e:
+                    self._error(e)
+
+            def _watch(self, store):
+                events: "queue.Queue" = queue.Queue()
+                listener = lambda et, obj: events.put((et, obj))
+                store.add_listener(listener)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while not outer._stopping.is_set():
+                        try:
+                            et, obj = events.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        line = json.dumps(
+                            {"type": et, "object": obj}).encode() + b"\n"
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    store.remove_listener(listener)
+
+            def do_POST(self):
+                r = self._route()
+                if not r:
+                    return
+                store, ns, _name, _sub, _q = r
+                try:
+                    self._send(201, store.create(ns or "default", self._body()))
+                except ApiError as e:
+                    self._error(e)
+
+            def do_PUT(self):
+                r = self._route()
+                if not r:
+                    return
+                store, _ns, _name, sub, _q = r
+                try:
+                    self._send(200, store.update(self._body(), subresource=sub))
+                except ApiError as e:
+                    self._error(e)
+
+            def do_PATCH(self):
+                r = self._route()
+                if not r:
+                    return
+                store, ns, name, sub, _q = r
+                try:
+                    self._send(200, store.patch(ns or "default", name,
+                                                self._body(), subresource=sub))
+                except ApiError as e:
+                    self._error(e)
+
+            def do_DELETE(self):
+                r = self._route()
+                if not r:
+                    return
+                store, ns, name, _sub, _q = r
+                try:
+                    store.delete(ns or "default", name)
+                    self._send(200, {"status": "Success"})
+                except ApiError as e:
+                    self._error(e)
+
+        self._stopping = threading.Event()
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> "StubApiServer":
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self.server.shutdown()
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="stub kube-apiserver")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args()
+    server = StubApiServer(host=args.host, port=args.port)
+    server.start()
+    print(f"stub API server on {args.host}:{server.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,14 +9,17 @@ PartitionSpec trees so they drop straight onto a `jax.sharding.Mesh`.
 
 from pytorch_operator_tpu.models import llama, mnist_cnn
 
-__all__ = ["llama", "mnist_cnn", "resnet"]
+__all__ = ["llama", "mnist_cnn", "resnet", "moe"]
 
 
 def __getattr__(name):
     # resnet pulls in flax; import it lazily so the pure-jax models (and
-    # the operator control plane) don't pay the flax import cost
-    if name == "resnet":
-        from pytorch_operator_tpu.models import resnet
+    # the operator control plane) don't pay the flax import cost.
+    # importlib, not `from ... import`: the latter re-enters this hook.
+    if name in ("resnet", "moe"):
+        import importlib
 
-        return resnet
+        module = importlib.import_module(f"pytorch_operator_tpu.models.{name}")
+        globals()[name] = module
+        return module
     raise AttributeError(name)

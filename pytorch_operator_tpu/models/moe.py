@@ -1,0 +1,149 @@
+"""Mixture-of-Experts Llama variant with expert parallelism (ep axis).
+
+Expert parallelism is absent from the reference (SURVEY.md §2.4); here
+the FFN of every layer is replaced by a top-k routed expert bank whose
+leading expert axis shards over the mesh's ``ep`` axis.  Dispatch is
+dense (every expert sees every token, combine weights zero out non-
+routed pairs): no token dropping, no capacity factor, and the combine
+contraction over the expert axis becomes the psum across ep devices
+that GSPMD inserts.  An all-to-all dispatch (sparse, capacity-bounded)
+is the scale-up path; dense dispatch is exact and keeps the routing
+differentiable everywhere, which suits the slice sizes this round
+targets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_operator_tpu.models import llama
+from pytorch_operator_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP
+
+AXIS_EP = "ep"
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+
+
+def tiny(**kw) -> MoEConfig:
+    defaults = dict(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        ffn_dim=128, max_seq_len=128, dtype=jnp.float32,
+        n_experts=4, top_k=2,
+    )
+    defaults.update(kw)
+    return MoEConfig(**defaults)
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    """Llama params with the FFN swapped for an expert bank + router."""
+    base = llama.init_params(key, cfg)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    k_router, k_gate, k_up, k_down = jax.random.split(
+        jax.random.fold_in(key, 7), 4)
+
+    def bank(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * fan_in ** -0.5).astype(cfg.dtype)
+
+    layers = dict(base["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["router"] = bank(k_router, (L, D, E), D)
+    layers["w_gate"] = bank(k_gate, (L, E, D, F), D)
+    layers["w_up"] = bank(k_up, (L, E, D, F), D)
+    layers["w_down"] = bank(k_down, (L, E, F, D), F)
+    base["layers"] = layers
+    return base
+
+
+def param_specs(cfg: MoEConfig) -> Params:
+    base = llama.param_specs(cfg)
+    layers = dict(base["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["router"] = P(None, None, None)
+    layers["w_gate"] = P(None, AXIS_EP, AXIS_FSDP, AXIS_TP)
+    layers["w_up"] = P(None, AXIS_EP, AXIS_FSDP, AXIS_TP)
+    layers["w_down"] = P(None, AXIS_EP, AXIS_TP, AXIS_FSDP)
+    base["layers"] = layers
+    return base
+
+
+def moe_ffn(x: jax.Array, lp: Params, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN.  x (B,T,D) -> (out, aux_loss)."""
+    E, k = cfg.n_experts, cfg.top_k
+    logits = jnp.einsum("btd,de->bte", x, lp["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = lax.top_k(probs, k)                  # (B,T,k)
+    top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+    combine = jnp.zeros_like(probs).at[
+        jnp.arange(probs.shape[0])[:, None, None],
+        jnp.arange(probs.shape[1])[None, :, None],
+        top_idx,
+    ].set(top_vals)                                          # (B,T,E)
+
+    # load-balancing auxiliary loss (Switch-style): mean prob * frac routed
+    frac_routed = jnp.mean((combine > 0).astype(jnp.float32), axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(frac_routed * mean_prob)
+
+    # dense dispatch: expert axis shards over ep; combine contraction
+    # over e is the cross-ep psum
+    gate = jax.nn.silu(jnp.einsum("btd,edf->ebtf", x, lp["w_gate"]))
+    up = jnp.einsum("btd,edf->ebtf", x, lp["w_up"])
+    y = jnp.einsum("ebtf,efd->ebtd", gate * up, lp["w_down"])
+    out = jnp.einsum("ebtd,bte->btd", y, combine.astype(y.dtype))
+    return out.astype(x.dtype), aux
+
+
+def _layer(h, lp, cfg: MoEConfig, cos, sin):
+    B, T, D = h.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    x = llama.rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    q = jnp.einsum("btd,dk->btk", x, lp["wq"]).reshape(B, T, nh, hd)
+    k = jnp.einsum("btd,dk->btk", x, lp["wk"]).reshape(B, T, nkv, hd)
+    v = jnp.einsum("btd,dk->btk", x, lp["wv"]).reshape(B, T, nkv, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    attn = llama._attention(q, k, v, cfg).reshape(B, T, nh * hd)
+    h = h + jnp.einsum("btk,kd->btd", attn, lp["wo"])
+
+    x = llama.rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    ffn_out, aux = moe_ffn(x, lp, cfg)
+    return h + ffn_out, aux
+
+
+def forward(
+    params: Params, tokens: jax.Array, cfg: MoEConfig
+) -> tuple[jax.Array, jax.Array]:
+    """tokens (B,T) -> (logits (B,T,V) f32, aux_loss scalar)."""
+    T = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = llama.rope_table(cfg, T)
+
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(h, lp):
+        h, aux = body(h, lp)
+        return h, aux
+
+    h, aux = lax.scan(scan_fn, h, params["layers"])
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    logits = jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
+    return logits, jnp.mean(aux)

@@ -11,14 +11,18 @@ examples/mnist/mnist.py:99-138).
 
 from pytorch_operator_tpu.parallel.mesh import (
     AXIS_DP,
+    AXIS_EP,
     AXIS_FSDP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     batch_spec,
     factor_devices,
     make_mesh,
+    make_named_mesh,
     make_sp_mesh,
 )
+from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
 from pytorch_operator_tpu.parallel.ring_attention import ring_attention
 from pytorch_operator_tpu.parallel.train import (
     cross_entropy_loss,
@@ -28,13 +32,17 @@ from pytorch_operator_tpu.parallel.train import (
 
 __all__ = [
     "AXIS_DP",
+    "AXIS_EP",
     "AXIS_FSDP",
+    "AXIS_PP",
     "AXIS_SP",
     "AXIS_TP",
     "batch_spec",
     "factor_devices",
     "make_mesh",
+    "make_named_mesh",
     "make_sp_mesh",
+    "pipeline_apply",
     "ring_attention",
     "cross_entropy_loss",
     "make_train_step",

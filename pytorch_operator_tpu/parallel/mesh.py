@@ -23,6 +23,8 @@ AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
+AXIS_PP = "pp"
+AXIS_EP = "ep"
 
 
 def factor_devices(n: int, tp_max: int = 8) -> tuple[int, int, int]:
@@ -73,6 +75,24 @@ def make_sp_mesh(dp: int = 1, sp: int = 1, *, devices=None) -> Mesh:
         raise ValueError(f"mesh ({dp},{sp}) needs {n} devices, have {len(devices)}")
     arr = np.asarray(devices[:n]).reshape(dp, sp)
     return Mesh(arr, (AXIS_DP, AXIS_SP))
+
+
+def make_named_mesh(axes: dict, *, devices=None) -> Mesh:
+    """Build a mesh with arbitrary named axes, e.g. {"dp":2,"tp":2,"ep":2}.
+
+    Axis order is the dict order (outermost first); put the axes whose
+    collectives need the fastest links (tp, ep) last so they map to ICI
+    neighbours.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = 1
+    for size in axes.values():
+        n *= size
+    if len(devices) < n:
+        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
 
 
 def batch_spec() -> P:

@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+Another strategy absent from the reference (SURVEY.md §2.4).  The layer
+stack is sharded over the ``pp`` axis (each stage holds n_layers/S
+consecutive layers); microbatches march through the ring: at step t,
+stage s computes microbatch t-s and hands its activation to stage s+1
+via `lax.ppermute` — neighbour traffic that rides ICI.  The schedule is
+plain GPipe (fill + drain bubbles, no 1F1B); reverse-mode autodiff
+differentiates through the ppermutes, so the same code trains.
+
+Shapes inside shard_map (per stage):
+  x_mb     (M, mb, ...)   all microbatches, replicated input
+  stage_fn (params_local, x) -> y    applies this stage's layers
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+AXIS_PP = "pp"
+
+
+def _pipeline_body(params_local, x_mb, *, stage_fn, axis_name):
+    """Runs per stage inside shard_map.
+
+    params_local: this stage's layer slice (leading axis L/S).
+    x_mb: (M, mb, ...) microbatched input (same on every stage; only
+    stage 0 actually consumes it).
+    Returns (M, mb, ...) outputs (valid on the last stage; other stages
+    hold garbage that the caller masks out via the output spec).
+    """
+    S = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    state0 = lax.pvary(state0, axis_name)
+    out0 = lax.pvary(out0, axis_name)
+
+    def step(t, carry):
+        state, outs = carry
+        # stage 0 ingests microbatch t (while it exists); other stages
+        # consume the activation received from the previous stage
+        mb_idx = jnp.clip(t, 0, M - 1)
+        inp = jnp.where(stage == 0, x_mb[mb_idx], state)
+        y = stage_fn(params_local, inp)
+        # last stage records finished microbatch t - (S-1)
+        done_idx = t - (S - 1)
+        record = jnp.logical_and(stage == S - 1, done_idx >= 0)
+        safe_idx = jnp.clip(done_idx, 0, M - 1)
+        outs = jnp.where(
+            record,
+            outs.at[safe_idx].set(y),
+            outs,
+        )
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outs
+
+    _, outs = lax.fori_loop(0, M + S - 1, step, (state0, out0))
+    # only the last stage wrote into outs (others carry zeros); psum
+    # replicates the valid result onto every stage so the replicated
+    # out_spec is truthful
+    return lax.psum(outs, axis_name)
+
+
+def pipeline_apply(
+    params_stacked: Any,
+    x: jax.Array,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh,
+    *,
+    n_microbatches: int,
+    axis_name: str = AXIS_PP,
+    params_spec: Any = None,
+) -> jax.Array:
+    """Apply a layer-stacked function as a pipeline over ``axis_name``.
+
+    params_stacked: pytree whose leaves have a leading n_layers axis,
+      sharded over the pipeline axis (each stage gets a contiguous slice).
+    x: (B, ...) global batch; B must divide by n_microbatches.
+    stage_fn(params_local, x_mb) -> y_mb applies one stage's layer slice.
+    """
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    mb = B // n_microbatches
+    x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    if params_spec is None:
+        params_spec = jax.tree.map(
+            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+            params_stacked,
+        )
+
+    out_mb = jax.shard_map(
+        partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P(),  # psum in the body makes the output truly replicated
+    )(params_stacked, x_mb)
+    return out_mb.reshape(B, *x.shape[1:])

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# CI gate (the reference's .travis.yml equivalent): build the native
+# core, run the full test suite on the virtual 8-device CPU mesh, and
+# compile-check the driver entry points.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native
+python -m pytest tests/ -q
+python __graft_entry__.py 8
+echo "all checks passed"

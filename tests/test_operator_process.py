@@ -137,6 +137,8 @@ class TestOperatorRun:
             t.join(timeout=10)
         assert not t.is_alive()
 
-    def test_no_backend_errors(self):
+    def test_no_backend_errors(self, monkeypatch, tmp_path):
+        # no kubeconfig, not in-cluster, no --master -> clean exit 1
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "absent"))
         args = build_parser().parse_args(["--monitoring-port", "0"])
         assert run(args, threading.Event()) == 1

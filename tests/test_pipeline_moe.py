@@ -1,0 +1,116 @@
+"""Pipeline (pp) and expert (ep) parallelism tests on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_operator_tpu.models import moe
+from pytorch_operator_tpu.parallel import make_named_mesh, pipeline_apply
+
+
+def sequential(ws, x):
+    h = x
+    for i in range(ws.shape[0]):
+        h = jnp.tanh(h @ ws[i])
+    return h
+
+
+def stage_fn(w_local, h):
+    def body(h, w):
+        return jnp.tanh(h @ w), None
+    return jax.lax.scan(body, h, w_local)[0]
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("pp,n_mb", [(2, 2), (4, 4), (4, 8), (8, 4)])
+    def test_matches_sequential(self, pp, n_mb):
+        mesh = make_named_mesh({"pp": pp})
+        L, D, B = 2 * pp, 16, n_mb * 2
+        ws = jax.random.normal(jax.random.key(0), (L, D, D)) * 0.3
+        x = jax.random.normal(jax.random.key(1), (B, D))
+        out = pipeline_apply(ws, x, stage_fn, mesh, n_microbatches=n_mb)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(sequential(ws, x)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_sequential(self):
+        mesh = make_named_mesh({"pp": 4})
+        L, D, B = 8, 8, 8
+        ws = jax.random.normal(jax.random.key(0), (L, D, D)) * 0.3
+        x = jax.random.normal(jax.random.key(1), (B, D))
+
+        g1 = jax.grad(lambda w: jnp.sum(
+            pipeline_apply(w, x, stage_fn, mesh, n_microbatches=4) ** 2))(ws)
+        g2 = jax.grad(lambda w: jnp.sum(sequential(w, x) ** 2))(ws)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_ragged_microbatch_raises(self):
+        mesh = make_named_mesh({"pp": 2})
+        ws = jnp.zeros((2, 4, 4))
+        x = jnp.zeros((5, 4))
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(ws, x, stage_fn, mesh, n_microbatches=3)
+
+
+class TestMoE:
+    def test_forward_shapes_and_aux(self):
+        cfg = moe.tiny()
+        params = moe.init_params(jax.random.key(0), cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits, aux = moe.forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+        # balanced routing aux is ~1; wildly unbalanced is ~n_experts
+        assert 0.5 < float(aux) < cfg.n_experts + 1
+
+    def test_top1_routing(self):
+        cfg = moe.tiny(top_k=1)
+        params = moe.init_params(jax.random.key(0), cfg)
+        logits, _ = moe.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_ep_sharded_training_converges(self):
+        cfg = moe.tiny(n_experts=4)
+        mesh = make_named_mesh({"dp": 2, "fsdp": 1, "tp": 2, "ep": 2})
+        params = moe.init_params(jax.random.key(0), cfg)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), moe.param_specs(cfg))
+        params = jax.device_put(params, shardings)
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+        batch = jax.device_put(
+            jax.random.randint(jax.random.key(2), (4, 33), 0, cfg.vocab_size),
+            NamedSharding(mesh, P(("dp", "fsdp"))))
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                logits, aux = moe.forward(p, batch[:, :-1], cfg)
+                lp = jax.nn.log_softmax(logits)
+                ce = -jnp.mean(jnp.take_along_axis(lp, batch[:, 1:, None], -1))
+                return ce + 0.01 * aux
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            u, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, u), opt_state, loss
+
+        losses = []
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+        # expert bank is genuinely sharded over ep (and tp)
+        wg = params["layers"]["w_gate"]
+        assert wg.addressable_shards[0].data.size * 4 == wg.size
+
+    def test_moe_params_superset_of_llama(self):
+        cfg = moe.tiny()
+        params = moe.init_params(jax.random.key(0), cfg)
+        assert "router" in params["layers"]
+        assert params["layers"]["w_gate"].shape[1] == cfg.n_experts
+        specs = moe.param_specs(cfg)
+        assert jax.tree.structure(params).num_leaves == \
+            jax.tree.structure(specs, is_leaf=lambda x: x is None or hasattr(x, "index")).num_leaves
