@@ -181,6 +181,19 @@ class RestClient:
         finally:
             conn.close()
 
+    def request_text(self, method: str, path: str) -> str:
+        """Raw-text request (pod logs endpoint returns plain text)."""
+        conn = self._connect()
+        try:
+            conn.request(method, path, headers=self._headers())
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                self._raise_for(resp.status, data)
+            return data.decode(errors="replace")
+        finally:
+            conn.close()
+
     @staticmethod
     def _raise_for(status: int, data: bytes):
         try:
@@ -388,6 +401,11 @@ class RestCluster:
     @property
     def podgroups(self) -> RestResourceStore:
         return self.resource("podgroups")
+
+    def read_pod_log(self, namespace: str, name: str) -> str:
+        """GET .../pods/{name}/log (plain text)."""
+        return self.client.request_text(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{name}/log")
 
     def check_crd_exists(self) -> bool:
         """server.go:201-213 — verify the PyTorchJob CRD is served.

@@ -27,7 +27,7 @@ _PATH_RE = re.compile(
     r"(?:/namespaces/(?P<ns>[^/]+))?"
     r"/(?P<plural>[^/]+)"
     r"(?:/(?P<name>[^/]+))?"
-    r"(?:/(?P<sub>status))?$"
+    r"(?:/(?P<sub>status|log))?$"
 )
 
 
@@ -81,8 +81,20 @@ class StubApiServer:
                 r = self._route()
                 if not r:
                     return
-                store, ns, name, _sub, q = r
+                store, ns, name, sub, q = r
                 try:
+                    if name and sub == "log":
+                        obj = store.get(ns, name)
+                        annotations = (obj.get("metadata") or {}).get(
+                            "annotations") or {}
+                        text = annotations.get(
+                            "fake.kubelet/logs", "").encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(text)))
+                        self.end_headers()
+                        self.wfile.write(text)
+                        return
                     if name:
                         self._send(200, store.get(ns, name))
                         return

@@ -41,7 +41,8 @@ def _to_wire(job: JobLike) -> dict:
 
 
 class _FakeBackend:
-    """Adapter over pytorch_operator_tpu.k8s.fake.FakeCluster."""
+    """Adapter over a cluster-shaped object: the in-memory FakeCluster or
+    the stdlib-HTTP RestCluster (both expose .jobs/.pods stores)."""
 
     def __init__(self, cluster):
         self.cluster = cluster
@@ -65,6 +66,8 @@ class _FakeBackend:
         return self.cluster.pods.list(namespace=namespace, label_selector=selector)
 
     def read_pod_log(self, namespace: str, name: str) -> str:
+        if hasattr(self.cluster, "read_pod_log"):  # RestCluster
+            return self.cluster.read_pod_log(namespace, name)
         pod = self.cluster.pods.get(namespace, name)
         annotations = (pod.get("metadata") or {}).get("annotations") or {}
         return annotations.get("fake.kubelet/logs", "")
@@ -143,15 +146,37 @@ class _KubeBackend:
 
 
 class PyTorchJobClient:
-    def __init__(self, cluster=None, config_file=None, context=None,
-                 client_configuration=None, persist_config=True):
-        """``cluster``: a FakeCluster for in-memory use; otherwise a real
-        Kubernetes connection is established (kubeconfig or in-cluster)."""
+    def __init__(self, cluster=None, master=None, config_file=None,
+                 context=None, client_configuration=None, persist_config=True):
+        """Backends, in order of precedence:
+
+        * ``cluster=`` — a FakeCluster or RestCluster instance;
+        * ``master=`` — an API server URL, served by the stdlib REST
+          client (no `kubernetes` package needed);
+        * otherwise — the `kubernetes` package with kubeconfig or
+          in-cluster auth, matching the reference client's constructor.
+          Falls back to the stdlib client when the package is absent.
+        """
         if cluster is not None:
             self._backend = _FakeBackend(cluster)
+        elif master is not None:
+            from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+            self._backend = _FakeBackend(
+                RestCluster(KubeConfig.from_url(master)))
         else:
-            self._backend = _KubeBackend(
-                config_file, context, client_configuration, persist_config)
+            try:
+                self._backend = _KubeBackend(
+                    config_file, context, client_configuration, persist_config)
+            except ImportError:
+                from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+                if utils.is_running_in_k8s() and not config_file:
+                    kube_config = KubeConfig.in_cluster()
+                else:
+                    kube_config = KubeConfig.from_kubeconfig(
+                        config_file or None, context)
+                self._backend = _FakeBackend(RestCluster(kube_config))
 
     # -- CRUD ---------------------------------------------------------------
 

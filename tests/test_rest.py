@@ -128,6 +128,33 @@ class TestRestWatch:
             scoped.close()
 
 
+class TestSdkOverHttp:
+    def test_sdk_master_url_backend(self, stub):
+        """SDK create->wait->logs over real HTTP, no kubernetes package."""
+        from pytorch_operator_tpu.sdk import PyTorchJobClient
+
+        backing: FakeCluster = stub.cluster
+        kubelet = FakeKubelet(backing)
+        kubelet.start()
+        ctl = PyTorchController(
+            RestCluster(KubeConfig("127.0.0.1", stub.port)),
+            config=JobControllerConfig(), registry=Registry())
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        client = PyTorchJobClient(master=f"http://127.0.0.1:{stub.port}")
+        try:
+            client.create(new_job(workers=1, name="sdk-http").to_dict())
+            client.wait_for_job("sdk-http", timeout_seconds=20,
+                                polling_interval=0.05)
+            assert client.is_job_succeeded("sdk-http")
+            logs = client.get_logs("sdk-http")
+            assert "accuracy=" in logs["sdk-http-master-0"]
+        finally:
+            stop.set()
+            ctl.work_queue.shutdown()
+            kubelet.stop()
+
+
 class TestOperatorOverHttp:
     def test_full_loop_over_rest(self, stub):
         """Controller + kubelet drive a job to Succeeded via real HTTP."""
