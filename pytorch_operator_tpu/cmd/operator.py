@@ -39,18 +39,58 @@ logger = logging.getLogger("pytorch-operator")
 
 
 class JsonFormatter(logging.Formatter):
-    """--json-log-format output for Stackdriver (reference main.go:55-58)."""
+    """--json-log-format output for Stackdriver (reference main.go:55-58).
+
+    Structured per-job fields (runtime/logger.py, the logger.go:26-80
+    equivalent) are merged into the entry so lines are filterable by
+    ``job``/``replica_type``/``pod``."""
 
     def format(self, record: logging.LogRecord) -> str:
+        from pytorch_operator_tpu.runtime.logger import STRUCTURED_FIELDS_ATTR
+
         entry = {
             "severity": record.levelname,
             "message": record.getMessage(),
             "logger": record.name,
             "filename": f"{record.filename}:{record.lineno}",
         }
+        fields = getattr(record, STRUCTURED_FIELDS_ATTR, None)
+        if fields:
+            for key, value in fields.items():
+                if value and key not in entry:
+                    entry[key] = value
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)
+
+
+class TextFormatter(logging.Formatter):
+    """Plain-text format with a ``key=value`` structured-field suffix."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from pytorch_operator_tpu.runtime.logger import format_fields
+
+        return super().format(record) + format_fields(record)
+
+
+def parse_duration(s: str) -> float:
+    """Go-style duration string to seconds: '12h', '30s', '1h30m', '45'."""
+    import re
+
+    s = (s or "").strip()
+    if not s:
+        return 0.0
+    if re.fullmatch(r"\d+(\.\d+)?", s):
+        return float(s)
+    # ms must precede m in the alternation or it can never match, and the
+    # whole string must be consumed or "500msgarbage" would silently parse
+    if not re.fullmatch(r"(\d+(?:\.\d+)?(?:ms|h|m|s))+", s):
+        raise ValueError(f"invalid duration {s!r}")
+    total = 0.0
+    for num, unit in re.findall(r"(\d+(?:\.\d+)?)(ms|h|m|s)", s):
+        total += float(num) * {"h": 3600.0, "m": 60.0, "s": 1.0,
+                               "ms": 0.001}[unit]
+    return total
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -74,6 +114,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-gang-scheduling", action="store_true",
                    help="create PodGroups and gang-schedule replica sets")
     p.add_argument("--gang-scheduler-name", default="volcano")
+    p.add_argument("--tpu-auto-gang", type=lambda s: s.lower() != "false",
+                   default=True, nargs="?", const=True,
+                   help="gang-schedule any job requesting google.com/tpu "
+                        "even without --enable-gang-scheduling (TPU slices "
+                        "are all-or-nothing); =false restores reference "
+                        "opt-in behavior")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for the /metrics endpoint (0 = disabled)")
     p.add_argument("--resync-period", "--resyc-period", dest="resync_period",
@@ -96,7 +142,7 @@ def setup_logging(json_format: bool) -> None:
     if json_format:
         handler.setFormatter(JsonFormatter())
     else:
-        handler.setFormatter(logging.Formatter(
+        handler.setFormatter(TextFormatter(
             "%(asctime)s %(levelname)s %(name)s %(message)s"))
     root = logging.getLogger()
     root.handlers[:] = [handler]
@@ -160,6 +206,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
         init_container_image=args.init_container_image,
+        tpu_auto_gang=args.tpu_auto_gang,
+        resync_period_seconds=parse_duration(args.resync_period),
     )
     controller = PyTorchController(cluster, config=config, registry=registry)
 

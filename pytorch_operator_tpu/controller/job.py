@@ -18,6 +18,7 @@ from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob
 from ..api.v1.validation import ValidationError
 from ..k8s.errors import ApiError, NotFoundError
+from ..runtime.logger import logger_for_job
 from ..runtime.recorder import EVENT_TYPE_WARNING
 from . import status as status_machine
 
@@ -43,7 +44,7 @@ class JobLifecycleMixin:
             return
 
         msg = f"PyTorchJob {job.metadata.name} is created."
-        self.logger.info(msg)
+        logger_for_job(self.logger, job).info(msg)
         status_machine.update_job_conditions(
             job.status, constants.JOB_CREATED, status_machine.JOB_CREATED_REASON, msg
         )
@@ -65,7 +66,7 @@ class JobLifecycleMixin:
     def mark_job_invalid(self, obj: dict, err: Exception) -> None:
         """Patch an invalid job's status to Failed (job.go:46-85)."""
         msg = f"Failed to unmarshal the object to PyTorchJob: Spec is invalid {err}"
-        self.logger.warning(msg)
+        logger_for_job(self.logger, obj).warning(msg)
         self.recorder.event(obj, EVENT_TYPE_WARNING, FAILED_MARSHAL_REASON, msg)
         status = {
             "conditions": [
@@ -88,7 +89,8 @@ class JobLifecycleMixin:
                 subresource="status",
             )
         except ApiError as patch_err:
-            self.logger.error("Could not update the PyTorchJob: %s", patch_err)
+            logger_for_job(self.logger, obj).error(
+                "Could not update the PyTorchJob: %s", patch_err)
 
     def update_job(self, old_obj: dict, new_obj: dict) -> None:
         """job.go:114-150: enqueue; reschedule the deadline wake-up when
@@ -109,7 +111,7 @@ class JobLifecycleMixin:
             start = parse_time(new_job.status.start_time) or time.time()
             passed = time.time() - start
             self.work_queue.add_after(new_job.key, new_ads - passed)
-            self.logger.info(
+            logger_for_job(self.logger, new_job).info(
                 "job ActiveDeadlineSeconds updated, will rsync after %s seconds",
                 new_ads - passed,
             )
@@ -160,7 +162,8 @@ class JobLifecycleMixin:
             try:
                 self.delete_job_handler(job)
             except ApiError as e:
-                self.logger.warning("Cleanup PyTorchJob error: %s", e)
+                logger_for_job(self.logger, job).warning(
+                    "Cleanup PyTorchJob error: %s", e)
                 raise
             return
         self.work_queue.add_after(job.key, remaining)

@@ -17,6 +17,7 @@ from ..api.v1.types import PyTorchJob, ReplicaSpec
 from ..k8s import serde
 from ..runtime.expectations import expectation_pods_key
 from ..runtime.job_controller import gen_general_name, gen_pod_group_name
+from ..runtime.logger import logger_for_pod, logger_for_replica
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from . import config as initconfig
 from . import status as status_machine
@@ -36,9 +37,15 @@ class PodReconcilerMixin:
         pods: List[dict],
         rtype: str,
         spec: ReplicaSpec,
+        gang_enabled: bool | None = None,
     ) -> None:
-        """pod.go:49-117."""
+        """pod.go:49-117.  ``gang_enabled`` lets the caller pass the
+        per-sync gang decision down; None recomputes (compat for direct
+        callers in tests)."""
+        if gang_enabled is None:
+            gang_enabled = self.gang_scheduling_enabled(job)
         rt = rtype.lower()
+        log = logger_for_replica(self.logger, job, rt)
         pods = self.filter_pods_for_replica_type(pods, rt)
         replicas = int(spec.replicas or 0)
         restart = False
@@ -48,11 +55,12 @@ class PodReconcilerMixin:
         pod_slices = self.get_pod_slices(pods, replicas)
         for index, pod_slice in enumerate(pod_slices):
             if len(pod_slice) > 1:
-                self.logger.warning("We have too many pods for %s %d", rt, index)
+                log.warning("We have too many pods for %s %d", rt, index)
             elif len(pod_slice) == 0:
-                self.logger.info("Need to create new pod: %s-%d", rt, index)
+                log.info("Need to create new pod: %s-%d", rt, index)
                 master_role = rtype == constants.REPLICA_TYPE_MASTER
-                self.create_new_pod(job, job_dict, rtype, str(index), spec, master_role)
+                self.create_new_pod(job, job_dict, rtype, str(index), spec,
+                                    master_role, gang_enabled=gang_enabled)
             else:
                 pod = pod_slice[0]
                 phase = (pod.get("status") or {}).get("phase")
@@ -72,7 +80,7 @@ class PodReconcilerMixin:
                                 exit_code,
                             )
                     if phase == "Failed" and train_util.is_retryable_exit_code(exit_code):
-                        self.logger.info(
+                        logger_for_pod(self.logger, pod, job).info(
                             "Need to restart the pod: %s", pod["metadata"].get("name")
                         )
                         self.pod_control.delete_pod(
@@ -94,8 +102,11 @@ class PodReconcilerMixin:
         index: str,
         spec: ReplicaSpec,
         master_role: bool,
+        gang_enabled: bool | None = None,
     ) -> None:
         """pod.go:140-232."""
+        if gang_enabled is None:
+            gang_enabled = self.gang_scheduling_enabled(job)
         rt = rtype.lower()
         job_key = job.key
         self.expectations.expect_creations(expectation_pods_key(job_key, rt), 1)
@@ -125,7 +136,7 @@ class PodReconcilerMixin:
                 "Restart policy in pod template will be overwritten by"
                 " restart policy in replica spec"
             )
-            self.logger.warning(msg)
+            logger_for_replica(self.logger, job, rt).warning(msg)
             self.recorder.event(
                 job_dict, EVENT_TYPE_WARNING, POD_TEMPLATE_RESTART_POLICY_REASON, msg
             )
@@ -140,13 +151,13 @@ class PodReconcilerMixin:
             )
             pod["spec"].setdefault("initContainers", []).extend(init_containers)
 
-        if self.config.enable_gang_scheduling:
+        if gang_enabled:
             if self._is_non_gang_scheduler_set(job):
                 msg = (
                     "Another scheduler is specified when gang-scheduling is"
                     " enabled and it will not be overwritten"
                 )
-                self.logger.warning(msg)
+                logger_for_replica(self.logger, job, rt).warning(msg)
                 self.recorder.event(
                     job_dict, EVENT_TYPE_WARNING, POD_TEMPLATE_SCHEDULER_NAME_REASON, msg
                 )

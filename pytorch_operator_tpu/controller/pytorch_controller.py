@@ -28,6 +28,7 @@ from ..runtime.expectations import (
 )
 from ..runtime.informer import Informer, split_meta_namespace_key
 from ..runtime.job_controller import JobController, JobControllerConfig
+from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from . import status as status_machine
 from .job import JobLifecycleMixin, get_total_failed_replicas, get_total_replicas, parse_time
@@ -47,7 +48,13 @@ class PyTorchController(
     ):
         super().__init__(cluster, config, recorder)
         self.logger = logging.getLogger(constants.CONTROLLER_NAME)
-        self.job_informer = Informer(cluster.jobs)
+        # Reference parity: the unstructured job informer resyncs every 30s
+        # (informer.go:24), factories every --resyc-period (options.go:24).
+        # When resync is disabled (0, the unit-test default) the job
+        # informer follows suit so tests stay deterministic.
+        factory_resync = self.config.resync_period_seconds
+        job_resync = min(30.0, factory_resync) if factory_resync > 0 else 0.0
+        self.job_informer = Informer(cluster.jobs, resync_period=job_resync)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
         )
@@ -71,6 +78,16 @@ class PyTorchController(
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
         self.delete_job_handler = self._delete_job
+
+    # -- gang policy -------------------------------------------------------
+    def gang_scheduling_enabled(self, job: PyTorchJob) -> bool:
+        """Gang semantics apply when the flag is set OR the job requests
+        TPU chips (tpu_env.job_requests_tpu — slices are all-or-nothing)."""
+        if self.config.enable_gang_scheduling:
+            return True
+        from .tpu_env import job_requests_tpu
+
+        return self.config.tpu_auto_gang and job_requests_tpu(job)
 
     # -- plumbing ----------------------------------------------------------
     def _job_from_unstructured(self, obj: dict) -> PyTorchJob:
@@ -122,7 +139,8 @@ class PyTorchController(
             if err is None and forget:
                 self.work_queue.forget(key)
             elif err is not None:
-                self.logger.warning("reconcile error for %s: %s", key, err)
+                logger_for_key(self.logger, key).warning(
+                    "reconcile error for %s: %s", key, err)
                 self.work_queue.add_rate_limited(key)
         finally:
             self.work_queue.done(key)
@@ -142,7 +160,8 @@ class PyTorchController(
             )
         obj = self._get_job_from_cache(namespace, name)
         if obj is None:
-            self.logger.info("PyTorchJob has been deleted: %s", key)
+            logger_for_key(self.logger, key).info(
+                "PyTorchJob has been deleted: %s", key)
             self.jobs_deleted_counter.inc()
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
@@ -151,7 +170,8 @@ class PyTorchController(
         try:
             job = self._job_from_unstructured(obj)
         except ValidationError as e:
-            self.logger.error("Failed to convert the PyTorchJob: %s", e)
+            logger_for_key(self.logger, key).error(
+                "Failed to convert the PyTorchJob: %s", e)
             # A job can also become invalid via an update after a valid
             # admission — mark it Failed here too, then stop reconciling.
             self.mark_job_invalid(obj, e)
@@ -166,7 +186,7 @@ class PyTorchController(
                 self.reconcile(job, obj)
             except Exception as e:  # reconcile errors requeue the job
                 err = e
-        self.logger.debug(
+        logger_for_key(self.logger, key).debug(
             "Finished syncing job %s (%.3fs)", key, time.monotonic() - start
         )
         if err is not None:
@@ -191,6 +211,9 @@ class PyTorchController(
         """controller.go:336-492."""
         job_key = job.key
         old_status = serde.deep_copy(job.status)
+        # computed once per sync: job_requests_tpu serializes every
+        # replica template, so don't re-ask at each branch / created pod
+        gang = self.gang_scheduling_enabled(job)
 
         pods = self.get_pods_for_job(job_dict)
         services = self.get_services_for_job(job_dict)
@@ -199,7 +222,7 @@ class PyTorchController(
         if status_machine.is_succeeded(job.status) or status_machine.is_failed(job.status):
             self.delete_pods_and_services(job, job_dict, pods, services)
             self.cleanup_job(job)
-            if self.config.enable_gang_scheduling:
+            if gang:
                 self.delete_pod_group(job_dict)
             if status_machine.is_succeeded(job.status):
                 for rtype in job.status.replica_statuses:
@@ -247,7 +270,7 @@ class PyTorchController(
         if job_exceeds_limit:
             self.delete_pods_and_services(job, job_dict, pods, services)
             self.cleanup_job(job)
-            if self.config.enable_gang_scheduling:
+            if gang:
                 self.delete_pod_group(job_dict)
             self.recorder.event(
                 job_dict, EVENT_TYPE_NORMAL, status_machine.JOB_FAILED_REASON, failure_message
@@ -260,10 +283,11 @@ class PyTorchController(
             )
             self.jobs_failed_counter.inc()
         else:
-            if self.config.enable_gang_scheduling:
+            if gang:
                 self.sync_pod_group(job_dict, get_total_replicas(job))
             for rtype, spec in job.spec.pytorch_replica_specs.items():
-                self.reconcile_pods(job, job_dict, pods, rtype, spec)
+                self.reconcile_pods(job, job_dict, pods, rtype, spec,
+                                    gang_enabled=gang)
                 # TPU deviation: services for EVERY replica type (the
                 # reference skips non-Master, controller.go:474-477) — all
                 # hosts need DNS for TPU_WORKER_HOSTNAMES.
@@ -284,7 +308,7 @@ class PyTorchController(
         if job.status.start_time is None:
             job.status.start_time = status_machine.now_iso()
             if job.spec.active_deadline_seconds is not None:
-                self.logger.info(
+                logger_for_job(self.logger, job).info(
                     "Job with ActiveDeadlineSeconds will sync after %s seconds",
                     job.spec.active_deadline_seconds,
                 )

@@ -16,6 +16,7 @@ from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
 from ..runtime.expectations import expectation_services_key
 from ..runtime.job_controller import gen_general_name
+from ..runtime.logger import logger_for_replica
 from .tpu_env import get_port_from_job
 
 
@@ -30,14 +31,15 @@ class ServiceReconcilerMixin:
     ) -> None:
         """service.go:36-71, generalized to any replica type."""
         rt = rtype.lower()
+        log = logger_for_replica(self.logger, job, rt)
         services = self.filter_services_for_replica_type(services, rt)
         replicas = int(spec.replicas or 0)
         service_slices = self.get_service_slices(services, replicas)
         for index, service_slice in enumerate(service_slices):
             if len(service_slice) > 1:
-                self.logger.warning("We have too many services for %s %d", rt, index)
+                log.warning("We have too many services for %s %d", rt, index)
             elif len(service_slice) == 0:
-                self.logger.info("Need to create new service: %s-%d", rt, index)
+                log.info("Need to create new service: %s-%d", rt, index)
                 self.create_new_service(job, job_dict, rtype, str(index))
 
     def create_new_service(

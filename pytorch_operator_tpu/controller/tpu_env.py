@@ -142,3 +142,19 @@ def requests_tpu(pod_template: dict) -> bool:
             if constants.TPU_RESOURCE in (resources.get(section) or {}):
                 return True
     return False
+
+
+def job_requests_tpu(job: PyTorchJob) -> bool:
+    """True when any replica's containers request google.com/tpu.
+
+    TPU slices are all-or-nothing: a partially scheduled job deadlocks the
+    slice (SURVEY.md §2.4/§7 hard parts), so the controller treats any TPU
+    job as a gang even when ``--enable-gang-scheduling`` is unset (the
+    reference keeps gang opt-in, options.go:73 — safe on GPU, not here).
+    """
+    from ..k8s import serde
+
+    return any(
+        requests_tpu(serde.to_dict(spec.template))
+        for spec in job.spec.pytorch_replica_specs.values()
+    )

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import base64
 import http.client
+from http.client import HTTPException
 import json
 import os
 import ssl
@@ -319,9 +320,28 @@ class RestResourceStore:
         while not self._watch_stop.is_set():
             try:
                 rv = self._watch_once(rv)
-            except (OSError, ApiError, ValueError):
+                # Clean EOF (server-side watch timeout, routine every few
+                # minutes on kube-apiserver): the next stream resumes from
+                # the last seen resourceVersion, so nothing is lost and no
+                # relist is needed — emitting GAP here would turn healthy
+                # watch churn into steady-state full LISTs.
+            except (OSError, ApiError, ValueError, HTTPException):
                 self._watch_stop.wait(1.0)
                 rv = ""  # restart from 'most recent' after an error
+                # Events delivered during the outage (DELETEDs especially)
+                # are gone for good at this point — tell listeners so
+                # informers can re-list and diff (client-go relists on
+                # watch failure; the reference additionally resyncs every
+                # 30s/12h, informer.go:24 / options.go:24).
+                if not self._watch_stop.is_set():
+                    self._notify_gap()
+
+    def _notify_gap(self) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn("GAP", {})
+            except Exception:
+                pass
 
     def _watch_once(self, rv: str) -> str:
         q = "watch=true&allowWatchBookmarks=true"

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import queue
 import re
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -35,6 +36,10 @@ class StubApiServer:
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.cluster = cluster if cluster is not None else FakeCluster()
+        # Test hook: while set, active watch streams terminate and new watch
+        # requests are refused with 500, simulating an API-server outage /
+        # network partition so watch-gap healing can be exercised.
+        self._drop_watch = threading.Event()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,6 +104,9 @@ class StubApiServer:
                         self._send(200, store.get(ns, name))
                         return
                     if q.get("watch", ["false"])[0] == "true":
+                        if outer._drop_watch.is_set():
+                            self._send(500, {"message": "watch unavailable"})
+                            return
                         self._watch(store)
                         return
                     selector = None
@@ -120,7 +128,8 @@ class StubApiServer:
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
-                    while not outer._stopping.is_set():
+                    while not (outer._stopping.is_set()
+                               or outer._drop_watch.is_set()):
                         try:
                             et, obj = events.get(timeout=0.2)
                         except queue.Empty:
@@ -134,6 +143,14 @@ class StubApiServer:
                     pass
                 finally:
                     store.remove_listener(listener)
+                    # terminate the stream for real: without this the
+                    # keep-alive socket stays open and the client blocks in
+                    # read1() forever, never noticing the watch ended
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
 
             def do_POST(self):
                 r = self._route()
@@ -195,6 +212,15 @@ class StubApiServer:
     def stop(self) -> None:
         self._stopping.set()
         self.server.shutdown()
+
+    def drop_watches(self) -> None:
+        """Terminate active watch streams and refuse new ones (simulated
+        API-server outage); CRUD keeps working so state can change during
+        the gap."""
+        self._drop_watch.set()
+
+    def resume_watches(self) -> None:
+        self._drop_watch.clear()
 
 
 def main() -> int:

@@ -1,15 +1,37 @@
-"""Fused causal flash attention (Pallas TPU kernel).
+"""Fused causal flash attention (Pallas TPU kernels, fwd + bwd).
 
-Forward pass streams K/V blocks through VMEM with an online softmax
-(running max + running denominator), so the (T, T) score matrix never
-materialises in HBM — the standard flash recipe mapped onto the MXU
-with (block_q x d) @ (d x block_k) tiles.  The backward pass is a
-rematerialising custom VJP: recompute attention probabilities blockwise
-in plain XLA ops (which fuse well) rather than storing them.
+Forward pass streams K/V blocks through VMEM via a third grid dimension
+with an online softmax (running max + running denominator), so neither
+the (T, T) score matrix nor the full K/V sequence ever sits in VMEM —
+usable T is bounded by HBM, not the ~16MB VMEM.  Tiles are
+(block_q x d) @ (d x block_k) MXU matmuls with f32 accumulation.
+
+Backward pass is the FlashAttention-2 recipe as two blockwise Pallas
+kernels (O(T) memory, no (T, T) buffer):
+
+  dq kernel  — grid (BH, n_q, n_k):  dq[i] = sum_j ds[i,j] @ K[j]
+  dkv kernel — grid (BH, n_k, n_q):  dk[j] = sum_i ds[i,j]^T @ Q[i],
+                                     dv[j] = sum_i  p[i,j]^T @ dO[i]
+
+where p is recomputed blockwise from the saved per-row logsumexp
+(lse = m + log l) and ds = p * (dp - delta) * scale with
+delta = rowsum(dO * O) computed once in plain XLA.
+
+Layout note: inside the backward kernels every score-shaped tile is kept
+*transposed* — (block_k sublanes, block_q lanes) — so the q-indexed
+row vectors (lse, delta, stored as (1, block_q) blocks) broadcast along
+lanes without any cross-lane reshape; the only sublane<->lane transpose
+in the whole pipeline is the (block_q, 1) -> (1, block_q) lse write at
+the end of the forward.
 
 Falls back to a dense jnp implementation for shapes that don't tile
 (seq not a multiple of the block size) or when Pallas is unavailable;
-``interpret=True`` runs the same kernel on CPU test meshes.
+``interpret=True`` runs the same kernels on CPU test meshes.
+
+Reference parity note: the reference operator has no attention kernels
+at all (its data plane is examples/mnist/mnist.py); this module is part
+of the TPU-native data plane that replaces the reference's CUDA-backed
+torch ops.
 """
 
 from __future__ import annotations
@@ -33,56 +55,78 @@ def _dense_reference(q, k, v, scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale, causal):
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, block_q, block_k, scale, causal):
     import jax.experimental.pallas as pl
 
     i = pl.program_id(1)
-    q = q_ref[0]                                      # (block_q, d), native dtype
-    d = q.shape[-1]
-    seq_k = k_ref.shape[1]
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]                                  # (block_q, d)
+        k = k_ref[0]                                  # (block_k, d)
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (block_q, block_k)
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_scr[...]                           # (block_q, 1)
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # blocks strictly above the diagonal contribute nothing
-        num_kb = lax.div(i * block_q + block_q + block_k - 1, block_k)
+        pl.when(j * block_k <= i * block_q + block_q - 1)(_compute)
     else:
-        num_kb = seq_k // block_k
+        _compute()
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        # bf16 x bf16 on the MXU, f32 accumulation
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-        if causal:
-            qpos = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
-
-    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[...] + jnp.log(l_safe)            # (block_q, 1)
+        lse_ref[0] = jnp.transpose(lse)               # (1, block_q)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """Returns (out (BH,T,D), lse (BH,1,T) f32).
+
+    lse is stored (BH, 1, T) — q positions in the *lane* dimension — so
+    both the forward write and the backward reads use (1, 1, block_q)
+    blocks, which satisfy the mosaic block-shape rule (last two dims
+    divisible by (8, 128) or equal to the array's) without replicating
+    across 128 lanes the way jax's bundled kernel does.
+    """
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
     BH, T, D = q.shape
-    grid = (BH, T // block_q)
+    grid = (BH, T // block_q, T // block_k)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k,
         scale=scale, causal=causal)
@@ -90,41 +134,217 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
 
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _transposed_probs(q_ref, k_ref, lse_ref, i, j, block_q, block_k, scale,
+                      causal):
+    """Recompute p^T = exp(s^T - lse) for one (i, j) tile.
+
+    Returns (block_k, block_q) f32 with q rows in *lanes* so the
+    (1, block_q) lse/delta blocks broadcast without reshapes.
+    """
+    q = q_ref[0]                                      # (block_q, d)
+    k = k_ref[0]                                      # (block_k, d)
+    s_t = lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # (block_k, block_q)
+    if causal:
+        kpos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        qpos = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        s_t = jnp.where(qpos >= kpos, s_t, NEG_INF)
+    return jnp.exp(s_t - lse_ref[0])                  # (block_k, block_q)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, block_q, block_k, scale, causal):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
+                                block_q, block_k, scale, causal)
+        v = v_ref[0]
+        do = do_ref[0]
+        dp_t = lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, block_q)
+        ds_t = p_t * (dp_t - delta_ref[0]) * scale    # (block_k, block_q)
+        # dq[i] += ds[i,j] @ K[j]  ==  ds_t^T @ K  (contract sublanes)
+        dq_scr[...] += lax.dot_general(
+            ds_t.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_q, d)
+
+    if causal:
+        pl.when(j * block_k <= i * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, block_q, block_k, scale, causal):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)   # k block (outer)
+    i = pl.program_id(2)   # q block (inner, accumulated)
+    n_q = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
+                                block_q, block_k, scale, causal)
+        do = do_ref[0]                                # (block_q, d)
+        # dv[j] += p[i,j]^T @ dO[i]
+        dv_scr[...] += lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, d)
+        dp_t = lax.dot_general(
+            v_ref[0], do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, block_q)
+        ds_t = p_t * (dp_t - delta_ref[0]) * scale
+        # dk[j] += ds[i,j]^T @ Q[i]
+        dk_scr[...] += lax.dot_general(
+            ds_t.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, d)
+
+    if causal:
+        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+               interpret):
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    BH, T, D = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]              # (BH, 1, T) f32
+    n_q, n_k = T // block_q, T // block_k
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(BH, n_q, n_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dkv grid walks (b, k-block, q-block): q is the accumulated inner dim
+    qT_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kT_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowT_spec = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(BH, n_k, n_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    # rematerialised dense backward; XLA fuses the softmax chain
-    q, k, v = res
-
-    def f(q, k, v):
-        return _dense_reference(q, k, v, scale, causal)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                      block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -141,7 +361,7 @@ def flash_attention(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal attention over (B, T, H, D) inputs (same-H q/k/v; repeat KV
-    for GQA before calling).  Dispatches to the Pallas kernel when the
+    for GQA before calling).  Dispatches to the Pallas kernels when the
     sequence tiles evenly, dense XLA otherwise."""
     B, T, H, D = q.shape
     scale = D ** -0.5

@@ -17,8 +17,11 @@ client's watcher satisfy it.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
 
 
 def meta_namespace_key(obj: dict) -> str:
@@ -78,13 +81,39 @@ class EventHandlers:
 
 
 class Informer:
-    def __init__(self, source):
+    """``resync_period`` > 0 starts a background thread that periodically
+    re-LISTs the source and diffs it against the store (client-go's
+    periodic resync — reference informer.go:24 uses 30s for the job
+    informer, options.go:24 12h for factories).  The diff emits synthetic
+    ADDED/MODIFIED/DELETED callbacks for divergence, healing events lost
+    while a watch stream was down; unchanged objects still fire the update
+    handlers, matching client-go resync semantics (this is what gives the
+    reference its periodic reconcile, controller.go:129)."""
+
+    def __init__(self, source, resync_period: float = 0.0):
         self._source = source
         self.store = Store()
         self._handlers = EventHandlers()
         self._synced = False
         self._started = False
         self._lock = threading.Lock()
+        self._resync_period = resync_period
+        self._resync_stop = threading.Event()
+        self._resync_thread: Optional[threading.Thread] = None
+        # Serializes store mutation: a resync's diff must not interleave
+        # with watch-event application, or a DELETED arriving between the
+        # LIST snapshot and the diff would be undone (the resync re-adds
+        # the deleted object and nothing ever removes it again until the
+        # next tick).  The LIST itself happens OUTSIDE this lock — sources
+        # deliver watch events from under their own lock (FakeResourceStore
+        # notifies listeners holding its RLock), so lock-ordering would
+        # invert and deadlock; staleness is instead detected with
+        # _mutation_seq and the diff retried.  RLock, not Lock: handlers
+        # run under this lock and may mutate the source synchronously
+        # (e.g. add_job patches job status; the fake store then notifies
+        # this same informer on the same thread), which must re-enter.
+        self._apply_lock = threading.RLock()
+        self._mutation_seq = 0
 
     # -- registration ------------------------------------------------------
     def add_event_handler(
@@ -119,8 +148,13 @@ class Informer:
             for fn in self._handlers.add_funcs:
                 fn(obj)
         self._synced = True
+        if self._resync_period > 0 and self._resync_thread is None:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, daemon=True)
+            self._resync_thread.start()
 
     def stop(self) -> None:
+        self._resync_stop.set()
         try:
             self._source.remove_listener(self._on_watch_event)
         except Exception:
@@ -129,24 +163,87 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced
 
+    # -- resync ------------------------------------------------------------
+    def _resync_loop(self) -> None:
+        while not self._resync_stop.wait(self._resync_period):
+            try:
+                self.resync()
+            except Exception:
+                # transient LIST failure or a handler bug mid-diff; the
+                # next tick retries either way, but never silently
+                _log.warning("informer resync failed", exc_info=True)
+
+    def resync(self) -> None:
+        """Diff a fresh LIST against the store and fire synthetic events.
+
+        Heals a cache that diverged while the watch stream was down: a
+        missed DELETED shows up as a store key absent from the fresh list,
+        a missed ADDED as a fresh key absent from the store, a missed
+        MODIFIED as a resourceVersion mismatch.  Unchanged objects fire
+        update handlers with (obj, obj) — client-go resync behavior, which
+        re-enqueues every job periodically (the pod handler drops
+        identical-resourceVersion updates, so no event storm).
+
+        The LIST snapshot is taken without holding the apply lock (see
+        the lock-ordering note in __init__); if watch events land between
+        the snapshot and the diff, the snapshot is stale — applying it
+        could resurrect a just-deleted object — so the diff aborts and
+        retries with a fresh LIST.  When the watch is down (the very case
+        resync exists to heal) no events flow and the first attempt
+        applies."""
+        for _attempt in range(3):
+            start_seq = self._mutation_seq
+            fresh = {meta_namespace_key(o): o for o in self._source.list()}
+            with self._apply_lock:
+                if self._mutation_seq != start_seq:
+                    continue  # events interleaved with the LIST; retry
+                stale_keys = [k for k in self.store.keys() if k not in fresh]
+                for key, obj in fresh.items():
+                    cur = self.store.get_by_key(key)
+                    if cur is None:
+                        self.store.add(obj)
+                        for fn in self._handlers.add_funcs:
+                            fn(obj)
+                    else:
+                        self.store.update(obj)
+                        for fn in self._handlers.update_funcs:
+                            fn(cur, obj)
+                for key in stale_keys:
+                    cur = self.store.get_by_key(key)
+                    if cur is not None:
+                        self.store.delete(cur)
+                        for fn in self._handlers.delete_funcs:
+                            fn(cur)
+                return
+        # busy stream all 3 attempts: the watch is clearly alive, so the
+        # cache is converging through events anyway; next tick retries
+
     # -- watch plumbing ----------------------------------------------------
     def _on_watch_event(self, event_type: str, obj: dict) -> None:
+        if event_type == "GAP":
+            # the source's watch stream broke and restarted from "now":
+            # events in the gap are lost — re-list and diff immediately
+            if self._synced:
+                self.resync()
+            return
         key = meta_namespace_key(obj)
-        if event_type == "ADDED":
-            existing = self.store.get_by_key(key)
-            if existing is not None and (existing.get("metadata") or {}).get(
-                "resourceVersion"
-            ) == (obj.get("metadata") or {}).get("resourceVersion"):
-                return  # already delivered via the initial list
-            self.store.add(obj)
-            for fn in self._handlers.add_funcs:
-                fn(obj)
-        elif event_type == "MODIFIED":
-            old = self.store.get_by_key(key)
-            self.store.update(obj)
-            for fn in self._handlers.update_funcs:
-                fn(old if old is not None else obj, obj)
-        elif event_type == "DELETED":
-            self.store.delete(obj)
-            for fn in self._handlers.delete_funcs:
-                fn(obj)
+        with self._apply_lock:
+            self._mutation_seq += 1
+            if event_type == "ADDED":
+                existing = self.store.get_by_key(key)
+                if existing is not None and (existing.get("metadata") or {}).get(
+                    "resourceVersion"
+                ) == (obj.get("metadata") or {}).get("resourceVersion"):
+                    return  # already delivered via the initial list
+                self.store.add(obj)
+                for fn in self._handlers.add_funcs:
+                    fn(obj)
+            elif event_type == "MODIFIED":
+                old = self.store.get_by_key(key)
+                self.store.update(obj)
+                for fn in self._handlers.update_funcs:
+                    fn(old if old is not None else obj, obj)
+            elif event_type == "DELETED":
+                self.store.delete(obj)
+                for fn in self._handlers.delete_funcs:
+                    fn(obj)

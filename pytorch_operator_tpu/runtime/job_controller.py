@@ -54,10 +54,22 @@ class JobControllerConfig:
         enable_gang_scheduling: bool = False,
         gang_scheduler_name: str = "volcano",
         init_container_image: str = "alpine:3.10",
+        tpu_auto_gang: bool = True,
+        resync_period_seconds: float = 0.0,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
         self.init_container_image = init_container_image
+        # Periodic informer relist-and-diff (reference --resyc-period,
+        # options.go:24, default 12h; the job informer additionally resyncs
+        # every 30s, informer.go:24).  0 disables (unit-test default);
+        # the CLI passes the parsed flag value.
+        self.resync_period_seconds = resync_period_seconds
+        # TPU-first deviation from the reference (options.go:73 keeps gang
+        # opt-in): jobs requesting google.com/tpu get gang semantics even
+        # with enable_gang_scheduling False, because a partially scheduled
+        # TPU slice deadlocks.  Set False to restore reference behavior.
+        self.tpu_auto_gang = tpu_auto_gang
 
 
 def _make_runtime_core():
@@ -109,8 +121,9 @@ class JobController:
         self.pod_control = PodControl(cluster.pods, self.recorder)
         self.service_control = ServiceControl(cluster.services, self.recorder)
         self.expectations, self.work_queue = _make_runtime_core()
-        self.pod_informer = Informer(cluster.pods)
-        self.service_informer = Informer(cluster.services)
+        resync = self.config.resync_period_seconds
+        self.pod_informer = Informer(cluster.pods, resync_period=resync)
+        self.service_informer = Informer(cluster.services, resync_period=resync)
         self._stop = threading.Event()
 
         self.pod_informer.add_event_handler(
@@ -309,7 +322,16 @@ class JobController:
         name = gen_pod_group_name(meta.get("name", ""))
         namespace = meta.get("namespace", "default")
         try:
-            return self.cluster.podgroups.get(namespace, name)
+            pg = self.cluster.podgroups.get(namespace, name)
+            # Replicas resized after creation: keep minMember equal to the
+            # current total or the gang constraint silently goes stale
+            # (the reference never updates it — jobcontroller.go:233-248
+            # creates once and returns the cached group forever).
+            if int((pg.get("spec") or {}).get("minMember") or 0) != min_available:
+                pg = self.cluster.podgroups.patch(
+                    namespace, name, {"spec": {"minMember": min_available}}
+                )
+            return pg
         except NotFoundError:
             pass
         ref = serde.to_dict(self.gen_owner_reference(job))

