@@ -41,6 +41,21 @@ def main() -> int:
     parser.add_argument("--dp", type=int, default=0, help="0 = auto")
     parser.add_argument("--fsdp", type=int, default=0)
     parser.add_argument("--tp", type=int, default=0)
+    parser.add_argument("--pp", type=int, default=0,
+                        help="pipeline-parallel stages (uses the GPipe "
+                             "path; must equal the device count)")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="GPipe microbatches when --pp is set")
+    # the Pallas kernels ARE the shipped fast path; flags exist to opt out
+    parser.add_argument("--no-flash", dest="use_flash", action="store_false",
+                        help="disable the Pallas flash-attention kernel")
+    parser.add_argument("--no-fused-norm", dest="use_fused_norm",
+                        action="store_false",
+                        help="disable the Pallas fused RMSNorm kernel")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="capture a TensorBoard-loadable XLA trace of "
+                             "steps 2..--profile-steps into this directory")
+    parser.add_argument("--profile-steps", type=int, default=5)
     parser.add_argument("--checkpoint-dir", type=str, default=None)
     parser.add_argument("--checkpoint-every", type=int, default=100)
     parser.add_argument("--log-interval", type=int, default=5)
@@ -59,31 +74,49 @@ def main() -> int:
 
     from pytorch_operator_tpu.models import llama
     from pytorch_operator_tpu.parallel import (
-        factor_devices, make_mesh, make_train_step, sharded_init,
+        factor_devices, make_mesh, make_named_mesh, make_pp_train_step,
+        make_train_step, sharded_init,
     )
 
     n = len(jax.devices())
-    flags = (args.dp, args.fsdp, args.tp)
-    if all(flags):
-        dp, fsdp, tp = flags
-        if dp * fsdp * tp != n:
-            parser.error(f"--dp*--fsdp*--tp = {dp * fsdp * tp} != {n} devices")
-    elif any(flags):
-        parser.error("--dp/--fsdp/--tp must be given together (or none)")
-    else:
-        dp, fsdp, tp = factor_devices(n, tp_max=4)
-    mesh = make_mesh(dp, fsdp, tp)
-    print(f"[worker {pid}/{nprocs}] mesh dp={dp} fsdp={fsdp} tp={tp} "
-          f"over {n} devices", flush=True)
-
+    kernel_kw = dict(use_flash=args.use_flash,
+                     use_fused_norm=args.use_fused_norm)
     if args.model == "7b":
-        cfg = llama.llama2_7b(max_seq_len=args.seq_len, remat=True)
+        cfg = llama.llama2_7b(max_seq_len=args.seq_len, remat=True,
+                              **kernel_kw)
     else:
-        cfg = llama.tiny(max_seq_len=args.seq_len, remat=True)
+        cfg = llama.tiny(max_seq_len=args.seq_len, remat=True, **kernel_kw)
 
     optimizer = optax.adamw(args.lr, weight_decay=0.1)
-    state = sharded_init(cfg, mesh, optimizer)
-    step_fn = make_train_step(cfg, mesh, optimizer)
+    if args.pp:
+        if args.pp != n:
+            parser.error(f"--pp {args.pp} != {n} devices")
+        if cfg.n_layers % args.pp:
+            parser.error(f"n_layers {cfg.n_layers} not divisible by --pp")
+        mesh = make_named_mesh({"pp": args.pp})
+        print(f"[worker {pid}/{nprocs}] GPipe mesh pp={args.pp} "
+              f"microbatches={args.microbatches} over {n} devices",
+              flush=True)
+        state = sharded_init(cfg, mesh, optimizer,
+                             specs=llama.pp_param_specs(cfg))
+        step_fn = make_pp_train_step(cfg, mesh, optimizer,
+                                     n_microbatches=args.microbatches)
+    else:
+        flags = (args.dp, args.fsdp, args.tp)
+        if all(flags):
+            dp, fsdp, tp = flags
+            if dp * fsdp * tp != n:
+                parser.error(
+                    f"--dp*--fsdp*--tp = {dp * fsdp * tp} != {n} devices")
+        elif any(flags):
+            parser.error("--dp/--fsdp/--tp must be given together (or none)")
+        else:
+            dp, fsdp, tp = factor_devices(n, tp_max=4)
+        mesh = make_mesh(dp, fsdp, tp)
+        print(f"[worker {pid}/{nprocs}] mesh dp={dp} fsdp={fsdp} tp={tp} "
+              f"over {n} devices", flush=True)
+        state = sharded_init(cfg, mesh, optimizer)
+        step_fn = make_train_step(cfg, mesh, optimizer)
 
     start_step = 0
     if args.checkpoint_dir:
@@ -101,14 +134,27 @@ def main() -> int:
             print(f"restored checkpoint at step {latest}", flush=True)
 
     tokens_per_step = args.batch_size * args.seq_len
+    # --profile-dir: trace steps [start+1, start+profile_steps] — step 0 is
+    # excluded so compilation doesn't drown the trace (SURVEY.md §5 asks
+    # for the jax.profiler equivalent of the reference's cAdvisor docs;
+    # load with: tensorboard --logdir <profile-dir>)
+    profiling = False
     t0 = time.perf_counter()
     for i in range(start_step, args.steps):
+        if args.profile_dir and i == start_step + 1:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
         # synthetic LM batch, seeded per step index so a checkpoint resume
         # continues the data stream instead of replaying it
         batch = np.random.default_rng(i).integers(
             0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)
         ).astype(np.int32)
         state, metrics = step_fn(state, batch)
+        if profiling and i == start_step + args.profile_steps:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profile trace written to {args.profile_dir}", flush=True)
         if i % args.log_interval == 0 or i == args.steps - 1:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
@@ -123,6 +169,8 @@ def main() -> int:
             mngr.wait_until_finished()
             print(f"checkpointed step {i + 1}", flush=True)
 
+    if profiling:
+        jax.profiler.stop_trace()
     print("training complete", flush=True)
     return 0
 

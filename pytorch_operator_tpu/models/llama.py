@@ -241,3 +241,68 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
 def activation_spec() -> P:
     """Spec for (B, T, D) activations under the (dp, fsdp, tp) mesh."""
     return P((AXIS_DP, AXIS_FSDP), None, AXIS_TP)
+
+
+def forward_pipelined(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Pipeline-parallel forward: the decoder stack runs as GPipe stages.
+
+    The layer stack (leading n_layers axis) is sharded over ``axis_name``
+    — each stage holds n_layers/S consecutive decoder blocks — and
+    microbatches march through parallel.pipeline.pipeline_apply's
+    ppermute ring.  Embedding, final norm and the tied output head run
+    replicated outside the pipeline.  Differentiable end to end (reverse
+    mode flows back through the ppermutes), so the same path trains —
+    see parallel.train.make_pp_train_step.
+    """
+    from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
+
+    T = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_table(cfg, T)
+
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def stage_fn(layers_local, h):
+        def scan_fn(h, lp):
+            return body(h, lp), None
+
+        return lax.scan(scan_fn, h, layers_local)[0]
+
+    h = pipeline_apply(
+        params["layers"], h, stage_fn, mesh,
+        n_microbatches=n_microbatches, axis_name=axis_name,
+    )
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
+
+
+def pp_param_specs(cfg: LlamaConfig, axis_name: str = "pp") -> Params:
+    """PartitionSpec tree for the pipeline layout: the layer stack is
+    sharded over the pp axis (stage = contiguous layer slice); embedding
+    and final norm replicate."""
+    del cfg
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(axis_name, None),
+            "wq": P(axis_name, None, None),
+            "wk": P(axis_name, None, None),
+            "wv": P(axis_name, None, None),
+            "wo": P(axis_name, None, None),
+            "mlp_norm": P(axis_name, None),
+            "w_gate": P(axis_name, None, None),
+            "w_up": P(axis_name, None, None),
+            "w_down": P(axis_name, None, None),
+        },
+        "final_norm": P(None),
+    }

@@ -26,6 +26,7 @@ from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
 from pytorch_operator_tpu.parallel.ring_attention import ring_attention
 from pytorch_operator_tpu.parallel.train import (
     cross_entropy_loss,
+    make_pp_train_step,
     make_train_step,
     sharded_init,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "pipeline_apply",
     "ring_attention",
     "cross_entropy_loss",
+    "make_pp_train_step",
     "make_train_step",
     "sharded_init",
 ]

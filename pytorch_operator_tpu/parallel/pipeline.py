@@ -105,5 +105,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(params_spec, P()),
         out_specs=P(),  # psum in the body makes the output truly replicated
+        # replication is established explicitly (pvary on carries, psum on
+        # the output); the vma checker also rejects jax.checkpoint-wrapped
+        # stage bodies (rematerialised Llama stages) outright
+        check_vma=False,
     )(params_stacked, x_mb)
     return out_mb.reshape(B, *x.shape[1:])

@@ -41,14 +41,18 @@ def sharded_init(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     seed: int = 0,
+    specs: Any = None,
 ) -> TrainState:
     """Initialise params + opt state directly into their shardings.
 
     jit with out_shardings means each device materialises only its own
     parameter shard — no host-side full copy, which is what lets 7B+
-    configs initialise on a v5p slice.
+    configs initialise on a v5p slice.  ``specs`` defaults to the
+    (dp, fsdp, tp) layout; pass llama.pp_param_specs(cfg) for the
+    pipeline layout.
     """
-    specs = llama.param_specs(cfg)
+    if specs is None:
+        specs = llama.param_specs(cfg)
     p_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
     replicated = NamedSharding(mesh, P())
 
@@ -102,6 +106,47 @@ def make_train_step(
     def loss_fn(params, batch):
         inputs, targets = batch[:, :-1], batch[:, 1:]
         logits = llama.forward(params, inputs, cfg)
+        return cross_entropy_loss(logits, targets)
+
+    def step(state: TrainState, batch: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(params, opt_state, state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(
+        step,
+        in_shardings=(None, data_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_pp_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pp",
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """Jitted training step through the GPipe pipeline.
+
+    The forward runs llama.forward_pipelined (decoder stack sharded over
+    the pp axis, microbatches through the ppermute ring); reverse mode
+    differentiates through the ppermutes so gradients flow stage-to-stage
+    the way the activations came.  Pair with
+    ``sharded_init(..., specs=llama.pp_param_specs(cfg))``.
+    """
+    data_sharding = NamedSharding(mesh, P())  # stage 0 consumes the batch
+
+    def loss_fn(params, batch):
+        inputs, targets = batch[:, :-1], batch[:, 1:]
+        logits = llama.forward_pipelined(
+            params, inputs, cfg, mesh,
+            n_microbatches=n_microbatches, axis_name=axis_name,
+        )
         return cross_entropy_loss(logits, targets)
 
     def step(state: TrainState, batch: jax.Array):
