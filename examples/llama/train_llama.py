@@ -46,9 +46,19 @@ def main() -> int:
                              "path; must equal the device count)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches when --pp is set")
-    # the Pallas kernels ARE the shipped fast path; flags exist to opt out
+    # The Pallas kernels ARE the shipped fast path on TPU; off-TPU the
+    # unset default resolves to False (interpret-mode Pallas is a
+    # debugging path that would make CPU smoke runs crawl).
+    parser.add_argument("--flash", dest="use_flash", action="store_true",
+                        default=None,
+                        help="force the Pallas flash-attention kernel "
+                             "(default: on for TPU backends)")
     parser.add_argument("--no-flash", dest="use_flash", action="store_false",
                         help="disable the Pallas flash-attention kernel")
+    parser.add_argument("--fused-norm", dest="use_fused_norm",
+                        action="store_true", default=None,
+                        help="force the Pallas fused RMSNorm kernel "
+                             "(default: on for TPU backends)")
     parser.add_argument("--no-fused-norm", dest="use_fused_norm",
                         action="store_false",
                         help="disable the Pallas fused RMSNorm kernel")
@@ -79,8 +89,12 @@ def main() -> int:
     )
 
     n = len(jax.devices())
-    kernel_kw = dict(use_flash=args.use_flash,
-                     use_fused_norm=args.use_fused_norm)
+    on_tpu = jax.default_backend() == "tpu"
+    kernel_kw = dict(
+        use_flash=on_tpu if args.use_flash is None else args.use_flash,
+        use_fused_norm=(on_tpu if args.use_fused_norm is None
+                        else args.use_fused_norm),
+    )
     if args.model == "7b":
         cfg = llama.llama2_7b(max_seq_len=args.seq_len, remat=True,
                               **kernel_kw)
@@ -89,6 +103,9 @@ def main() -> int:
 
     optimizer = optax.adamw(args.lr, weight_decay=0.1)
     if args.pp:
+        if args.dp or args.fsdp or args.tp:
+            parser.error("--pp is a pure GPipe layout; it cannot be "
+                         "combined with --dp/--fsdp/--tp")
         if args.pp != n:
             parser.error(f"--pp {args.pp} != {n} devices")
         if cfg.n_layers % args.pp:
@@ -141,7 +158,7 @@ def main() -> int:
     profiling = False
     t0 = time.perf_counter()
     for i in range(start_step, args.steps):
-        if args.profile_dir and i == start_step + 1:
+        if args.profile_dir and args.profile_steps >= 1 and i == start_step + 1:
             jax.profiler.start_trace(args.profile_dir)
             profiling = True
         # synthetic LM batch, seeded per step index so a checkpoint resume

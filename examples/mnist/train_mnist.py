@@ -44,6 +44,11 @@ def main() -> int:
     parser.add_argument("--target-accuracy", type=float, default=0.0,
                         help="exit once test accuracy reaches this")
     parser.add_argument("--save-model", type=str, default=None)
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="capture a jax.profiler trace of the first "
+                             "epoch's steps 1..--profile-steps here "
+                             "(view: tensorboard --logdir <dir>)")
+    parser.add_argument("--profile-steps", type=int, default=10)
     args = parser.parse_args()
 
     pid, nprocs = maybe_init_distributed()
@@ -103,21 +108,39 @@ def main() -> int:
         return (mnist_cnn.nll_loss(logp, y) * y.shape[0],
                 jnp.sum(jnp.argmax(logp, -1) == y))
 
+    # --profile-dir: trace steps [1, profile_steps] of epoch 1 — step 0 is
+    # skipped so compilation doesn't drown the trace (SURVEY §5 tracing ask;
+    # the reference delegates profiling to cAdvisor, docs/monitoring).
+    profiling = False
     steps_per_epoch = len(xtr) // args.batch_size
     for epoch in range(1, args.epochs + 1):
         t0 = time.perf_counter()
         for i, (x, y) in enumerate(
             mnist_data.batches(xtr, ytr, args.batch_size, seed=epoch)
         ):
+            if (args.profile_dir and args.profile_steps >= 1
+                    and epoch == 1 and i == 1 and pid == 0):
+                jax.profiler.start_trace(args.profile_dir)
+                profiling = True
             x = jax.device_put(x, data_sharding)
             y = jax.device_put(y, data_sharding)
             params, opt_state, loss = train_step(params, opt_state, x, y)
+            if profiling and i == args.profile_steps:
+                jax.block_until_ready(params)
+                jax.profiler.stop_trace()
+                profiling = False
+                print(f"profile trace written to {args.profile_dir}",
+                      flush=True)
             if i % args.log_interval == 0:
                 print(
                     f"Train Epoch: {epoch} [{i * args.batch_size}/{len(xtr)} "
                     f"({100. * i / steps_per_epoch:.0f}%)]\t"
                     f"loss={float(loss):.4f}", flush=True)
         jax.block_until_ready(params)
+        if profiling:  # epoch shorter than --profile-steps
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profile trace written to {args.profile_dir}", flush=True)
         train_dt = time.perf_counter() - t0
 
         total_loss, total_correct = 0.0, 0

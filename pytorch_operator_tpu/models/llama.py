@@ -219,8 +219,12 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin):
     return h
 
 
-def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
+def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                  apply_stack) -> jax.Array:
+    """Shared prologue/epilogue around the decoder stack: embed + RoPE
+    tables in, final norm + weight-tied head out.  ``apply_stack(layers,
+    h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
+    ring) — the only difference between forward and forward_pipelined."""
     T = tokens.shape[1]
     h = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_table(cfg, T)
@@ -229,13 +233,19 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     if cfg.remat:
         body = jax.checkpoint(body)
 
-    def scan_fn(h, lp):
-        return body(h, lp), None
-
-    h, _ = lax.scan(scan_fn, h, params["layers"])
+    h = apply_stack(params["layers"], h, body)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
     # weight-tied output head
     return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
+
+    def apply_stack(layers, h, body):
+        return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
+
+    return _forward_with(params, tokens, cfg, apply_stack)
 
 
 def activation_spec() -> P:
@@ -264,26 +274,19 @@ def forward_pipelined(
     """
     from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
 
-    T = tokens.shape[1]
-    h = jnp.take(params["embed"], tokens, axis=0)
-    cos, sin = rope_table(cfg, T)
+    def apply_stack(layers, h, body):
+        def stage_fn(layers_local, h):
+            return lax.scan(lambda h, lp: (body(h, lp), None),
+                            h, layers_local)[0]
 
-    body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
-    if cfg.remat:
-        body = jax.checkpoint(body)
+        return pipeline_apply(
+            layers, h, stage_fn, mesh,
+            n_microbatches=n_microbatches, axis_name=axis_name,
+            # remat-wrapped bodies are rejected by the vma checker outright
+            check_vma=not cfg.remat,
+        )
 
-    def stage_fn(layers_local, h):
-        def scan_fn(h, lp):
-            return body(h, lp), None
-
-        return lax.scan(scan_fn, h, layers_local)[0]
-
-    h = pipeline_apply(
-        params["layers"], h, stage_fn, mesh,
-        n_microbatches=n_microbatches, axis_name=axis_name,
-    )
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
-    return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
+    return _forward_with(params, tokens, cfg, apply_stack)
 
 
 def pp_param_specs(cfg: LlamaConfig, axis_name: str = "pp") -> Params:

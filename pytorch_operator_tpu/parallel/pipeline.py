@@ -80,6 +80,7 @@ def pipeline_apply(
     n_microbatches: int,
     axis_name: str = AXIS_PP,
     params_spec: Any = None,
+    check_vma: bool = True,
 ) -> jax.Array:
     """Apply a layer-stacked function as a pipeline over ``axis_name``.
 
@@ -105,9 +106,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(params_spec, P()),
         out_specs=P(),  # psum in the body makes the output truly replicated
-        # replication is established explicitly (pvary on carries, psum on
-        # the output); the vma checker also rejects jax.checkpoint-wrapped
-        # stage bodies (rematerialised Llama stages) outright
-        check_vma=False,
+        # callers with jax.checkpoint-wrapped stage bodies (rematerialised
+        # Llama stages) must pass check_vma=False — the vma checker rejects
+        # remat bodies outright; everyone else keeps the replication check
+        check_vma=check_vma,
     )(params_stacked, x_mb)
     return out_mb.reshape(B, *x.shape[1:])

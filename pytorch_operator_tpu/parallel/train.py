@@ -91,22 +91,19 @@ def sharded_init(
     return jax.jit(init, out_shardings=out_shardings)(jax.random.key(seed))
 
 
-def make_train_step(
-    cfg: llama.LlamaConfig,
-    mesh: Mesh,
+def _make_step(
+    forward_fn: Callable[[Any, jax.Array], jax.Array],
+    data_sharding: NamedSharding,
     optimizer: optax.GradientTransformation,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
-    """Build the jitted full training step.
-
-    Batch is an int32 (B, T+1) token array; step returns the new state
-    (donated in-place) and a metrics dict.
-    """
-    data_sharding = NamedSharding(mesh, batch_spec())
+    """Shared step builder: grad of next-token loss over ``forward_fn``,
+    optimizer update, donated state.  The forward (dense vs pipelined)
+    and the batch layout are the only things that vary between the
+    parallel strategies."""
 
     def loss_fn(params, batch):
         inputs, targets = batch[:, :-1], batch[:, 1:]
-        logits = llama.forward(params, inputs, cfg)
-        return cross_entropy_loss(logits, targets)
+        return cross_entropy_loss(forward_fn(params, inputs), targets)
 
     def step(state: TrainState, batch: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
@@ -120,6 +117,23 @@ def make_train_step(
         step,
         in_shardings=(None, data_sharding),
         donate_argnums=(0,),
+    )
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """Build the jitted full training step.
+
+    Batch is an int32 (B, T+1) token array; step returns the new state
+    (donated in-place) and a metrics dict.
+    """
+    return _make_step(
+        lambda params, inputs: llama.forward(params, inputs, cfg),
+        NamedSharding(mesh, batch_spec()),
+        optimizer,
     )
 
 
@@ -139,26 +153,11 @@ def make_pp_train_step(
     the way the activations came.  Pair with
     ``sharded_init(..., specs=llama.pp_param_specs(cfg))``.
     """
-    data_sharding = NamedSharding(mesh, P())  # stage 0 consumes the batch
-
-    def loss_fn(params, batch):
-        inputs, targets = batch[:, :-1], batch[:, 1:]
-        logits = llama.forward_pipelined(
+    return _make_step(
+        lambda params, inputs: llama.forward_pipelined(
             params, inputs, cfg, mesh,
             n_microbatches=n_microbatches, axis_name=axis_name,
-        )
-        return cross_entropy_loss(logits, targets)
-
-    def step(state: TrainState, batch: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
-        new_state = TrainState(params, opt_state, state.step + 1)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
-
-    return jax.jit(
-        step,
-        in_shardings=(None, data_sharding),
-        donate_argnums=(0,),
+        ),
+        NamedSharding(mesh, P()),  # stage 0 consumes the batch
+        optimizer,
     )

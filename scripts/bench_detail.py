@@ -1,0 +1,398 @@
+"""Flagship perf evidence: Llama training MFU + Pallas kernel comparisons.
+
+Produces the committed artifact ``BENCH_DETAIL.md`` (VERDICT round-1 item 2):
+
+  1. **Llama single-chip training MFU** — a ~0.9B-param Llama config
+     (flash attention + fused RMSNorm + per-layer remat, bf16, AdamW)
+     trained on one real TPU chip; reports step time, achieved TFLOP/s
+     and MFU against the chip's peak bf16 rate.
+  2. **Flash vs dense attention** — forward and forward+backward wall
+     time at seq 1024 / 4096 for the Pallas kernel
+     (ops/flash_attention.py) vs the dense XLA path, same shapes.
+  3. **Fused RMSNorm vs XLA** — Pallas kernel (ops/rms_norm.py) vs the
+     unfused f32-upcast XLA implementation.
+
+The reference publishes no kernel/MFU numbers (its headline is the
+dist-MNIST wall-clock envelope, README.md:37 — covered by bench.py), so
+this artifact is the repo's own reproducible flagship evidence.
+
+Run on a TPU host:   python scripts/bench_detail.py --out BENCH_DETAIL.md
+Quick smoke (CPU):   python scripts/bench_detail.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Peak dense bf16 TFLOP/s per chip by device_kind substring.  Sources:
+# public TPU spec sheets (v5e 197, v4 275, v5p 459, v6e 918).
+PEAK_BF16_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v5": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def _peak_tflops(device_kind: str) -> float | None:
+    dk = device_kind.lower()
+    for key, val in sorted(PEAK_BF16_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in dk:
+            return val
+    return None
+
+
+def _time_scanned(body, init_carry, iters: int, repeats: int = 3) -> float:
+    """Per-iteration device time of ``body`` (carry -> carry), measured as
+    ONE jitted lax.scan of `iters` chained applications — per-call
+    dispatch overhead (milliseconds over the device tunnel, larger than
+    these kernels) amortizes to noise, and the carry chain stops XLA
+    hoisting loop-invariant work.  Best of `repeats` rounds filters
+    shared-chip contention.  Returns seconds per iteration."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run(carry):
+        return lax.scan(lambda c, _: (body(c), None), carry, None,
+                        length=iters)[0]
+
+    carry = run(init_carry)  # compile + warmup
+    jax.block_until_ready(carry)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run(init_carry)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# 1. Llama training MFU
+
+
+def bench_llama_mfu(smoke: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pytorch_operator_tpu.models import llama
+
+    if smoke:
+        cfg = llama.tiny(use_flash=False, use_fused_norm=False, remat=True,
+                         dtype=jnp.bfloat16)
+        batch, seq = 2, 128
+        iters = 2
+    else:
+        # ~0.9B params: fits one 16GB v5e chip with bf16 AdamW + remat.
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, ffn_dim=5632, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True,
+            use_flash=True, use_fused_norm=True,
+        )
+        batch, seq = 4, 2048
+        iters = 10
+
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    # (batch, seq+1) so the trained T = seq tiles the Pallas block sizes
+    # (flash_attention and rms_norm fall back to dense XLA on ragged T —
+    # same convention as examples/llama/train_llama.py).
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+
+    from functools import partial
+
+    from pytorch_operator_tpu.parallel.train import cross_entropy_loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        def loss(p):
+            logits = llama.forward(p, tokens[:, :-1], cfg)
+            return cross_entropy_loss(logits, tokens[:, 1:])
+
+        l, grads = jax.value_and_grad(loss)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    t0 = time.perf_counter()
+    for _i in range(2):
+        params, opt_state, l = step(params, opt_state, tokens)
+    _ = float(l)
+    compile_s = time.perf_counter() - t0
+
+    step_s = float("inf")
+    for _round in range(2):  # min-of-2 rounds filters shared-chip noise
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            params, opt_state, l = step(params, opt_state, tokens)
+        final_loss = float(l)  # host fetch: forces completion of every step
+        step_s = min(step_s, (time.perf_counter() - t0) / iters)
+
+    # FLOP model (train = fwd + bwd = 3x fwd matmul FLOPs):
+    #   matmuls: 6 * n_params * tokens   (2 FLOP/MAC * 3x for training)
+    #   attention: 12 * L * B * T^2 * D, halved for causal masking (the
+    #   flash kernel skips fully-masked key blocks).
+    T = seq
+    tokens_per_step = batch * T
+    matmul_flops = 6.0 * n_params * tokens_per_step
+    attn_flops = 12.0 * cfg.n_layers * batch * T * T * cfg.dim * 0.5
+    total_flops = matmul_flops + attn_flops
+
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev.device_kind)
+    achieved_tflops = total_flops / step_s / 1e12
+    return {
+        "model": f"Llama d{cfg.dim} L{cfg.n_layers} h{cfg.n_heads} "
+                 f"ffn{cfg.ffn_dim} vocab{cfg.vocab_size}",
+        "n_params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "device": dev.device_kind,
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "tokens_per_sec": round(tokens_per_step / step_s, 1),
+        "achieved_tflops": round(achieved_tflops, 1),
+        "peak_tflops": peak,
+        "mfu_pct": round(100 * achieved_tflops / peak, 1) if peak else None,
+        "final_loss": round(final_loss, 4),
+        "flags": f"use_flash={cfg.use_flash} use_fused_norm={cfg.use_fused_norm} "
+                 f"remat={cfg.remat} {jnp.dtype(cfg.dtype).name} AdamW",
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2. Flash vs dense attention
+
+
+def bench_flash_vs_dense(smoke: bool) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_operator_tpu.ops import flash_attention
+    from pytorch_operator_tpu.ops.flash_attention import _dense_reference
+
+    def dense(q, k, v):
+        # the exact dense XLA path flash_attention falls back to
+        B, T, H, D = q.shape
+        q2, k2, v2 = (x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+                      for x in (q, k, v))
+        out = _dense_reference(q2, k2, v2, D ** -0.5, True)
+        return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    def _normed(x):
+        # rescale to unit RMS so the carry chain neither decays nor blows
+        # up over the scan; identical cost on every timed variant
+        xf = x.astype(jnp.float32)
+        return (xf * jax.lax.rsqrt(jnp.mean(xf * xf) + 1e-6)).astype(x.dtype)
+
+    seqs = [256] if smoke else [1024, 4096]
+    B, H, D = 1, 16, 128
+    rows = []
+    for T in seqs:
+        q, k, v = (jax.random.normal(jax.random.key(i), (B, T, H, D),
+                                     jnp.bfloat16) for i in range(3))
+
+        def fwd_body(fn):
+            # chain q through the output so each scan iteration depends
+            # on the last (no loop-invariant hoisting)
+            return lambda qc: _normed(fn(qc, k, v))
+
+        def bwd_body(fn):
+            # sum-of-squares: a NONLINEAR functional of the output, so
+            # XLA cannot push the reduction through the matmuls and skip
+            # the attention (a plain sum() lets it — measured fwd+bwd
+            # came out faster than fwd).  The carry mixes all three
+            # grads so none of dq/dk/dv is dead code.
+            def loss(q, k, v):
+                o = fn(q, k, v).astype(jnp.float32)
+                return jnp.sum(o * o)
+
+            grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+            def body(qc):
+                dq, dk, dv = grad_fn(qc, k, v)
+                return _normed(dq + dk + dv)
+
+            return body
+
+        flash = lambda q, k, v: flash_attention(q, k, v, causal=True)  # noqa: E731
+        # scale iterations inversely with T² so every scan runs long
+        # enough (hundreds of ms) to rise above shared-chip noise
+        iters = 2 if smoke else max(20, (4096 // T) ** 2 * 20)
+        t_ff = _time_scanned(fwd_body(flash), q, iters, repeats=5)
+        t_df = _time_scanned(fwd_body(dense), q, iters, repeats=5)
+        t_fg = _time_scanned(bwd_body(flash), q, iters, repeats=5)
+        t_dg = _time_scanned(bwd_body(dense), q, iters, repeats=5)
+        rows.append({
+            "shape": f"B{B} T{T} H{H} D{D} bf16 causal",
+            "fwd_flash_ms": round(t_ff * 1e3, 3),
+            "fwd_dense_ms": round(t_df * 1e3, 3),
+            "fwd_speedup": round(t_df / t_ff, 2),
+            "fwdbwd_flash_ms": round(t_fg * 1e3, 3),
+            "fwdbwd_dense_ms": round(t_dg * 1e3, 3),
+            "fwdbwd_speedup": round(t_dg / t_fg, 2),
+        })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# 3. Fused RMSNorm vs XLA
+
+
+def bench_rms_norm(smoke: bool) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pytorch_operator_tpu.ops import rms_norm
+
+    def xla_rms(x, w):
+        xf = x.astype(jnp.float32)
+        inv = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-5)
+        return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+
+    shapes = [(256, 128)] if smoke else [(8192, 2048), (16384, 4096)]
+    rows = []
+    for N, D in shapes:
+        x = jax.random.normal(jax.random.key(0), (N, D), jnp.bfloat16)
+        w = jnp.full((D,), 1.5, jnp.bfloat16)  # != 1 so the scan has a fixpoint-free chain
+        iters = 2 if smoke else 50
+        # chain x through the output: rms_norm output feeds the next
+        # iteration, so the scan can't hoist the computation
+        t_f = _time_scanned(lambda xc: rms_norm(xc, w, 1e-5), x, iters,
+                            repeats=5)
+        t_p = _time_scanned(lambda xc: xla_rms(xc, w), x, iters, repeats=5)
+        rows.append({
+            "shape": f"({N}, {D}) bf16",
+            "fused_us": round(t_f * 1e6, 1),
+            "xla_us": round(t_p * 1e6, 1),
+            "speedup": round(t_p / t_f, 2),
+        })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+
+
+def render_md(mfu: dict, flash: list[dict], norm: list[dict]) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    lines = [
+        "# BENCH_DETAIL — flagship perf evidence",
+        "",
+        f"Generated {now} by `python scripts/bench_detail.py` on "
+        f"`{mfu['device']}` (single chip).  Reproduce with the same "
+        "command; `--smoke` runs tiny shapes anywhere.",
+        "",
+        "## 1. Llama single-chip training MFU",
+        "",
+        f"* model: {mfu['model']} — {mfu['n_params']/1e6:.0f}M params",
+        f"* batch {mfu['batch']} x seq {mfu['seq']}, {mfu['flags']}",
+        f"* step time: **{mfu['step_ms']} ms** "
+        f"({mfu['tokens_per_sec']:.0f} tokens/s/chip); "
+        f"compile+warmup {mfu['compile_s']}s; final loss {mfu['final_loss']}",
+        (f"* achieved **{mfu['achieved_tflops']} TFLOP/s** vs "
+         f"{mfu['peak_tflops']} peak bf16 -> **MFU {mfu['mfu_pct']}%**"
+         if mfu["peak_tflops"] else
+         f"* achieved **{mfu['achieved_tflops']} TFLOP/s** "
+         f"(no peak-bf16 entry for `{mfu['device']}`; MFU not computed)"),
+        "",
+        "FLOP accounting: 6·N·tokens matmul + causal-halved 12·L·B·T²·D "
+        "attention (see script).  The reference publishes no MFU/kernel "
+        "numbers (its headline is the dist-MNIST envelope — bench.py), so "
+        "this is the repo's own flagship baseline to beat in later rounds.",
+        "",
+        "## 2. Flash attention (Pallas) vs dense XLA",
+        "",
+        "| shape | fwd flash | fwd dense | fwd speedup | fwd+bwd flash | fwd+bwd dense | fwd+bwd speedup |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in flash:
+        lines.append(
+            f"| {r['shape']} | {r['fwd_flash_ms']} ms | {r['fwd_dense_ms']} ms "
+            f"| **{r['fwd_speedup']}x** | {r['fwdbwd_flash_ms']} ms | "
+            f"{r['fwdbwd_dense_ms']} ms | **{r['fwdbwd_speedup']}x** |")
+    lines += [
+        "",
+        "Backward is the blockwise Pallas dq/dk/dv kernel "
+        "(ops/flash_attention.py) — O(T) memory, no (T,T) buffer.",
+        "",
+        "Timing is a jitted lax.scan chain (dispatch overhead amortized), "
+        "best of 5 rounds; the bench chip is shared, so sub-10ms rows "
+        "still carry a few-percent noise floor — read the seq-4096 rows "
+        "(and the MFU above, where steps are ~0.7s) as the signal.  The "
+        "flash kernel's advantage is the O(T) memory path: at seq 1024 "
+        "the dense path's (T,T) buffer still fits cache-friendly tiles "
+        "and XLA's fused softmax is competitive.",
+        "",
+        "## 3. Fused RMSNorm (Pallas) vs XLA",
+        "",
+        "| shape | fused | XLA | speedup |",
+        "|---|---|---|---|",
+    ]
+    for r in norm:
+        lines.append(f"| {r['shape']} | {r['fused_us']} us | {r['xla_us']} us "
+                     f"| **{r['speedup']}x** |")
+    lines += [
+        "",
+        "## Raw JSON",
+        "",
+        "```json",
+        json.dumps({"mfu": mfu, "flash": flash, "rms_norm": norm}, indent=2),
+        "```",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write BENCH_DETAIL.md here (default: stdout only)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes, any backend (CI sanity check)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    print(f"[bench_detail] device: {jax.devices()[0].device_kind}",
+          file=sys.stderr)
+
+    print("[bench_detail] 1/3 llama MFU...", file=sys.stderr)
+    mfu = bench_llama_mfu(args.smoke)
+    print(f"[bench_detail]   {mfu}", file=sys.stderr)
+    print("[bench_detail] 2/3 flash vs dense...", file=sys.stderr)
+    flash = bench_flash_vs_dense(args.smoke)
+    print(f"[bench_detail]   {flash}", file=sys.stderr)
+    print("[bench_detail] 3/3 rms_norm...", file=sys.stderr)
+    norm = bench_rms_norm(args.smoke)
+    print(f"[bench_detail]   {norm}", file=sys.stderr)
+
+    md = render_md(mfu, flash, norm)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+        print(f"[bench_detail] wrote {args.out}", file=sys.stderr)
+    print(json.dumps({"mfu": mfu, "flash": flash, "rms_norm": norm}))
+
+
+if __name__ == "__main__":
+    main()
