@@ -58,6 +58,22 @@ void exp_delete(void* e, const char* key);
 int exp_get(void* e, const char* key, int* adds, int* dels,
             double* age_seconds);
 
+/* ---- informer object store -------------------------------------------- */
+
+/* Thread-safe cache of wire-format JSON objects keyed "namespace/name",
+ * with metadata.resourceVersion stored alongside for cheap diffing.
+ * st_get/st_get_rv/st_keys return malloc'd NUL-terminated strings the
+ * caller must release with st_buf_free (NULL when the key is absent). */
+void* st_new(void);
+void st_free(void* s);
+void st_set(void* s, const char* key, const char* rv, const char* json);
+int st_delete(void* s, const char* key);     /* 1 removed, 0 absent */
+char* st_get(void* s, const char* key);      /* JSON copy */
+char* st_get_rv(void* s, const char* key);   /* resourceVersion copy */
+int st_len(void* s);
+char* st_keys(void* s);                      /* '\n'-joined key list */
+void st_buf_free(char* p);
+
 #ifdef __cplusplus
 }
 #endif

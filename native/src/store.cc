@@ -1,0 +1,101 @@
+// Informer object store: the native informer cache of SURVEY §7 step 3.
+//
+// Native equivalent of the client-go ThreadSafeStore backing every
+// SharedIndexInformer (the reference consumes it through the informer
+// factories, pkg/controller.v1/pytorch/informer.go:34-55).  Objects are
+// stored as their wire-format JSON, keyed "namespace/name", alongside
+// the metadata.resourceVersion so callers can run resourceVersion-based
+// diffs (periodic resync, watch-gap healing) without parsing JSON.
+//
+// Reads take a shared lock; Python-side `get` deserialises the returned
+// JSON into a FRESH object per call, which gives the controller
+// deep-copy-on-read semantics by construction — the "DeepCopy before
+// mutation" discipline client-go demands (controller.go:316) can't be
+// violated through this store.
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+
+#include "tpu_operator.h"
+
+namespace {
+
+struct Entry {
+  std::string rv;
+  std::string json;
+};
+
+struct Store {
+  std::shared_mutex mu;
+  std::unordered_map<std::string, Entry> items;
+};
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) {
+    std::memcpy(out, s.data(), s.size());
+    out[s.size()] = '\0';
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* st_new(void) { return new Store(); }
+
+void st_free(void* s) { delete static_cast<Store*>(s); }
+
+void st_set(void* s, const char* key, const char* rv, const char* json) {
+  auto* st = static_cast<Store*>(s);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  st->items[key] = Entry{rv ? rv : "", json ? json : ""};
+}
+
+int st_delete(void* s, const char* key) {
+  auto* st = static_cast<Store*>(s);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  return st->items.erase(key) ? 1 : 0;
+}
+
+char* st_get(void* s, const char* key) {
+  auto* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  auto it = st->items.find(key);
+  if (it == st->items.end()) return nullptr;
+  return dup_string(it->second.json);
+}
+
+char* st_get_rv(void* s, const char* key) {
+  auto* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  auto it = st->items.find(key);
+  if (it == st->items.end()) return nullptr;
+  return dup_string(it->second.rv);
+}
+
+int st_len(void* s) {
+  auto* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  return static_cast<int>(st->items.size());
+}
+
+char* st_keys(void* s) {
+  auto* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  std::string joined;
+  for (const auto& kv : st->items) {
+    if (!joined.empty()) joined.push_back('\n');
+    joined.append(kv.first);
+  }
+  return dup_string(joined);
+}
+
+void st_buf_free(char* p) { std::free(p); }
+
+}  // extern "C"

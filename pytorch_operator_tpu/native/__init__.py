@@ -2,10 +2,12 @@
 
 The reference's runtime is compiled (a Go binary); here the hot
 control-plane structures — the rate-limited workqueue the sync workers
-block on and the expectations cache every watch event touches — are C++
-(native/src/*.cc), loaded via ctypes so no binding framework is needed.
-Blocking `get` calls release the GIL inside C++, so N sync workers
-contend on a native mutex instead of the interpreter lock.
+block on, the expectations cache every watch event touches, and the
+informer object cache (SURVEY §7 step 3) — are C++ (native/src/*.cc),
+loaded via ctypes so no binding framework is needed.  Blocking `get`
+calls release the GIL inside C++, so N sync workers contend on a native
+mutex instead of the interpreter lock; the store's reads take a C++
+shared lock and deserialise fresh copies (deep-copy-on-read).
 
 `load()` builds the library on first use (make -C native) and caches the
 handle; callers fall back to the pure-Python implementations when no
@@ -66,6 +68,24 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
                             ctypes.POINTER(ctypes.c_int),
                             ctypes.POINTER(ctypes.c_int),
                             ctypes.POINTER(ctypes.c_double)]
+
+    # st_get/st_get_rv/st_keys return malloc'd buffers: restype must be
+    # a bare pointer (c_char_p would copy-and-leak), freed via st_buf_free
+    lib.st_new.restype = c_void
+    lib.st_new.argtypes = []
+    lib.st_free.argtypes = [c_void]
+    lib.st_set.argtypes = [c_void, c_char, c_char, c_char]
+    lib.st_delete.restype = ctypes.c_int
+    lib.st_delete.argtypes = [c_void, c_char]
+    lib.st_get.restype = ctypes.POINTER(ctypes.c_char)
+    lib.st_get.argtypes = [c_void, c_char]
+    lib.st_get_rv.restype = ctypes.POINTER(ctypes.c_char)
+    lib.st_get_rv.argtypes = [c_void, c_char]
+    lib.st_len.restype = ctypes.c_int
+    lib.st_len.argtypes = [c_void]
+    lib.st_keys.restype = ctypes.POINTER(ctypes.c_char)
+    lib.st_keys.argtypes = [c_void]
+    lib.st_buf_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
     return lib
 
 
@@ -240,5 +260,91 @@ class NativeExpectations:
             if getattr(self, "_e", None):
                 self._lib.exp_free(self._e)
                 self._e = None
+        except Exception:
+            pass
+
+
+class NativeStore:
+    """Drop-in for runtime.informer.Store backed by the C++ object cache.
+
+    Objects live in native memory as wire-format JSON (the native
+    informer cache of SURVEY §7 step 3); every ``get_by_key``/``list``
+    deserialises a fresh copy, so callers get deep-copy-on-read — the
+    client-go "DeepCopy before mutation" rule (reference
+    controller.go:316) holds by construction, a caller cannot corrupt
+    the cache through a returned reference.
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self._s = lib.st_new()
+
+    @staticmethod
+    def _key_of(obj: dict) -> str:
+        from pytorch_operator_tpu.runtime.informer import meta_namespace_key
+
+        return meta_namespace_key(obj)
+
+    def _take_str(self, ptr) -> Optional[str]:
+        if not ptr:
+            return None
+        try:
+            return ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+        finally:
+            self._lib.st_buf_free(ptr)
+
+    def add(self, obj: dict) -> None:
+        import json
+
+        meta = obj.get("metadata") or {}
+        self._lib.st_set(
+            self._s,
+            self._key_of(obj).encode(),
+            str(meta.get("resourceVersion", "")).encode(),
+            json.dumps(obj).encode(),
+        )
+
+    def update(self, obj: dict) -> None:
+        self.add(obj)
+
+    def delete(self, obj: dict) -> None:
+        self._lib.st_delete(self._s, self._key_of(obj).encode())
+
+    def get_by_key(self, key: str) -> Optional[dict]:
+        import json
+
+        raw = self._take_str(self._lib.st_get(self._s, key.encode()))
+        return None if raw is None else json.loads(raw)
+
+    def get_resource_version(self, key: str) -> Optional[str]:
+        """resourceVersion without deserialising the object."""
+        return self._take_str(self._lib.st_get_rv(self._s, key.encode()))
+
+    def contains(self, key: str) -> bool:
+        """Key presence without deserialising the object ("" rv counts)."""
+        return self.get_resource_version(key) is not None
+
+    def keys(self) -> list:
+        raw = self._take_str(self._lib.st_keys(self._s))
+        return raw.split("\n") if raw else []
+
+    def list(self) -> list:
+        return [obj for key in self.keys()
+                if (obj := self.get_by_key(key)) is not None]
+
+    def __len__(self) -> int:
+        return self._lib.st_len(self._s) if self._s else 0
+
+    def close(self) -> None:
+        s, self._s = getattr(self, "_s", None), None
+        if s:
+            self._lib.st_free(s)
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass

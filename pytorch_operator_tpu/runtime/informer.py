@@ -72,6 +72,37 @@ class Store:
         with self._lock:
             return list(self._items.keys())
 
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._items
+
+
+def _make_store():
+    """Informer cache: the C++ object store when available (native/ —
+    the native informer cache of SURVEY §7 step 3, with deep-copy-on-read
+    semantics), Python otherwise.  Same env contract as the runtime core:
+    PYTORCH_OPERATOR_NATIVE=0 forces Python, =1 makes a missing native
+    build a hard error."""
+    import os
+
+    pref = os.environ.get("PYTORCH_OPERATOR_NATIVE", "auto")
+    if pref != "0":
+        try:
+            from pytorch_operator_tpu.native import NativeStore, native_available
+
+            if native_available():
+                return NativeStore()
+            if pref == "1":
+                from pytorch_operator_tpu.native import load_error
+
+                raise RuntimeError(
+                    f"PYTORCH_OPERATOR_NATIVE=1 but native store failed to "
+                    f"load: {load_error()}")
+        except ImportError:
+            if pref == "1":
+                raise
+    return Store()
+
 
 class EventHandlers:
     def __init__(self):
@@ -92,7 +123,7 @@ class Informer:
 
     def __init__(self, source, resync_period: float = 0.0):
         self._source = source
-        self.store = Store()
+        self.store = _make_store()
         self._handlers = EventHandlers()
         self._synced = False
         self._started = False
@@ -142,7 +173,9 @@ class Informer:
             self._started = True
         self._source.add_listener(self._on_watch_event)
         for obj in self._source.list():
-            if self.store.get_by_key(meta_namespace_key(obj)) is not None:
+            # contains(): presence check without deserialising (the native
+            # store would otherwise json-parse every object just for this)
+            if self.store.contains(meta_namespace_key(obj)):
                 continue
             self.store.add(obj)
             for fn in self._handlers.add_funcs:

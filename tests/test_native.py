@@ -217,3 +217,143 @@ def test_e2e_sim_with_native_core(monkeypatch):
         stop.set()
         ctl.work_queue.shutdown()
         kubelet.stop()
+
+
+@pytest.fixture(params=["python", "native"])
+def store(request):
+    from pytorch_operator_tpu.runtime.informer import Store
+
+    if request.param == "python":
+        return Store()
+    return native.NativeStore()
+
+
+def _obj(ns, name, rv="1", **extra):
+    o = {"metadata": {"namespace": ns, "name": name, "resourceVersion": rv}}
+    o.update(extra)
+    return o
+
+
+class TestStoreContract:
+    """runtime.informer.Store and native.NativeStore are drop-ins."""
+
+    def test_add_get_roundtrip(self, store):
+        store.add(_obj("ns", "a", "5", kind="Pod", spec={"x": [1, 2]}))
+        got = store.get_by_key("ns/a")
+        assert got["kind"] == "Pod"
+        assert got["spec"] == {"x": [1, 2]}
+        assert got["metadata"]["resourceVersion"] == "5"
+
+    def test_get_missing(self, store):
+        assert store.get_by_key("nope/nothing") is None
+
+    def test_update_replaces(self, store):
+        store.add(_obj("ns", "a", "1", phase="Pending"))
+        store.update(_obj("ns", "a", "2", phase="Running"))
+        got = store.get_by_key("ns/a")
+        assert got["phase"] == "Running"
+        assert got["metadata"]["resourceVersion"] == "2"
+
+    def test_delete(self, store):
+        o = _obj("ns", "a")
+        store.add(o)
+        store.delete(o)
+        assert store.get_by_key("ns/a") is None
+        store.delete(o)  # idempotent
+
+    def test_keys_and_list(self, store):
+        store.add(_obj("ns", "a"))
+        store.add(_obj("other", "b"))
+        store.add(_obj(None, "clusterwide"))
+        assert sorted(store.keys()) == ["clusterwide", "ns/a", "other/b"]
+        assert {o["metadata"]["name"] for o in store.list()} == {
+            "a", "b", "clusterwide"}
+
+    def test_cluster_scoped_key(self, store):
+        store.add(_obj(None, "n"))
+        assert store.get_by_key("n")["metadata"]["name"] == "n"
+
+
+class TestNativeStoreSemantics:
+    """Native-only guarantees beyond the shared contract."""
+
+    def test_deep_copy_on_read(self):
+        s = native.NativeStore()
+        s.add(_obj("ns", "a", "1", spec={"replicas": 1}))
+        got = s.get_by_key("ns/a")
+        got["spec"]["replicas"] = 99  # mutate the returned copy
+        assert s.get_by_key("ns/a")["spec"]["replicas"] == 1
+
+    def test_resource_version_without_parse(self):
+        s = native.NativeStore()
+        s.add(_obj("ns", "a", "42"))
+        assert s.get_resource_version("ns/a") == "42"
+        assert s.get_resource_version("ns/missing") is None
+
+    def test_len(self):
+        s = native.NativeStore()
+        assert len(s) == 0
+        s.add(_obj("ns", "a"))
+        s.add(_obj("ns", "b"))
+        assert len(s) == 2
+
+    def test_concurrent_readers_writers(self):
+        s = native.NativeStore()
+        errors = []
+
+        def writer(i):
+            try:
+                for j in range(200):
+                    s.add(_obj("ns", f"obj-{i}-{j % 10}", str(j)))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                for _ in range(200):
+                    for key in s.keys():
+                        s.get_by_key(key)  # may be None mid-delete: fine
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        threads += [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(s) == 40  # 4 writers x 10 distinct names
+
+
+def test_informer_uses_native_store(monkeypatch):
+    """Default informer cache is the native store when the lib loads."""
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE", "1")
+    from pytorch_operator_tpu.runtime.informer import Informer, _make_store
+
+    assert type(_make_store()).__name__ == "NativeStore"
+
+    class FakeSource:
+        def __init__(self):
+            self.listeners = []
+
+        def add_listener(self, fn):
+            self.listeners.append(fn)
+
+        def remove_listener(self, fn):
+            self.listeners.remove(fn)
+
+        def list(self, namespace=None):
+            return [_obj("ns", "seed", "1", kind="PyTorchJob")]
+
+    src = FakeSource()
+    inf = Informer(src)
+    seen = []
+    inf.add_event_handler(on_add=lambda o: seen.append(o["metadata"]["name"]))
+    inf.start()
+    assert inf.has_synced()
+    assert seen == ["seed"]
+    assert inf.store.get_by_key("ns/seed")["kind"] == "PyTorchJob"
+    # watch events flow through the native cache
+    src.listeners[0]("DELETED", _obj("ns", "seed", "1"))
+    assert inf.store.get_by_key("ns/seed") is None
