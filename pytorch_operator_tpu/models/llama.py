@@ -50,6 +50,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # name of a jax.checkpoint_policies policy (e.g. "dots_saveable",
+    # "dots_with_no_batch_dims_saveable") — None reproduces full remat
+    # (save nothing, recompute the whole layer in backward)
+    remat_policy: Any = None
     use_flash: bool = False       # pallas flash-attention kernel (ops/)
     use_fused_norm: bool = False  # pallas fused RMSNorm kernel (ops/)
 
@@ -231,7 +235,11 @@ def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy:
+            body = jax.checkpoint(
+                body, policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
+        else:
+            body = jax.checkpoint(body)
 
     h = apply_stack(params["layers"], h, body)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)

@@ -122,6 +122,23 @@ def load_error() -> Optional[str]:
     return _load_error
 
 
+def resolve_backend(component: str) -> bool:
+    """Shared PYTORCH_OPERATOR_NATIVE contract: True = use the native
+    implementation, False = the Python fallback.  ``0`` forces Python,
+    ``1`` raises when the native build is unavailable, anything else
+    (default ``auto``) prefers native when it loads."""
+    pref = os.environ.get("PYTORCH_OPERATOR_NATIVE", "auto")
+    if pref == "0":
+        return False
+    if native_available():
+        return True
+    if pref == "1":
+        raise RuntimeError(
+            f"PYTORCH_OPERATOR_NATIVE=1 but native {component} failed to "
+            f"load: {load_error()}")
+    return False
+
+
 class NativeWorkQueue:
     """Drop-in for runtime.workqueue.WorkQueue over string items."""
 
@@ -299,10 +316,18 @@ class NativeStore:
     def add(self, obj: dict) -> None:
         import json
 
+        s = self._s
+        if not s:
+            return
+        key = self._key_of(obj)
+        if "\n" in key:
+            # st_keys joins with '\n'; K8s DNS-1123 names can't contain
+            # whitespace, so reject rather than corrupt the key listing
+            raise ValueError(f"invalid object key (newline): {key!r}")
         meta = obj.get("metadata") or {}
         self._lib.st_set(
-            self._s,
-            self._key_of(obj).encode(),
+            s,
+            key.encode(),
             str(meta.get("resourceVersion", "")).encode(),
             json.dumps(obj).encode(),
         )
@@ -311,24 +336,35 @@ class NativeStore:
         self.add(obj)
 
     def delete(self, obj: dict) -> None:
-        self._lib.st_delete(self._s, self._key_of(obj).encode())
+        s = self._s
+        if s:
+            self._lib.st_delete(s, self._key_of(obj).encode())
 
     def get_by_key(self, key: str) -> Optional[dict]:
         import json
 
-        raw = self._take_str(self._lib.st_get(self._s, key.encode()))
+        s = self._s
+        if not s:
+            return None
+        raw = self._take_str(self._lib.st_get(s, key.encode()))
         return None if raw is None else json.loads(raw)
 
     def get_resource_version(self, key: str) -> Optional[str]:
         """resourceVersion without deserialising the object."""
-        return self._take_str(self._lib.st_get_rv(self._s, key.encode()))
+        s = self._s
+        if not s:
+            return None
+        return self._take_str(self._lib.st_get_rv(s, key.encode()))
 
     def contains(self, key: str) -> bool:
         """Key presence without deserialising the object ("" rv counts)."""
         return self.get_resource_version(key) is not None
 
     def keys(self) -> list:
-        raw = self._take_str(self._lib.st_keys(self._s))
+        s = self._s
+        if not s:
+            return []
+        raw = self._take_str(self._lib.st_keys(s))
         return raw.split("\n") if raw else []
 
     def list(self) -> list:
@@ -339,6 +375,10 @@ class NativeStore:
         return self._lib.st_len(self._s) if self._s else 0
 
     def close(self) -> None:
+        """Free the C++ store.  Post-close calls no-op (every method
+        re-reads the cleared handle), but close() must not race in-flight
+        calls on other threads — the owner (the informer) tears down its
+        watch/resync threads first."""
         s, self._s = getattr(self, "_s", None), None
         if s:
             self._lib.st_free(s)

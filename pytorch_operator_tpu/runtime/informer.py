@@ -80,28 +80,11 @@ class Store:
 def _make_store():
     """Informer cache: the C++ object store when available (native/ —
     the native informer cache of SURVEY §7 step 3, with deep-copy-on-read
-    semantics), Python otherwise.  Same env contract as the runtime core:
-    PYTORCH_OPERATOR_NATIVE=0 forces Python, =1 makes a missing native
-    build a hard error."""
-    import os
+    semantics), Python otherwise.  PYTORCH_OPERATOR_NATIVE contract via
+    native.resolve_backend."""
+    from pytorch_operator_tpu.native import NativeStore, resolve_backend
 
-    pref = os.environ.get("PYTORCH_OPERATOR_NATIVE", "auto")
-    if pref != "0":
-        try:
-            from pytorch_operator_tpu.native import NativeStore, native_available
-
-            if native_available():
-                return NativeStore()
-            if pref == "1":
-                from pytorch_operator_tpu.native import load_error
-
-                raise RuntimeError(
-                    f"PYTORCH_OPERATOR_NATIVE=1 but native store failed to "
-                    f"load: {load_error()}")
-        except ImportError:
-            if pref == "1":
-                raise
-    return Store()
+    return NativeStore() if resolve_backend("store") else Store()
 
 
 class EventHandlers:

@@ -74,30 +74,16 @@ class JobControllerConfig:
 
 def _make_runtime_core():
     """Expectations + workqueue, C++ when available (native/), Python
-    otherwise.  PYTORCH_OPERATOR_NATIVE=0 forces the Python versions;
-    =1 makes a missing native build a hard error instead of a fallback."""
-    import os
+    otherwise.  PYTORCH_OPERATOR_NATIVE contract via
+    native.resolve_backend (=0 forces Python, =1 hard error)."""
+    from pytorch_operator_tpu.native import (
+        NativeExpectations,
+        NativeWorkQueue,
+        resolve_backend,
+    )
 
-    pref = os.environ.get("PYTORCH_OPERATOR_NATIVE", "auto")
-    if pref != "0":
-        try:
-            from pytorch_operator_tpu.native import (
-                NativeExpectations,
-                NativeWorkQueue,
-                native_available,
-            )
-
-            if native_available():
-                return NativeExpectations(), NativeWorkQueue()
-            if pref == "1":
-                from pytorch_operator_tpu.native import load_error
-
-                raise RuntimeError(
-                    f"PYTORCH_OPERATOR_NATIVE=1 but native core failed to "
-                    f"load: {load_error()}")
-        except ImportError:
-            if pref == "1":
-                raise
+    if resolve_backend("core"):
+        return NativeExpectations(), NativeWorkQueue()
     return ControllerExpectations(), WorkQueue()
 
 
