@@ -24,6 +24,7 @@ from pytorch_operator_tpu.parallel.mesh import (
 )
 from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
 from pytorch_operator_tpu.parallel.ring_attention import ring_attention
+from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
 from pytorch_operator_tpu.parallel.train import (
     cross_entropy_loss,
     make_pp_train_step,
@@ -45,6 +46,7 @@ __all__ = [
     "make_sp_mesh",
     "pipeline_apply",
     "ring_attention",
+    "ulysses_attention",
     "cross_entropy_loss",
     "make_pp_train_step",
     "make_train_step",

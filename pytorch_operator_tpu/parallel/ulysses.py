@@ -1,0 +1,100 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second long-context strategy next to ring attention (SURVEY.md §5
+long-context ask; the reference has no sequence scaling at all — it
+scales data-parallel replica count only).  Where the ring rotates K/V
+chunks neighbour-to-neighbour and keeps the sequence sharded throughout,
+the all-to-all approach re-shards between *sequence* and *head*
+parallelism around the attention:
+
+    (B, T/n, H, Dh)  --all_to_all-->  (B, T, H/n, Dh)
+        attention over the FULL sequence on 1/n-th of the heads
+    (B, T, H/n, Dh)  --all_to_all-->  (B, T/n, H, Dh)
+
+Two collectives per attention call instead of n-1 ppermute hops, and the
+local compute is plain full-sequence attention — so it composes with the
+Pallas flash kernel (ops/) unchanged.  Trade-off vs the ring: head count
+must divide the mesh axis (GQA kv-heads too after broadcast), and each
+device must hold one full (T, H/n) activation; the ring only ever holds
+T/n rows.  On TPU both collectives ride ICI (all_to_all lowers to an
+ICI all-to-all, the ring to neighbour ppermutes).
+
+Reference for the pattern: DeepSpeed-Ulysses (arXiv:2309.14509); this is
+an independent JAX shard_map implementation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _local_attention(q, k, v, scale, causal, use_flash):
+    """Plain full-sequence attention on the local head slice.
+
+    q/k/v: (B, T, Hl, Dh).  f32 accumulation, bf16-safe.
+    """
+    if use_flash:
+        from pytorch_operator_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    T = q.shape[1]
+    s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+def _ulysses_body(q, k, v, axis_name, causal, scale, use_flash):
+    """Runs per device inside shard_map; local shapes (B, T/n, H, Dh)."""
+    # seq-sharded -> head-sharded: gather the full sequence, keep H/n heads
+    to_heads = partial(lax.all_to_all, axis_name=axis_name,
+                       split_axis=2, concat_axis=1, tiled=True)
+    o = _local_attention(to_heads(q), to_heads(k), to_heads(v),
+                         scale, causal, use_flash)
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(o, axis_name=axis_name,
+                          split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    use_flash: bool = False,
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``axis_name``.
+
+    q/k/v: global-view (B, T, H, Dh); T and H must divide by the mesh's
+    ``axis_name`` size (broadcast GQA KV heads before calling, as with
+    ops.flash_attention).  Differentiable: reverse mode flows back
+    through the two all_to_alls.  Returns (B, T, H, Dh) sharded the same
+    way as the inputs.
+    """
+    n = mesh.shape[axis_name]
+    B, T, H, Dh = q.shape
+    if T % n:
+        raise ValueError(f"seq len {T} not divisible by {axis_name}={n}")
+    if H % n:
+        raise ValueError(f"{H} heads not divisible by {axis_name}={n} "
+                         f"(all-to-all SP shards heads; use ring_attention "
+                         f"for head counts below the mesh axis)")
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(_ulysses_body, axis_name=axis_name, causal=causal,
+                scale=Dh ** -0.5, use_flash=use_flash),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

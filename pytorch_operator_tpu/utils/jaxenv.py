@@ -27,8 +27,21 @@ def apply_platform_env() -> None:
               file=sys.stderr)
     backend = jax.default_backend()
     want = plat.split(",")[0]
-    if backend != want:
-        raise RuntimeError(
-            f"JAX_PLATFORMS={plat!r} requested but backend initialised as "
-            f"{backend!r} — the job would silently run on the wrong platform"
-        )
+    if backend == want:
+        return
+    # A PJRT plugin's canonical backend name can differ from its platform
+    # name (e.g. a tunnelled TPU plugin registering as platform "axon"
+    # reports backend "tpu").  Mere enumerability of the requested
+    # platform is NOT enough (on an image whose sitecustomize already
+    # initialised another backend, jax.devices(want) can succeed while
+    # computations default elsewhere): the requested platform's devices
+    # must BE the default devices.
+    try:
+        if jax.devices(want) == jax.devices():
+            return
+    except RuntimeError:
+        pass
+    raise RuntimeError(
+        f"JAX_PLATFORMS={plat!r} requested but backend initialised as "
+        f"{backend!r} — the job would silently run on the wrong platform"
+    )
