@@ -46,6 +46,13 @@ def main() -> int:
                              "path; must equal the device count)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches when --pp is set")
+    parser.add_argument("--sp", type=int, default=0,
+                        help="sequence-parallel degree for long contexts "
+                             "(must equal the device count)")
+    parser.add_argument("--sp-impl", choices=["ulysses", "ring"],
+                        default="ulysses",
+                        help="attention strategy under --sp: all-to-all "
+                             "head re-shard (ulysses) or K/V ring rotation")
     # The Pallas kernels ARE the shipped fast path on TPU; off-TPU the
     # unset default resolves to False (interpret-mode Pallas is a
     # debugging path that would make CPU smoke runs crawl).
@@ -102,7 +109,30 @@ def main() -> int:
         cfg = llama.tiny(max_seq_len=args.seq_len, remat=True, **kernel_kw)
 
     optimizer = optax.adamw(args.lr, weight_decay=0.1)
-    if args.pp:
+    if args.pp and args.sp:
+        parser.error("--pp and --sp are mutually exclusive layouts")
+    if args.sp:
+        if args.dp or args.fsdp or args.tp:
+            parser.error("--sp is a pure sequence-parallel layout; it "
+                         "cannot be combined with --dp/--fsdp/--tp")
+        if args.sp != n:
+            parser.error(f"--sp {args.sp} != {n} devices")
+        if args.seq_len % args.sp:
+            parser.error(f"--seq-len {args.seq_len} not divisible by --sp")
+        if args.sp_impl == "ulysses" and cfg.n_heads % args.sp:
+            parser.error(f"n_heads {cfg.n_heads} not divisible by --sp "
+                         f"(use --sp-impl ring)")
+        from pytorch_operator_tpu.parallel import make_sp_train_step
+        from pytorch_operator_tpu.parallel.mesh import make_sp_mesh
+
+        mesh = make_sp_mesh(dp=1, sp=args.sp)
+        print(f"[worker {pid}/{nprocs}] sequence-parallel mesh sp={args.sp} "
+              f"({args.sp_impl}) over {n} devices", flush=True)
+        state = sharded_init(cfg, mesh, optimizer,
+                             specs=llama.sp_param_specs(cfg))
+        step_fn = make_sp_train_step(cfg, mesh, optimizer,
+                                     impl=args.sp_impl)
+    elif args.pp:
         if args.dp or args.fsdp or args.tp:
             parser.error("--pp is a pure GPipe layout; it cannot be "
                          "combined with --dp/--fsdp/--tp")

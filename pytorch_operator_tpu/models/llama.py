@@ -30,7 +30,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pytorch_operator_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_TP
 
@@ -203,7 +203,7 @@ def _attention(q, k, v, cfg: LlamaConfig):
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def _layer(h, lp, cfg: LlamaConfig, cos, sin):
+def _layer(h, lp, cfg: LlamaConfig, cos, sin, attn=None):
     B, T, D = h.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
 
@@ -213,7 +213,7 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin):
     v = jnp.einsum("btd,dk->btk", x, lp["wv"]).reshape(B, T, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = _attention(q, k, v, cfg).reshape(B, T, nh * hd)
+    attn = (attn or _attention)(q, k, v, cfg).reshape(B, T, nh * hd)
     h = h + jnp.einsum("btk,kd->btd", attn, lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.use_fused_norm)
@@ -224,16 +224,17 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin):
 
 
 def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-                  apply_stack) -> jax.Array:
+                  apply_stack, attn=None) -> jax.Array:
     """Shared prologue/epilogue around the decoder stack: embed + RoPE
     tables in, final norm + weight-tied head out.  ``apply_stack(layers,
     h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
-    ring) — the only difference between forward and forward_pipelined."""
+    ring); ``attn`` overrides the per-layer attention (the SP forward
+    routes it through ring/all-to-all shard_map strategies)."""
     T = tokens.shape[1]
     h = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_table(cfg, T)
 
-    body = partial(_layer, cfg=cfg, cos=cos, sin=sin)
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin, attn=attn)
     if cfg.remat:
         if cfg.remat_policy:
             body = jax.checkpoint(
@@ -295,6 +296,70 @@ def forward_pipelined(
         )
 
     return _forward_with(params, tokens, cfg, apply_stack)
+
+
+def forward_sp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    *,
+    axis_name: str = "sp",
+    impl: str = "ulysses",
+) -> jax.Array:
+    """Sequence-parallel forward for long-context training.
+
+    Activations stay sequence-sharded — (B, T/n, D) per device — through
+    every pointwise/matmul op (GSPMD propagates the layout from the
+    sharded tokens); only attention, the one op that mixes positions,
+    runs a sequence-parallel strategy via shard_map:
+
+      impl="ulysses"  all-to-all re-shard to head parallelism
+                      (parallel/ulysses.py; needs n_heads % n == 0)
+      impl="ring"     K/V rotation with online softmax
+                      (parallel/ring_attention.py; any head count)
+
+    GQA KV heads are broadcast before the strategy, matching what
+    _attention does internally.  Params replicate (``sp_param_specs``) —
+    sequence parallelism shards activations, not weights.  Reference
+    scope: the reference scales only DP replica count (SURVEY §2.4);
+    long-context is a TPU-build extension (SURVEY §5).
+    """
+    from pytorch_operator_tpu.parallel.ring_attention import ring_attention
+    from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
+
+    if impl not in ("ulysses", "ring"):
+        raise ValueError(f"unknown sp impl {impl!r}")
+
+    def attn(q, k, v, cfg):
+        groups = cfg.n_heads // cfg.n_kv_heads
+        if groups > 1:
+            k2 = jnp.repeat(k, groups, axis=2)
+            v2 = jnp.repeat(v, groups, axis=2)
+        else:
+            k2, v2 = k, v
+        if impl == "ulysses":
+            return ulysses_attention(q, k2, v2, mesh, axis_name=axis_name,
+                                     use_flash=cfg.use_flash)
+        return ring_attention(q, k2, v2, mesh, axis_name=axis_name).astype(q.dtype)
+
+    def apply_stack(layers, h, body):
+        # pin the (B, T, D) activations to the sequence-sharded layout;
+        # GSPMD propagates it through every pointwise/matmul op, so the
+        # memory-heavy tensors live T/n per device (the token ints stay
+        # replicated — they're negligible and T+1 is ragged)
+        h = lax.with_sharding_constraint(
+            h, NamedSharding(mesh, P(None, axis_name, None)))
+        return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
+
+    return _forward_with(params, tokens, cfg, apply_stack, attn=attn)
+
+
+def sp_param_specs(cfg: LlamaConfig) -> Params:
+    """Fully replicated parameter specs for the sequence-parallel layout
+    (SP shards activations over the sp axis, never the weights)."""
+    return jax.tree.map(lambda _: P(), param_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def pp_param_specs(cfg: LlamaConfig, axis_name: str = "pp") -> Params:

@@ -28,6 +28,7 @@ from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
 from pytorch_operator_tpu.parallel.train import (
     cross_entropy_loss,
     make_pp_train_step,
+    make_sp_train_step,
     make_train_step,
     sharded_init,
 )
@@ -49,6 +50,7 @@ __all__ = [
     "ulysses_attention",
     "cross_entropy_loss",
     "make_pp_train_step",
+    "make_sp_train_step",
     "make_train_step",
     "sharded_init",
 ]

@@ -137,6 +137,32 @@ def make_train_step(
     )
 
 
+def make_sp_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    *,
+    axis_name: str = "sp",
+    impl: str = "ulysses",
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """Jitted sequence-parallel training step for long contexts.
+
+    Batch is (B, T+1) tokens, replicated — T+1 is ragged against the sp
+    axis and token ints are negligible; llama.forward_sp pins the (B, T,
+    D) activations to the sequence-sharded layout, which is where the
+    memory lives.  Attention runs the chosen strategy (ulysses | ring);
+    params replicate (pair with ``sharded_init(..., specs=
+    llama.sp_param_specs(cfg))``); gradients of the replicated params
+    are reduced by the collectives GSPMD inserts, like the dp path.
+    """
+    return _make_step(
+        lambda params, inputs: llama.forward_sp(
+            params, inputs, cfg, mesh, axis_name=axis_name, impl=impl),
+        NamedSharding(mesh, P()),
+        optimizer,
+    )
+
+
 def make_pp_train_step(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
