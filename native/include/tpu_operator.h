@@ -74,6 +74,50 @@ int st_len(void* s);
 char* st_keys(void* s);                      /* '\n'-joined key list */
 void st_buf_free(char* p);
 
+/* ---- HTTP transport (plain TCP; TLS rides the Python fallback) -------- */
+
+/* ht_request return codes. */
+#define HT_OK 0
+#define HT_ERR_CONNECT (-1)  /* resolve/connect failed or timed out */
+#define HT_ERR_IO (-2)       /* send/recv failed mid-exchange */
+#define HT_ERR_PROTOCOL (-3) /* malformed response framing */
+
+/* One request/response exchange (Connection: close).  `headers` is a
+ * '\n'-joined list of "Name: value" lines (Host/Content-Length are
+ * added internally).  On HT_OK, *resp_body is a malloc'd NUL-terminated
+ * copy of the (de-chunked) body — release with ht_buf_free — with its
+ * true length in *resp_len (bodies may contain NUL bytes; use the
+ * length, not strlen) and *resp_status the HTTP status code. */
+int ht_request(const char* host, int port, const char* method,
+               const char* path, const char* headers, const char* body,
+               int body_len, double timeout, char** resp_body,
+               int* resp_len, int* resp_status);
+
+/* ws_next out-state values. */
+#define WS_OK 0      /* returned a line */
+#define WS_EOF 1     /* clean end of stream (server-side watch timeout) */
+#define WS_TIMEOUT 2 /* no data within timeout; stream still healthy */
+#define WS_ERROR 3   /* socket/framing error */
+
+/* Open a streaming GET (the watch endpoint): returns a handle or NULL
+ * on connect/send/header failure; *resp_status carries the HTTP status
+ * (error statuses still return a handle so the JSON Status body can be
+ * read via ws_next).  Single-owner: ws_next/ws_close must be called
+ * from one thread. */
+void* ws_open(const char* host, int port, const char* path,
+              const char* headers, double timeout, int* resp_status);
+
+/* Pop the next newline-delimited line of the de-chunked stream, blocking
+ * up to `timeout` seconds without the GIL.  Returns a malloc'd line
+ * (release with ht_buf_free; *len_out holds its true length) with
+ * *state=WS_OK, or NULL with *state telling why. */
+char* ws_next(void* w, double timeout, int* len_out, int* state);
+
+int ws_status(void* w);
+void ws_close(void* w);
+
+void ht_buf_free(char* p);
+
 #ifdef __cplusplus
 }
 #endif

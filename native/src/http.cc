@@ -1,0 +1,419 @@
+// Native HTTP/1.1 transport for the Kubernetes REST client.
+//
+// The reference's REST transport is compiled into its Go binary
+// (client-go rest.Config -> net/http); here the socket I/O, HTTP
+// framing, chunked-transfer decoding, and watch-stream line splitting
+// are C++ so a blocked read (a watch stream sits in a blocking read for
+// minutes at a time) never holds the Python GIL.  Plain TCP only: the
+// image has no OpenSSL headers, so TLS connections take the Python
+// ssl/http.client fallback (k8s/rest.py picks per scheme).
+//
+// Exported C API (see include/tpu_operator.h):
+//   ht_request    — one request/response exchange (Connection: close)
+//   ws_open/ws_next/ws_close — streaming watch: open a chunked response
+//                   and pop newline-delimited JSON event lines
+//   ht_buf_free   — release any malloc'd buffer returned by this module
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "tpu_operator.h"
+
+namespace {
+
+// ---- socket helpers ------------------------------------------------------
+
+// Connect with a deadline; returns fd or -1.  Non-blocking connect +
+// poll so an unreachable API server fails in `timeout` seconds instead
+// of the kernel's multi-minute SYN retry default.
+int connect_with_timeout(const char* host, int port, double timeout) {
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof portbuf, "%d", port);
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr) {
+    return -1;
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    timeval tv;
+    tv.tv_sec = static_cast<long>(timeout);
+    tv.tv_usec = static_cast<long>((timeout - tv.tv_sec) * 1e6);
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool send_all(int fd, const char* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Returns a malloc'd NUL-terminated copy and (optionally) the true
+// length — callers must use the length, not strlen, so bodies with
+// embedded NUL bytes (binary pod logs) survive the boundary intact.
+char* dup_string(const std::string& s, int* len_out = nullptr) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) {
+    std::memcpy(out, s.data(), s.size());
+    out[s.size()] = '\0';
+    if (len_out != nullptr) *len_out = static_cast<int>(s.size());
+  }
+  return out;
+}
+
+// ---- HTTP response framing ----------------------------------------------
+
+struct Response {
+  int status = 0;
+  bool chunked = false;
+  long content_length = -1;  // -1: read to EOF
+  std::string body;          // filled by read_body (non-streaming path)
+};
+
+// Reads from fd until the header/body separator; parses status line and
+// the two framing headers we act on.  Leftover bytes past the separator
+// (start of the body) are returned in `leftover`.
+bool read_headers(int fd, Response* resp, std::string* leftover) {
+  std::string buf;
+  char tmp[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    buf.append(tmp, static_cast<size_t>(n));
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20)) return false;  // runaway header block
+  }
+  // status line: HTTP/1.1 NNN reason
+  size_t sp = buf.find(' ');
+  if (sp == std::string::npos || sp + 4 > buf.size()) return false;
+  resp->status = std::atoi(buf.c_str() + sp + 1);
+  if (resp->status < 100) return false;
+  // headers (case-insensitive names per RFC 7230)
+  size_t pos = buf.find("\r\n") + 2;
+  while (pos < header_end) {
+    size_t eol = buf.find("\r\n", pos);
+    std::string line = buf.substr(pos, eol - pos);
+    pos = eol + 2;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string name = line.substr(0, colon);
+    for (char& c : name) c = static_cast<char>(std::tolower(c));
+    std::string value = line.substr(colon + 1);
+    size_t start = value.find_first_not_of(" \t");
+    if (start != std::string::npos) value = value.substr(start);
+    if (name == "transfer-encoding" &&
+        value.find("chunked") != std::string::npos) {
+      resp->chunked = true;
+    } else if (name == "content-length") {
+      resp->content_length = std::atol(value.c_str());
+    }
+  }
+  *leftover = buf.substr(header_end + 4);
+  return true;
+}
+
+// Incremental chunked-transfer decoder: feed raw bytes, collect decoded
+// payload.  Tracks state across feeds so it works for streaming watches.
+struct ChunkDecoder {
+  std::string raw;        // undecoded input tail
+  long remaining = 0;     // bytes left in current chunk payload
+  bool done = false;      // saw the terminal 0-length chunk
+
+  // Appends decoded payload bytes to `out`; returns false on a framing
+  // violation (bad chunk-size line).
+  bool feed(const char* data, size_t len, std::string* out) {
+    raw.append(data, len);
+    for (;;) {
+      if (done) return true;
+      if (remaining > 0) {
+        size_t take = std::min(static_cast<size_t>(remaining), raw.size());
+        out->append(raw, 0, take);
+        raw.erase(0, take);
+        remaining -= static_cast<long>(take);
+        if (remaining > 0) return true;  // need more input
+        remaining = -2;  // expect CRLF after chunk payload
+      }
+      if (remaining == -2) {
+        if (raw.size() < 2) return true;
+        raw.erase(0, 2);  // CRLF
+        remaining = 0;
+      }
+      // chunk-size line
+      size_t eol = raw.find("\r\n");
+      if (eol == std::string::npos) {
+        return raw.size() <= 256;  // size line can't be this long
+      }
+      long size = std::strtol(raw.c_str(), nullptr, 16);
+      if (size < 0 ||
+          (size == 0 && !std::isxdigit(static_cast<unsigned char>(raw[0])))) {
+        return false;
+      }
+      raw.erase(0, eol + 2);
+      if (size == 0) {
+        done = true;  // trailers, if any, are ignored
+        return true;
+      }
+      remaining = size;
+    }
+  }
+};
+
+// Reads the full body per the response framing (used by ht_request).
+bool read_body(int fd, Response* resp, const std::string& leftover) {
+  char tmp[16384];
+  if (resp->chunked) {
+    ChunkDecoder dec;
+    if (!dec.feed(leftover.data(), leftover.size(), &resp->body)) return false;
+    while (!dec.done) {
+      ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+      if (n <= 0) return dec.done;
+      if (!dec.feed(tmp, static_cast<size_t>(n), &resp->body)) return false;
+    }
+    return true;
+  }
+  resp->body = leftover;
+  if (resp->content_length >= 0) {
+    while (resp->body.size() < static_cast<size_t>(resp->content_length)) {
+      ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+      if (n <= 0) return false;
+      resp->body.append(tmp, static_cast<size_t>(n));
+    }
+    resp->body.resize(static_cast<size_t>(resp->content_length));
+    return true;
+  }
+  for (;;) {  // Connection: close framing — read to EOF
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n < 0) return false;
+    if (n == 0) return true;
+    resp->body.append(tmp, static_cast<size_t>(n));
+  }
+}
+
+std::string build_request(const char* method, const char* path,
+                          const char* host, const char* headers,
+                          const char* body, int body_len, bool close_conn) {
+  std::string req(method);
+  req += " ";
+  req += path;
+  req += " HTTP/1.1\r\nHost: ";
+  req += host;
+  req += "\r\n";
+  if (close_conn) req += "Connection: close\r\n";
+  if (headers != nullptr && headers[0] != '\0') {
+    // '\n'-joined "Name: value" lines from the binding layer
+    const char* p = headers;
+    while (*p != '\0') {
+      const char* nl = std::strchr(p, '\n');
+      size_t len = (nl != nullptr) ? static_cast<size_t>(nl - p)
+                                   : std::strlen(p);
+      if (len > 0) {
+        req.append(p, len);
+        req += "\r\n";
+      }
+      p += len + ((nl != nullptr) ? 1 : 0);
+    }
+  }
+  if (body != nullptr && body_len > 0) {
+    char cl[64];
+    std::snprintf(cl, sizeof cl, "Content-Length: %d\r\n", body_len);
+    req += cl;
+  }
+  req += "\r\n";
+  if (body != nullptr && body_len > 0) req.append(body, body_len);
+  return req;
+}
+
+// ---- streaming watch handle ---------------------------------------------
+
+struct WatchStream {
+  int fd = -1;
+  int status = 0;
+  bool chunked = false;
+  bool eof = false;
+  bool proto_error = false;  // framing violation: report WS_ERROR, not EOF
+  ChunkDecoder dec;
+  std::string decoded;  // decoded-but-unconsumed payload (line buffer)
+};
+
+}  // namespace
+
+extern "C" {
+
+int ht_request(const char* host, int port, const char* method,
+               const char* path, const char* headers, const char* body,
+               int body_len, double timeout, char** resp_body,
+               int* resp_len, int* resp_status) {
+  *resp_body = nullptr;
+  *resp_len = 0;
+  *resp_status = 0;
+  int fd = connect_with_timeout(host, port, timeout);
+  if (fd < 0) return HT_ERR_CONNECT;
+  std::string req = build_request(method, path, host, headers, body,
+                                  body_len, /*close_conn=*/true);
+  int rc = HT_OK;
+  Response resp;
+  std::string leftover;
+  if (!send_all(fd, req.data(), req.size())) {
+    rc = HT_ERR_IO;
+  } else if (!read_headers(fd, &resp, &leftover) ||
+             !read_body(fd, &resp, leftover)) {
+    rc = HT_ERR_PROTOCOL;
+  } else {
+    *resp_status = resp.status;
+    *resp_body = dup_string(resp.body, resp_len);
+    if (*resp_body == nullptr) rc = HT_ERR_IO;
+  }
+  close(fd);
+  return rc;
+}
+
+void* ws_open(const char* host, int port, const char* path,
+              const char* headers, double timeout, int* resp_status) {
+  *resp_status = 0;
+  int fd = connect_with_timeout(host, port, timeout);
+  if (fd < 0) return nullptr;
+  // keep the connection open for the stream; the server ends it
+  std::string req = build_request("GET", path, host, headers, nullptr, 0,
+                                  /*close_conn=*/false);
+  if (!send_all(fd, req.data(), req.size())) {
+    close(fd);
+    return nullptr;
+  }
+  Response resp;
+  std::string leftover;
+  if (!read_headers(fd, &resp, &leftover)) {
+    close(fd);
+    return nullptr;
+  }
+  *resp_status = resp.status;
+  auto* ws = new WatchStream();
+  ws->fd = fd;
+  ws->status = resp.status;
+  ws->chunked = resp.chunked;
+  if (resp.status >= 400) {
+    // Error responses carry a JSON Status body — read it in full here
+    // (honouring whatever framing the server chose, incl. a
+    // Content-Length body with no trailing newline on a keep-alive
+    // connection) and surface it through ws_next before EOF.
+    read_body(fd, &resp, leftover);
+    ws->decoded = resp.body;
+    ws->eof = true;
+    return ws;
+  }
+  if (resp.chunked) {
+    if (!ws->dec.feed(leftover.data(), leftover.size(), &ws->decoded)) {
+      ws->proto_error = true;
+    }
+  } else {
+    ws->decoded = leftover;
+  }
+  return ws;
+}
+
+char* ws_next(void* w, double timeout, int* len_out, int* state) {
+  auto* ws = static_cast<WatchStream*>(w);
+  *state = WS_OK;
+  *len_out = 0;
+  char tmp[16384];
+  for (;;) {
+    size_t nl = ws->decoded.find('\n');
+    if (nl != std::string::npos) {
+      std::string line = ws->decoded.substr(0, nl);
+      ws->decoded.erase(0, nl + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (line.empty()) continue;  // keep-alive blank line
+      return dup_string(line, len_out);
+    }
+    if (ws->proto_error) {
+      // a framing violation must not masquerade as clean EOF: the
+      // caller needs WS_ERROR so its watch loop relists (GAP) instead
+      // of resuming from a resourceVersion it may have half-read past
+      *state = WS_ERROR;
+      return nullptr;
+    }
+    if (ws->eof || (ws->chunked && ws->dec.done)) {
+      // flush a final unterminated line, then signal EOF
+      if (!ws->decoded.empty()) {
+        std::string line = ws->decoded;
+        ws->decoded.clear();
+        return dup_string(line, len_out);
+      }
+      *state = WS_EOF;
+      return nullptr;
+    }
+    pollfd pfd{ws->fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, static_cast<int>(timeout * 1000));
+    if (pr == 0) {
+      *state = WS_TIMEOUT;
+      return nullptr;
+    }
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      *state = WS_ERROR;
+      return nullptr;
+    }
+    ssize_t n = recv(ws->fd, tmp, sizeof tmp, 0);
+    if (n < 0) {
+      *state = WS_ERROR;
+      return nullptr;
+    }
+    if (n == 0) {
+      ws->eof = true;
+      continue;  // loop flushes any tail line, then reports EOF
+    }
+    if (ws->chunked) {
+      if (!ws->dec.feed(tmp, static_cast<size_t>(n), &ws->decoded)) {
+        ws->proto_error = true;
+        *state = WS_ERROR;
+        return nullptr;
+      }
+    } else {
+      ws->decoded.append(tmp, static_cast<size_t>(n));
+    }
+  }
+}
+
+int ws_status(void* w) { return static_cast<WatchStream*>(w)->status; }
+
+void ws_close(void* w) {
+  // Single-owner contract: the thread that calls ws_next is the only
+  // one allowed to call ws_close (the Python watch loop polls ws_next
+  // with a short timeout and checks its stop flag between calls, so no
+  // ws_next is ever in flight here).
+  auto* ws = static_cast<WatchStream*>(w);
+  if (ws->fd >= 0) {
+    shutdown(ws->fd, SHUT_RDWR);
+    close(ws->fd);
+    ws->fd = -1;
+  }
+  delete ws;
+}
+
+void ht_buf_free(char* p) { std::free(p); }
+
+}  // extern "C"

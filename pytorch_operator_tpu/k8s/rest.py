@@ -142,11 +142,27 @@ class KubeConfig:
 
 
 class RestClient:
-    """Thin JSON-over-HTTP client with k8s error mapping."""
+    """Thin JSON-over-HTTP client with k8s error mapping.
+
+    Plain-HTTP endpoints (stub server, `kubectl proxy`, `--master
+    http://...`) ride the native C++ transport when it is available
+    (socket I/O + framing + chunked decoding with the GIL released,
+    native/src/http.cc); TLS endpoints always use the Python
+    ssl/http.client path — the image carries no OpenSSL headers, so the
+    native core does not link TLS.  `PYTORCH_OPERATOR_NATIVE=0` forces
+    the Python path everywhere.
+    """
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0):
         self.config = config
         self.timeout = timeout
+        self.native = None
+        if config.scheme == "http":
+            from pytorch_operator_tpu import native as _native
+
+            if _native.resolve_backend("http transport"):
+                self.native = _native.NativeHttpTransport(
+                    config.host, config.port, timeout)
 
     def _connect(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
         ctx = self.config.ssl_context()
@@ -168,12 +184,18 @@ class RestClient:
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 content_type: str = "application/json") -> dict:
+        headers = self._headers(content_type if body is not None else None)
+        payload = json.dumps(body) if body is not None else None
+        if self.native is not None:
+            status, data = self.native.request(
+                method, path, headers=headers,
+                body=payload.encode() if payload is not None else None)
+            if status >= 400:
+                self._raise_for(status, data)
+            return json.loads(data) if data else {}
         conn = self._connect()
         try:
-            payload = json.dumps(body) if body is not None else None
-            conn.request(method, path, body=payload,
-                         headers=self._headers(
-                             content_type if body is not None else None))
+            conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             if resp.status >= 400:
@@ -184,6 +206,12 @@ class RestClient:
 
     def request_text(self, method: str, path: str) -> str:
         """Raw-text request (pod logs endpoint returns plain text)."""
+        if self.native is not None:
+            status, data = self.native.request(
+                method, path, headers=self._headers())
+            if status >= 400:
+                self._raise_for(status, data)
+            return data.decode(errors="replace")
         conn = self._connect()
         try:
             conn.request(method, path, headers=self._headers())
@@ -343,14 +371,33 @@ class RestResourceStore:
             except Exception:
                 pass
 
+    def _dispatch_event(self, event: dict, rv: str) -> str:
+        """Apply one watch event to the listeners; returns the advanced
+        resourceVersion (shared by the native and Python stream loops)."""
+        etype = event.get("type")
+        obj = event.get("object") or {}
+        if etype == "ERROR":
+            # e.g. 410 Gone after etcd compaction: the stored
+            # rv is useless — raise so the loop restarts fresh
+            raise ApiError(f"watch error event: {obj}")
+        new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if new_rv:
+            rv = new_rv
+        if etype in (ADDED, MODIFIED, DELETED):
+            for fn in list(self._listeners):
+                fn(etype, obj)
+        return rv
+
     def _watch_once(self, rv: str) -> str:
         q = "watch=true&allowWatchBookmarks=true"
         if rv:
             q += f"&resourceVersion={rv}"
+        path = self._path(self._namespace, query=q)
+        if self._client.native is not None:
+            return self._watch_once_native(path, rv)
         conn = self._client._connect(timeout=300.0)
         try:
-            conn.request("GET", self._path(self._namespace, query=q),
-                         headers=self._client._headers())
+            conn.request("GET", path, headers=self._client._headers())
             resp = conn.getresponse()
             if resp.status >= 400:
                 RestClient._raise_for(resp.status, resp.read())
@@ -365,22 +412,44 @@ class RestResourceStore:
                     line, buf = buf.split(b"\n", 1)
                     if not line.strip():
                         continue
-                    event = json.loads(line)
-                    etype = event.get("type")
-                    obj = event.get("object") or {}
-                    if etype == "ERROR":
-                        # e.g. 410 Gone after etcd compaction: the stored
-                        # rv is useless — raise so the loop restarts fresh
-                        raise ApiError(f"watch error event: {obj}")
-                    new_rv = (obj.get("metadata") or {}).get("resourceVersion")
-                    if new_rv:
-                        rv = new_rv
-                    if etype in (ADDED, MODIFIED, DELETED):
-                        for fn in list(self._listeners):
-                            fn(etype, obj)
+                    rv = self._dispatch_event(json.loads(line), rv)
             return rv
         finally:
             conn.close()
+
+    def _watch_once_native(self, path: str, rv: str) -> str:
+        """One watch stream over the C++ transport: the blocking reads
+        and chunked decoding happen in native code with the GIL
+        released; this thread only wakes to parse complete JSON lines
+        (or once a second to check the stop flag)."""
+        from pytorch_operator_tpu import native as nat
+
+        stream = self._client.native.open_watch(
+            path, headers=self._client._headers())
+        try:
+            if stream.status >= 400:
+                body = b""
+                while True:
+                    line, state = stream.next_line(timeout=1.0)
+                    if state != nat.WS_OK:
+                        break
+                    body += line + b"\n"
+                RestClient._raise_for(stream.status, body)
+            self._watch_ready.set()
+            while not self._watch_stop.is_set():
+                line, state = stream.next_line(timeout=1.0)
+                if state == nat.WS_TIMEOUT:
+                    continue  # idle stream; re-check the stop flag
+                if state == nat.WS_EOF:
+                    return rv  # clean server-side watch timeout
+                if state == nat.WS_ERROR:
+                    raise ApiError("native watch stream error")
+                if not line.strip():
+                    continue
+                rv = self._dispatch_event(json.loads(line), rv)
+            return rv
+        finally:
+            stream.close()
 
 
 class RestCluster:

@@ -86,6 +86,28 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.st_keys.restype = ctypes.POINTER(ctypes.c_char)
     lib.st_keys.argtypes = [c_void]
     lib.st_buf_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+
+    # HTTP transport: malloc'd response buffers come back through
+    # char** / char* out-params, freed via ht_buf_free
+    c_int = ctypes.c_int
+    lib.ht_request.restype = c_int
+    lib.ht_request.argtypes = [
+        c_char, c_int, c_char, c_char, c_char, c_char, c_int,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(c_int),
+        ctypes.POINTER(c_int),
+    ]
+    lib.ws_open.restype = c_void
+    lib.ws_open.argtypes = [c_char, c_int, c_char, c_char,
+                            ctypes.c_double, ctypes.POINTER(c_int)]
+    lib.ws_next.restype = ctypes.POINTER(ctypes.c_char)
+    lib.ws_next.argtypes = [c_void, ctypes.c_double,
+                            ctypes.POINTER(c_int), ctypes.POINTER(c_int)]
+    lib.ws_status.restype = c_int
+    lib.ws_status.argtypes = [c_void]
+    lib.ws_close.argtypes = [c_void]
+    lib.ht_buf_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
     return lib
 
 
@@ -277,6 +299,121 @@ class NativeExpectations:
             if getattr(self, "_e", None):
                 self._lib.exp_free(self._e)
                 self._e = None
+        except Exception:
+            pass
+
+
+class NativeHttpError(OSError):
+    """Connect/IO/protocol failure inside the native transport."""
+
+
+# ht_request return codes (tpu_operator.h)
+_HT_ERRORS = {-1: "connect failed or timed out", -2: "send/recv failed",
+              -3: "malformed HTTP response"}
+
+# ws_next out-state values (tpu_operator.h)
+WS_OK, WS_EOF, WS_TIMEOUT, WS_ERROR = 0, 1, 2, 3
+
+
+class NativeHttpTransport:
+    """Plain-TCP HTTP/1.1 exchanges + streaming watch via the C++ core.
+
+    The native side owns socket I/O, response framing, chunked-transfer
+    decoding and watch line splitting (native/src/http.cc); blocking
+    reads run with the GIL released, so a watch stream parked in a
+    minutes-long read never stalls the interpreter.  TLS endpoints stay
+    on the Python ssl/http.client path (k8s/rest.py selects by scheme).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    @staticmethod
+    def _join_headers(headers: Optional[dict]) -> bytes:
+        if not headers:
+            return b""
+        return "\n".join(f"{k}: {v}" for k, v in headers.items()).encode()
+
+    def _take(self, ptr, length: int) -> Optional[bytes]:
+        """string_at with the C-reported length, NOT c_char_p (which
+        would truncate bodies containing NUL bytes, e.g. binary logs)."""
+        if not ptr:
+            return None
+        try:
+            return ctypes.string_at(ptr, length)
+        finally:
+            self._lib.ht_buf_free(ptr)
+
+    def request(self, method: str, path: str,
+                headers: Optional[dict] = None,
+                body: Optional[bytes] = None,
+                timeout: Optional[float] = None) -> Tuple[int, bytes]:
+        """One exchange; returns (status, body) or raises NativeHttpError."""
+        out_body = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_int()
+        out_status = ctypes.c_int()
+        rc = self._lib.ht_request(
+            self.host.encode(), self.port, method.encode(), path.encode(),
+            self._join_headers(headers), body or b"",
+            len(body) if body else 0, timeout or self.timeout,
+            ctypes.byref(out_body), ctypes.byref(out_len),
+            ctypes.byref(out_status))
+        data = self._take(out_body, out_len.value)
+        if rc != 0:
+            raise NativeHttpError(
+                f"{method} {path}: {_HT_ERRORS.get(rc, f'error {rc}')}")
+        return out_status.value, data or b""
+
+    def open_watch(self, path: str, headers: Optional[dict] = None,
+                   timeout: Optional[float] = None) -> "NativeWatchStream":
+        out_status = ctypes.c_int()
+        h = self._lib.ws_open(self.host.encode(), self.port, path.encode(),
+                              self._join_headers(headers),
+                              timeout or self.timeout,
+                              ctypes.byref(out_status))
+        if not h:
+            raise NativeHttpError(f"watch {path}: connect/handshake failed")
+        return NativeWatchStream(self._lib, h, out_status.value)
+
+
+class NativeWatchStream:
+    """Line iterator over a streaming chunked response (single-owner:
+    next_line/close must run on one thread — the store's watch loop)."""
+
+    def __init__(self, lib, handle, status: int):
+        self._lib = lib
+        self._h = handle
+        self.status = status
+
+    def next_line(self, timeout: float = 1.0):
+        """(line_bytes, state) — line is None unless state == WS_OK."""
+        if not self._h:
+            return None, WS_EOF
+        state = ctypes.c_int()
+        length = ctypes.c_int()
+        ptr = self._lib.ws_next(self._h, timeout, ctypes.byref(length),
+                                ctypes.byref(state))
+        if not ptr:
+            return None, state.value
+        try:
+            return ctypes.string_at(ptr, length.value), WS_OK
+        finally:
+            self._lib.ht_buf_free(ptr)
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.ws_close(h)
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass
 

@@ -32,9 +32,22 @@ def stub():
     server.stop()
 
 
-@pytest.fixture
-def rest(stub):
+@pytest.fixture(params=["native", "python"])
+def rest(stub, request, monkeypatch):
+    """Every REST test runs twice: once over the native C++ transport
+    (the default for plain-HTTP endpoints) and once with the Python
+    http.client fallback forced — the path TLS endpoints always take."""
+    if request.param == "python":
+        monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE", "0")
     cluster = RestCluster(KubeConfig("127.0.0.1", stub.port))
+    if request.param == "python":
+        assert cluster.client.native is None
+    else:
+        # hard requirement, not best-effort: a broken native build must
+        # fail this suite, not silently re-run the Python path twice
+        assert cluster.client.native is not None, (
+            "native transport failed to load — the 'native' param would "
+            "silently test the Python path twice")
     yield cluster
     cluster.close()
 
@@ -95,6 +108,29 @@ class TestRestCrud:
         rest.pods.delete("default", "p1")
         with pytest.raises(NotFoundError):
             rest.pods.get("default", "p1")
+
+    def test_large_object_roundtrip(self, rest):
+        """A ~300KB object spans many socket reads (and many chunks on
+        the watch stream) — exercises the transport's incremental
+        framing, not just single-recv happy paths."""
+        big = pod("big")
+        big["metadata"]["annotations"] = {
+            f"blob-{i}": "x" * 4096 for i in range(75)}
+        events = []
+        got = threading.Event()
+
+        def on_event(et, obj):
+            if obj["metadata"]["name"] == "big":
+                events.append((et, obj))
+                got.set()
+
+        rest.pods.add_listener(on_event)
+        rest.pods.create("default", big)
+        assert got.wait(10.0)
+        fetched = rest.pods.get("default", "big")
+        assert fetched["metadata"]["annotations"] == big["metadata"]["annotations"]
+        assert events[0][1]["metadata"]["annotations"][
+            "blob-74"] == "x" * 4096
 
 
 class TestRestWatch:
