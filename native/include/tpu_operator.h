@@ -74,6 +74,36 @@ int st_len(void* s);
 char* st_keys(void* s);                      /* '\n'-joined key list */
 void st_buf_free(char* p);
 
+/* ---- reconcile decision core ------------------------------------------ */
+
+/* Exit-code retry classification (train_util.go:18-53 + TPU extension):
+ * 1 retryable, 0 permanent. */
+int rc_retryable_exit_code(int exit_code, int tpu_aware);
+
+/* Compute the reconcile plan for one replica type.
+ *
+ * pods: n_pods rows of 3 ints [index, phase, exit_code] where
+ *   index      = replica-index label value (rows with index outside
+ *                [0, replicas) are ignored, matching getPodSlices)
+ *   phase      = 0 other/Pending, 1 Running, 2 Succeeded, 3 Failed
+ *   exit_code  = terminated exit code of the framework container (0 if
+ *                not terminated)
+ *
+ * Outputs (caller-allocated):
+ *   create_out (cap >= replicas)  — indices needing a new pod, ascending
+ *   delete_out (cap >= n_pods)    — row positions to delete (ExitCode retry)
+ *   warn_out   (cap >= replicas)  — indices holding >1 pods
+ *   counts[3]                     — active/succeeded/failed tallies over
+ *                                   single-occupant slices
+ *   restart_out                   — 1 if any retry delete was planned
+ *
+ * Returns 0 on success, -1 on invalid sizes (negative, or replicas >
+ * 4096 — far above the CRD's validation bounds). */
+int rc_plan(int replicas, int restart_policy_exit_code, int tpu_aware,
+            const int* pods, int n_pods, int* create_out, int* n_create,
+            int* delete_out, int* n_delete, int* warn_out, int* n_warn,
+            int* counts, int* restart_out);
+
 /* ---- HTTP transport (plain TCP; TLS rides the Python fallback) -------- */
 
 /* ht_request return codes. */

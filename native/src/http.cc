@@ -15,6 +15,7 @@
 //   ht_buf_free   — release any malloc'd buffer returned by this module
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -32,9 +33,13 @@ namespace {
 
 // ---- socket helpers ------------------------------------------------------
 
-// Connect with a deadline; returns fd or -1.  Non-blocking connect +
-// poll so an unreachable API server fails in `timeout` seconds instead
-// of the kernel's multi-minute SYN retry default.
+// Connect with a deadline; returns fd or -1.  True non-blocking
+// connect + poll(POLLOUT) so an unreachable API server fails in
+// `timeout` seconds instead of the kernel's multi-minute SYN retry
+// default, on any POSIX platform (SO_SNDTIMEO bounding connect() is a
+// Linux-only behavior).  Name resolution (getaddrinfo) has no portable
+// deadline — in-cluster the API server host is a plain IP, so this is
+// the rare path.
 int connect_with_timeout(const char* host, int port, double timeout) {
   char portbuf[16];
   std::snprintf(portbuf, sizeof portbuf, "%d", port);
@@ -49,12 +54,32 @@ int connect_with_timeout(const char* host, int port, double timeout) {
   for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
     fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    timeval tv;
-    tv.tv_sec = static_cast<long>(timeout);
-    tv.tv_usec = static_cast<long>((timeout - tv.tv_sec) * 1e6);
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    int flags = fcntl(fd, F_GETFL, 0);
+    bool ok = false;
+    if (flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0) {
+      int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+      if (rc == 0) {
+        ok = true;
+      } else if (errno == EINPROGRESS) {
+        pollfd pfd{fd, POLLOUT, 0};
+        if (poll(&pfd, 1, static_cast<int>(timeout * 1000)) == 1) {
+          int err = 0;
+          socklen_t len = sizeof err;
+          ok = (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) == 0 &&
+                err == 0);
+        }
+      }
+    }
+    if (ok) {
+      // back to blocking; per-op deadlines via the socket timeouts
+      fcntl(fd, F_SETFL, flags);
+      timeval tv;
+      tv.tv_sec = static_cast<long>(timeout);
+      tv.tv_usec = static_cast<long>((timeout - tv.tv_sec) * 1e6);
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+      break;
+    }
     close(fd);
     fd = -1;
   }

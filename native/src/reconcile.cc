@@ -1,0 +1,112 @@
+// Native reconcile decision core.
+//
+// The per-sync decision kernel of the pod reconciler — the hot loop the
+// reference runs in compiled Go (pkg/controller.v1/pytorch/pod.go:49-117
+// plus the train_util exit-code table) — as pure functions over compact
+// rows.  The Python controller extracts (index, phase, exit_code) per
+// observed pod, calls rc_plan, then performs the I/O the plan dictates
+// (pod creates/deletes, events, status tallies).  Pure decision logic:
+// no allocation beyond caller buffers, no locks, trivially testable for
+// equivalence against the Python fallback.
+
+#include "tpu_operator.h"
+
+namespace {
+
+// Phase encoding shared with the binding layer (rc_plan docs).
+constexpr int kPhaseRunning = 1;
+constexpr int kPhaseSucceeded = 2;
+constexpr int kPhaseFailed = 3;
+
+}  // namespace
+
+extern "C" {
+
+int rc_retryable_exit_code(int exit_code, int tpu_aware) {
+  // Mirror of controller/train_util.py (itself mirroring the
+  // reference's train_util.go:18-53 with the TPU extension):
+  // permanent: 1,2,126,127,128,139; retryable signals: 130,137,143;
+  // user-defined retryable: 138; TPU transients (when tpu_aware):
+  // 134 SIGABRT (libtpu chip-lock contention), 135 SIGBUS (slice
+  // preemption HBM teardown).
+  switch (exit_code) {
+    case 1:
+    case 2:
+    case 126:
+    case 127:
+    case 128:
+    case 139:
+      return 0;
+    case 130:
+    case 137:
+    case 143:
+      return 1;
+    case 138:
+      return 1;
+    case 134:
+    case 135:
+      return tpu_aware ? 1 : 0;
+    default:
+      return 0;
+  }
+}
+
+int rc_plan(int replicas, int restart_policy_exit_code, int tpu_aware,
+            const int* pods, int n_pods, int* create_out, int* n_create,
+            int* delete_out, int* n_delete, int* warn_out, int* n_warn,
+            int* counts, int* restart_out) {
+  *n_create = 0;
+  *n_delete = 0;
+  *n_warn = 0;
+  counts[0] = counts[1] = counts[2] = 0;  // active, succeeded, failed
+  *restart_out = 0;
+  if (replicas < 0 || n_pods < 0) return -1;
+
+  // Slice occupancy: count pods per in-range index and remember the row
+  // of the single occupant (only single-occupant slices get status
+  // tallies and retry decisions — pod.go:56-92 semantics).
+  // replicas is bounded by the CRD schema (small); stack VLA avoided
+  // for portability — use a fixed cap with overflow guard.
+  constexpr int kMaxReplicas = 4096;
+  if (replicas > kMaxReplicas) return -1;
+  int occupancy[kMaxReplicas];
+  int sole_row[kMaxReplicas];
+  for (int i = 0; i < replicas; ++i) {
+    occupancy[i] = 0;
+    sole_row[i] = -1;
+  }
+  for (int r = 0; r < n_pods; ++r) {
+    int index = pods[r * 3];
+    if (index < 0 || index >= replicas) continue;  // get_pod_slices drop
+    if (++occupancy[index] == 1) {
+      sole_row[index] = r;
+    }
+  }
+
+  for (int i = 0; i < replicas; ++i) {
+    if (occupancy[i] == 0) {
+      create_out[(*n_create)++] = i;
+    } else if (occupancy[i] > 1) {
+      warn_out[(*n_warn)++] = i;
+    } else {
+      int r = sole_row[i];
+      int phase = pods[r * 3 + 1];
+      int exit_code = pods[r * 3 + 2];
+      if (restart_policy_exit_code && phase == kPhaseFailed &&
+          rc_retryable_exit_code(exit_code, tpu_aware)) {
+        delete_out[(*n_delete)++] = r;
+        *restart_out = 1;
+      }
+      if (phase == kPhaseRunning) {
+        ++counts[0];
+      } else if (phase == kPhaseSucceeded) {
+        ++counts[1];
+      } else if (phase == kPhaseFailed) {
+        ++counts[2];
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -20,8 +20,8 @@ from ..runtime.job_controller import gen_general_name, gen_pod_group_name
 from ..runtime.logger import logger_for_pod, logger_for_replica
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from . import config as initconfig
+from . import reconcile_plan
 from . import status as status_machine
-from . import train_util
 from .tpu_env import set_cluster_spec
 
 POD_TEMPLATE_RESTART_POLICY_REASON = "SettedPodTemplateRestartPolicy"
@@ -48,28 +48,57 @@ class PodReconcilerMixin:
         log = logger_for_replica(self.logger, job, rt)
         pods = self.filter_pods_for_replica_type(pods, rt)
         replicas = int(spec.replicas or 0)
-        restart = False
+        exit_code_policy = (
+            spec.restart_policy == constants.RESTART_POLICY_EXIT_CODE)
 
         status_machine.initialize_replica_statuses(job.status, rtype)
 
-        pod_slices = self.get_pod_slices(pods, replicas)
-        for index, pod_slice in enumerate(pod_slices):
-            if len(pod_slice) > 1:
-                log.warning("We have too many pods for %s %d", rt, index)
-            elif len(pod_slice) == 0:
+        # Encode observed pods into plan rows and hand the decisions to
+        # the reconcile kernel (native C++ when available,
+        # reconcile_plan.plan_replica_set_py otherwise); this method then
+        # performs the I/O the plan dictates, in ascending index order
+        # like the reference's inline loop (pod.go:56-92).
+        rows = []
+        for pod in pods:
+            labels = pod.get("metadata", {}).get("labels") or {}
+            try:
+                index = int(labels.get(constants.LABEL_REPLICA_INDEX))
+            except (TypeError, ValueError):
+                index = -1
+            phase = (pod.get("status") or {}).get("phase")
+            exit_code = 0
+            for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+                terminated = (cs.get("state") or {}).get("terminated")
+                if cs.get("name") == constants.DEFAULT_CONTAINER_NAME and terminated:
+                    exit_code = terminated.get("exitCode", 0)
+            rows.append((index, reconcile_plan.encode_phase(phase), exit_code))
+
+        creates, delete_rows, warns, counts, restart = (
+            reconcile_plan.plan_replica_set(replicas, exit_code_policy, rows))
+
+        create_set = frozenset(creates)
+        warn_set = frozenset(warns)
+        delete_set = frozenset(delete_rows)
+        sole_row_by_index = {}
+        for r, (index, _, _) in enumerate(rows):
+            if 0 <= index < replicas and index not in warn_set:
+                sole_row_by_index[index] = r
+
+        for index in range(replicas):
+            if index in create_set:
                 log.info("Need to create new pod: %s-%d", rt, index)
                 master_role = rtype == constants.REPLICA_TYPE_MASTER
                 self.create_new_pod(job, job_dict, rtype, str(index), spec,
                                     master_role, gang_enabled=gang_enabled)
+            elif index in warn_set:
+                log.warning("We have too many pods for %s %d", rt, index)
             else:
-                pod = pod_slice[0]
-                phase = (pod.get("status") or {}).get("phase")
-                if spec.restart_policy == constants.RESTART_POLICY_EXIT_CODE:
-                    exit_code = 0
+                r = sole_row_by_index[index]
+                pod = pods[r]
+                if exit_code_policy:
                     for cs in (pod.get("status") or {}).get("containerStatuses") or []:
                         terminated = (cs.get("state") or {}).get("terminated")
                         if cs.get("name") == constants.DEFAULT_CONTAINER_NAME and terminated:
-                            exit_code = terminated.get("exitCode", 0)
                             self.recorder.eventf(
                                 job_dict,
                                 EVENT_TYPE_NORMAL,
@@ -77,19 +106,19 @@ class PodReconcilerMixin:
                                 "Pod: %s.%s exited with code %s",
                                 pod["metadata"].get("namespace", ""),
                                 pod["metadata"].get("name", ""),
-                                exit_code,
+                                terminated.get("exitCode", 0),
                             )
-                    if phase == "Failed" and train_util.is_retryable_exit_code(exit_code):
-                        logger_for_pod(self.logger, pod, job).info(
-                            "Need to restart the pod: %s", pod["metadata"].get("name")
-                        )
-                        self.pod_control.delete_pod(
-                            pod["metadata"].get("namespace", ""),
-                            pod["metadata"].get("name", ""),
-                            job_dict,
-                        )
-                        restart = True
-                status_machine.update_replica_statuses(job.status, rtype, pod)
+                if r in delete_set:
+                    logger_for_pod(self.logger, pod, job).info(
+                        "Need to restart the pod: %s", pod["metadata"].get("name")
+                    )
+                    self.pod_control.delete_pod(
+                        pod["metadata"].get("namespace", ""),
+                        pod["metadata"].get("name", ""),
+                        job_dict,
+                    )
+
+        status_machine.apply_replica_counts(job.status, rtype, *counts)
 
         self.update_status_single(job, job_dict, rtype, replicas, restart)
 

@@ -1,0 +1,104 @@
+"""Reconcile decision kernel: pure plan computation for one replica set.
+
+The reference's pod reconciler makes its decisions inline in compiled Go
+(pkg/controller.v1/pytorch/pod.go:49-117: slice grouping, missing-index
+creation, ExitCode retry via the train_util table, per-phase tallies).
+Here those decisions are a pure function over compact rows so the hot
+per-sync path can run in the native C++ core (native/src/reconcile.cc)
+with this module as the behavior-defining Python fallback; the
+controller performs the I/O (creates, deletes, events) the plan
+dictates.
+
+Row encoding (shared with the C side, tpu_operator.h):
+  (index, phase, exit_code) — index is the replica-index label value
+  (-1 when missing/unparseable), phase is PHASE_*, exit_code the
+  terminated exit code of the framework container (0 if none).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from . import train_util
+
+PHASE_OTHER = 0     # Pending / Unknown / anything untallied
+PHASE_RUNNING = 1
+PHASE_SUCCEEDED = 2
+PHASE_FAILED = 3
+
+_PHASE_ENCODING = {
+    "Running": PHASE_RUNNING,
+    "Succeeded": PHASE_SUCCEEDED,
+    "Failed": PHASE_FAILED,
+}
+
+# (creates, delete_row_positions, warn_indices,
+#  (active, succeeded, failed), restart)
+Plan = Tuple[List[int], List[int], List[int], Tuple[int, int, int], bool]
+
+
+def encode_phase(phase) -> int:
+    return _PHASE_ENCODING.get(phase, PHASE_OTHER)
+
+
+def plan_replica_set_py(replicas: int, exit_code_policy: bool,
+                        rows: Sequence[Tuple[int, int, int]],
+                        tpu_aware: bool = True) -> Plan:
+    """Pure-Python reference implementation (pod.go:49-117 semantics):
+
+    - an index with no pod is created;
+    - an index with >1 pods only warns (no tally, no retry — the next
+      sync acts once the duplicates resolve);
+    - an index with exactly one pod is tallied by phase, and under the
+      ExitCode policy a Failed pod with a retryable code is deleted so
+      the following sync recreates it.
+    """
+    slices: List[List[int]] = [[] for _ in range(replicas)]
+    for r, (index, _, _) in enumerate(rows):
+        if 0 <= index < replicas:
+            slices[index].append(r)
+
+    creates: List[int] = []
+    deletes: List[int] = []
+    warns: List[int] = []
+    active = succeeded = failed = 0
+    restart = False
+    for index, rs in enumerate(slices):
+        if not rs:
+            creates.append(index)
+        elif len(rs) > 1:
+            warns.append(index)
+        else:
+            r = rs[0]
+            _, phase, exit_code = rows[r]
+            if (exit_code_policy and phase == PHASE_FAILED
+                    and train_util.is_retryable_exit_code(
+                        exit_code, tpu_aware=tpu_aware)):
+                deletes.append(r)
+                restart = True
+            if phase == PHASE_RUNNING:
+                active += 1
+            elif phase == PHASE_SUCCEEDED:
+                succeeded += 1
+            elif phase == PHASE_FAILED:
+                failed += 1
+    return creates, deletes, warns, (active, succeeded, failed), restart
+
+
+def plan_replica_set(replicas: int, exit_code_policy: bool,
+                     rows: Sequence[Tuple[int, int, int]],
+                     tpu_aware: bool = True) -> Plan:
+    """Native C++ kernel when available, Python fallback otherwise
+    (PYTORCH_OPERATOR_NATIVE selects, same contract as the workqueue/
+    expectations/store backends)."""
+    from pytorch_operator_tpu import native
+
+    # The C kernel caps replicas at 4096 (stack-allocated occupancy);
+    # validation.py places no upper bound on Worker replicas, so larger
+    # jobs must take the Python path rather than erroring into an
+    # endless rate-limited requeue.
+    if replicas <= 4096 and native.resolve_backend("reconcile plan"):
+        return native.native_rc_plan(replicas, exit_code_policy, tpu_aware,
+                                     rows)
+    return plan_replica_set_py(replicas, exit_code_policy, rows,
+                               tpu_aware=tpu_aware)

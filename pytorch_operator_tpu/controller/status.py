@@ -122,3 +122,13 @@ def update_replica_statuses(status: JobStatus, rtype: str, pod: dict) -> None:
         rs.succeeded += 1
     elif phase == "Failed":
         rs.failed += 1
+
+
+def apply_replica_counts(status: JobStatus, rtype: str, active: int,
+                         succeeded: int, failed: int) -> None:
+    """Aggregate form of update_replica_statuses for the reconcile plan
+    kernel, which tallies single-occupant slices in one pass."""
+    rs = status.replica_statuses.setdefault(rtype, ReplicaStatus())
+    rs.active += active
+    rs.succeeded += succeeded
+    rs.failed += failed

@@ -87,6 +87,18 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.st_keys.argtypes = [c_void]
     lib.st_buf_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
 
+    lib.rc_retryable_exit_code.restype = ctypes.c_int
+    lib.rc_retryable_exit_code.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.rc_plan.restype = ctypes.c_int
+    lib.rc_plan.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+    ]
+
     # HTTP transport: malloc'd response buffers come back through
     # char** / char* out-params, freed via ht_buf_free
     c_int = ctypes.c_int
@@ -301,6 +313,63 @@ class NativeExpectations:
                 self._e = None
         except Exception:
             pass
+
+
+def native_retryable_exit_code(exit_code: int, tpu_aware: bool = True) -> bool:
+    """C++ mirror of controller.train_util.is_retryable_exit_code."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    return bool(lib.rc_retryable_exit_code(exit_code, int(tpu_aware)))
+
+
+def native_rc_plan(replicas: int, exit_code_policy: bool, tpu_aware: bool,
+                   rows):
+    """Run the C++ reconcile decision kernel.
+
+    ``rows`` is a sequence of (index, phase, exit_code) int triples (see
+    tpu_operator.h for the phase encoding).  Returns the same tuple
+    shape as controller.reconcile_plan.plan_replica_set_py:
+    (creates, delete_rows, warns, (active, succeeded, failed), restart).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    n = len(rows)
+    # Sanitize to int32 before crossing the C boundary: a replica-index
+    # label like 2**32 must stay out-of-range (-1) rather than aliasing
+    # to a small index under ctypes truncation; out-of-range exit codes
+    # saturate, which both backends classify as permanent.
+    flat = []
+    for index, phase, exit_code in rows:
+        if not (-(2**31) <= index < 2**31):
+            index = -1
+        if not (-(2**31) <= exit_code < 2**31):
+            exit_code = 2**31 - 1
+        flat += [index, phase, exit_code]
+    pods_arr = (ctypes.c_int * (3 * n))(*flat) if n else None
+    cap = max(replicas, 1)
+    create = (ctypes.c_int * cap)()
+    delete = (ctypes.c_int * max(n, 1))()
+    warn = (ctypes.c_int * cap)()
+    counts = (ctypes.c_int * 3)()
+    n_create = ctypes.c_int()
+    n_delete = ctypes.c_int()
+    n_warn = ctypes.c_int()
+    restart = ctypes.c_int()
+    rc = lib.rc_plan(replicas, int(exit_code_policy), int(tpu_aware),
+                     pods_arr, n, create, ctypes.byref(n_create),
+                     delete, ctypes.byref(n_delete),
+                     warn, ctypes.byref(n_warn), counts,
+                     ctypes.byref(restart))
+    if rc != 0:
+        raise ValueError(f"rc_plan rejected inputs (rc={rc}, "
+                         f"replicas={replicas}, n={n})")
+    return (list(create[:n_create.value]),
+            list(delete[:n_delete.value]),
+            list(warn[:n_warn.value]),
+            (counts[0], counts[1], counts[2]),
+            bool(restart.value))
 
 
 class NativeHttpError(OSError):

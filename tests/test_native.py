@@ -357,3 +357,92 @@ def test_informer_uses_native_store(monkeypatch):
     # watch events flow through the native cache
     src.listeners[0]("DELETED", _obj("ns", "seed", "1"))
     assert inf.store.get_by_key("ns/seed") is None
+
+
+class TestReconcilePlanEquivalence:
+    """The C++ reconcile kernel must agree with the Python reference
+    implementation on every scenario — tested exhaustively over small
+    spaces and randomly over large ones."""
+
+    def test_exit_code_table_equivalence(self):
+        from pytorch_operator_tpu.controller import train_util
+
+        for code in range(0, 256):
+            for tpu_aware in (True, False):
+                assert native.native_retryable_exit_code(
+                    code, tpu_aware) == train_util.is_retryable_exit_code(
+                        code, tpu_aware=tpu_aware), (
+                    f"exit code {code} tpu_aware={tpu_aware}")
+
+    def test_known_scenarios(self):
+        from pytorch_operator_tpu.controller.reconcile_plan import (
+            PHASE_FAILED, PHASE_OTHER, PHASE_RUNNING, PHASE_SUCCEEDED,
+            plan_replica_set_py)
+
+        scenarios = [
+            # (replicas, exit_code_policy, rows)
+            (3, False, []),                                    # all missing
+            (1, False, [(0, PHASE_RUNNING, 0)]),               # steady state
+            (2, True, [(0, PHASE_FAILED, 137),                 # retryable
+                       (1, PHASE_FAILED, 1)]),                 # permanent
+            (2, True, [(0, PHASE_FAILED, 134)]),               # TPU retryable
+            (2, False, [(0, PHASE_FAILED, 137)]),              # policy off
+            (3, True, [(0, PHASE_RUNNING, 0), (0, PHASE_RUNNING, 0),
+                       (2, PHASE_SUCCEEDED, 0)]),              # dup slice
+            (2, True, [(-1, PHASE_RUNNING, 0), (5, PHASE_FAILED, 137),
+                       (1, PHASE_OTHER, 0)]),                  # out of range
+            (0, True, [(0, PHASE_RUNNING, 0)]),                # zero replicas
+        ]
+        for replicas, policy, rows in scenarios:
+            expected = plan_replica_set_py(replicas, policy, rows)
+            got = native.native_rc_plan(replicas, policy, True, rows)
+            assert got == expected, (replicas, policy, rows)
+
+    def test_randomized_equivalence(self):
+        import random
+
+        from pytorch_operator_tpu.controller.reconcile_plan import (
+            plan_replica_set_py)
+
+        rng = random.Random(20260730)
+        codes = [0, 1, 2, 126, 127, 128, 130, 134, 135, 137, 138, 139,
+                 143, 42, 255]
+        for _ in range(500):
+            replicas = rng.randint(0, 8)
+            n = rng.randint(0, 12)
+            rows = [(rng.randint(-2, replicas + 2), rng.randint(0, 3),
+                     rng.choice(codes)) for _ in range(n)]
+            policy = rng.random() < 0.5
+            tpu_aware = rng.random() < 0.5
+            expected = plan_replica_set_py(replicas, policy, rows,
+                                           tpu_aware=tpu_aware)
+            got = native.native_rc_plan(replicas, policy, tpu_aware, rows)
+            assert got == expected, (replicas, policy, tpu_aware, rows)
+
+    def test_oversized_replicas_rejected(self):
+        with pytest.raises(ValueError):
+            native.native_rc_plan(5000, True, True, [])
+
+
+def test_plan_large_replicas_falls_back_to_python():
+    """replicas > the C kernel's 4096 cap must reconcile via the Python
+    planner, not hot-loop on a ValueError."""
+    from pytorch_operator_tpu.controller.reconcile_plan import (
+        PHASE_RUNNING, plan_replica_set)
+
+    creates, deletes, warns, counts, restart = plan_replica_set(
+        5000, True, [(0, PHASE_RUNNING, 0)])
+    assert len(creates) == 4999 and counts == (1, 0, 0)
+
+
+def test_plan_int32_overflow_index_stays_out_of_range():
+    """A replica-index label >= 2**32 must not alias to index 0 through
+    ctypes truncation — both backends treat it as out-of-range."""
+    from pytorch_operator_tpu.controller.reconcile_plan import (
+        PHASE_RUNNING, plan_replica_set_py)
+
+    rows = [(2**32, PHASE_RUNNING, 0)]
+    expected = plan_replica_set_py(2, False, rows)
+    got = native.native_rc_plan(2, False, True, rows)
+    assert got == expected
+    assert got[0] == [0, 1]  # both indices still need creation
