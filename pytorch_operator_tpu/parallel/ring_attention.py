@@ -47,8 +47,35 @@ def _chunk_attn(q, k, v, q_off, k_off, scale, causal):
     return o, m, l
 
 
-def _ring_body(q, k, v, axis_name, causal, scale):
-    """Runs on each device inside shard_map."""
+def _chunk_attn_flash(q, k, v, scale, causal, block, interpret):
+    """One (q-chunk, kv-chunk) pair through the Pallas flash kernel.
+
+    Returns the same (o_part, row_max, row_sum) contract as _chunk_attn
+    by mapping the kernel's normalized (out, lse) to the accumulator
+    basis m := lse, l := 1 (then o_unnormalized(m) == out exactly) — so
+    flash- and dense-computed chunks combine interchangeably.
+    """
+    from pytorch_operator_tpu.ops.flash_attention import _flash_fwd
+
+    B, Tq, H, Dh = q.shape
+    bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, Dh)  # noqa: E731
+    out, lse = _flash_fwd(bh(q), bh(k), bh(v), scale, causal,
+                          block, block, interpret)
+    o = out.reshape(B, H, Tq, Dh).astype(jnp.float32)
+    m = lse.reshape(B, H, Tq)
+    return o, m, jnp.ones_like(m)
+
+
+def _ring_body(q, k, v, axis_name, causal, scale, block, interpret):
+    """Runs on each device inside shard_map.
+
+    Causal chunk scheduling: a kv chunk entirely *after* the local q
+    chunk is fully masked — its compute is skipped outright via
+    lax.cond (the naive ring does the matmuls and masks everything,
+    wasting ~half the FLOPs).  The diagonal chunk runs causal, earlier
+    chunks run unmasked; both go through the Pallas flash kernel when
+    the local chunk tiles (``block``), dense XLA otherwise.
+    """
     B, Tl, H, Dh = q.shape
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
@@ -63,17 +90,39 @@ def _ring_body(q, k, v, axis_name, causal, scale):
     def body(s, carry):
         o, m, l, kc, vc = carry
         src = (rank - s) % n  # which global chunk kc currently holds
-        o_p, m_p, l_p = _chunk_attn(
-            q, kc, vc, rank * Tl, src * Tl, scale, causal
-        )
-        m_new = jnp.maximum(m, m_p)
-        a = jnp.exp(m - m_new)
-        b = jnp.exp(m_p - m_new)
-        o = o * a[..., None] + o_p * b[..., None]
-        l = l * a + l_p * b
+
+        def merge(parts):
+            o_p, m_p, l_p = parts
+            m_new = jnp.maximum(m, m_p)
+            a = jnp.exp(m - m_new)
+            b = jnp.exp(m_p - m_new)
+            return (o * a[..., None] + o_p * b[..., None], m_new,
+                    l * a + l_p * b)
+
+        def chunk(causal_chunk):
+            if block is not None:
+                return _chunk_attn_flash(q, kc, vc, scale, causal_chunk,
+                                         block, interpret)
+            # offsets only matter for the diagonal (causal) chunk, where
+            # q and kv offsets are equal — 0/0 yields the same mask
+            return _chunk_attn(q, kc, vc, 0, 0, scale, causal_chunk)
+
+        if causal:
+            o2, m2, l2 = lax.cond(
+                src > rank,
+                lambda _: (o, m, l),  # fully masked: skip the compute
+                lambda _: lax.cond(
+                    src == rank,
+                    lambda _: merge(chunk(True)),    # diagonal: causal
+                    lambda _: merge(chunk(False)),   # earlier: unmasked
+                    None),
+                None)
+        else:
+            o2, m2, l2 = merge(chunk(False))
+
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return o, m_new, l, kc, vc
+        return o2, m2, l2, kc, vc
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
     l = jnp.where(l == 0.0, 1.0, l)
@@ -94,15 +143,31 @@ def ring_attention(
 
     q/k/v: global-view (B, T, H, Dh) arrays; T must divide evenly by the
     mesh's ``axis_name`` size.  Returns (B, T, H, Dh).
+
+    Per-chunk compute routes through the Pallas flash kernel when the
+    local chunk length tiles (ops.flash_attention._auto_block), dense
+    XLA otherwise; fully-masked chunks are skipped either way.
     """
+    from pytorch_operator_tpu.ops.flash_attention import _auto_block
+
     Dh = q.shape[-1]
+    T = q.shape[1]
+    sp = mesh.shape[axis_name]
+    t_local = T // sp
+    block = _auto_block(t_local, Dh)
+    interpret = jax.default_backend() != "tpu"
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         partial(
-            _ring_body, axis_name=axis_name, causal=causal, scale=Dh ** -0.5
+            _ring_body, axis_name=axis_name, causal=causal,
+            scale=Dh ** -0.5, block=block, interpret=interpret
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no vma metadata; the varying-axes
+        # checker rejects them outright (same workaround as the remat
+        # bodies in models/llama.py)
+        check_vma=False,
     )
     return fn(q, k, v)

@@ -96,5 +96,9 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no vma metadata; without this the
+        # varying-axes checker rejects the flash path for chunk lengths
+        # that tile (ops.flash_attention._auto_block)
+        check_vma=False,
     )
     return fn(q, k, v)

@@ -120,6 +120,30 @@ class TestRingAttention:
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4
         )
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_chunk_path_matches_dense(self, causal):
+        """T_local = 128 tiles the Pallas blocks, so per-chunk compute
+        runs the flash kernel (interpret mode on the CPU mesh) instead
+        of the dense einsum — both must agree with full dense attention."""
+        from pytorch_operator_tpu.ops.flash_attention import _auto_block
+
+        mesh = make_sp_mesh(dp=4, sp=2)
+        B, T, H, Dh = 1, 256, 2, 8
+        assert _auto_block(T // 2, Dh) == 128  # flash path active
+        ks = jax.random.split(jax.random.key(7), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, Dh), jnp.float32)
+                   for kk in ks)
+        out = ring_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+        if causal:
+            ref = dense_causal_attention(q, k, v)
+        else:
+            s = jnp.einsum("bthd,bshd->bhts", q, k) * (Dh ** -0.5)
+            ref = jnp.einsum("bhts,bshd->bthd",
+                             jax.nn.softmax(s, axis=-1), v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4
+        )
+
     def test_non_causal(self):
         mesh = make_sp_mesh(dp=2, sp=4)
         B, T, H, Dh = 1, 16, 2, 8
