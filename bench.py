@@ -112,8 +112,9 @@ def main() -> None:
 
     images_per_sec = batch_size * steps_timed / dt
     print(
-        f"[bench] {steps_timed} steps x {batch_size} imgs in {dt:.3f}s, "
-        f"final loss {final_loss:.4f}",
+        f"[bench] {steps_timed} steps x {batch_size} imgs in {dt:.3f}s "
+        f"(best of 3 rounds), loss after all warmup+timed rounds "
+        f"{final_loss:.4f}",
         file=sys.stderr,
     )
 

@@ -69,6 +69,19 @@ def main() -> int:
     parser.add_argument("--no-fused-norm", dest="use_fused_norm",
                         action="store_false",
                         help="disable the Pallas fused RMSNorm kernel")
+    parser.add_argument("--remat", dest="remat", action="store_true",
+                        default=None,
+                        help="per-layer rematerialisation (default: on — "
+                             "required for 7b/FSDP memory; the single-chip "
+                             "0.9B MFU sweep showed no-remat wins when "
+                             "activations fit, see BENCH_DETAIL.md)")
+    parser.add_argument("--no-remat", dest="remat", action="store_false",
+                        help="disable remat (small models / ample HBM)")
+    parser.add_argument("--remat-policy", type=str, default=None,
+                        help="jax.checkpoint_policies name for selective "
+                             "remat (e.g. dots_with_no_batch_dims_saveable "
+                             "— measured-best remat variant; default: full "
+                             "remat)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a TensorBoard-loadable XLA trace of "
                              "steps 2..--profile-steps into this directory")
@@ -102,11 +115,16 @@ def main() -> int:
         use_fused_norm=(on_tpu if args.use_fused_norm is None
                         else args.use_fused_norm),
     )
+    remat = True if args.remat is None else args.remat
+    kernel_kw["remat"] = remat
+    if args.remat_policy and not remat:
+        parser.error("--remat-policy requires remat (drop --no-remat)")
+    if remat and args.remat_policy:
+        kernel_kw["remat_policy"] = args.remat_policy
     if args.model == "7b":
-        cfg = llama.llama2_7b(max_seq_len=args.seq_len, remat=True,
-                              **kernel_kw)
+        cfg = llama.llama2_7b(max_seq_len=args.seq_len, **kernel_kw)
     else:
-        cfg = llama.tiny(max_seq_len=args.seq_len, remat=True, **kernel_kw)
+        cfg = llama.tiny(max_seq_len=args.seq_len, **kernel_kw)
 
     optimizer = optax.adamw(args.lr, weight_decay=0.1)
     if args.pp and args.sp:

@@ -267,13 +267,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-               interpret):
+               interpret, g_lse=None):
+    """dq/dk/dv for upstream cotangents on out (``g``) and, optionally,
+    on lse (``g_lse``, (BH, 1, T) f32).
+
+    The lse cotangent folds into the existing kernels for free:
+    ds = p*(dp - delta) picks up +p*g_lse (d lse_i/d s_ij = p_ij), which
+    is exactly ds = p*(dp - (delta - g_lse)) — so shifting delta is the
+    complete correction and no kernel changes.
+    """
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
     BH, T, D = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]              # (BH, 1, T) f32
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     n_q, n_k = T // block_q, T // block_k
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
@@ -348,6 +358,34 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    """Differentiable (out, lse) pair over (BH, T, D) inputs.
+
+    For consumers that combine partial attention results across chunks
+    (ring attention's online-softmax merge): both outputs carry
+    cotangents, and the backward routes the lse cotangent through the
+    delta shift in _flash_bwd.
+    """
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, res, gs):
+    q, k, v, out, lse = res
+    g_out, g_lse = gs
+    return _flash_bwd(q, k, v, out, lse, g_out, scale, causal,
+                      block_q, block_k, interpret, g_lse=g_lse)
+
+
+flash_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def _auto_block(T: int, D: int) -> int | None:

@@ -85,13 +85,14 @@ def rms_norm(
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
     N = x2.shape[0]
-    # Ragged row counts can't tile; and above D=2048 the measured
-    # roofline flips — XLA's fused elementwise pipeline reaches ~roofline
-    # while the kernel's (block_rows, D) f32 intermediates start to
-    # crowd VMEM (measured v5e, (16384, 4096): XLA 634us vs kernel
-    # 864us; at (8192, 2048) the two are equal within noise standalone,
-    # with the kernel winning in-model).
-    if N % block_rows or shape[-1] > 2048:
+    # Ragged row counts can't tile; and the kernel's ~3 f32
+    # (block_rows, D) intermediates must fit VMEM with pipelining
+    # headroom (~12MB of the ~16MB) — beyond that XLA's fused
+    # elementwise pipeline is the right path anyway.  Measured v5e: at
+    # D=2048 and D=4096 the kernel ties XLA standalone-forward within
+    # noise and wins in-model via its analytic VJP (Llama step ~10%
+    # faster at d2048, parity at d4096 — BENCH_DETAIL.md).
+    if N % block_rows or block_rows * shape[-1] * 4 * 3 > 12 * 2**20:
         xf = x2.astype(jnp.float32)
         inv = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
         out = (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)

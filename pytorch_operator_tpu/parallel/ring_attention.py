@@ -53,14 +53,16 @@ def _chunk_attn_flash(q, k, v, scale, causal, block, interpret):
     Returns the same (o_part, row_max, row_sum) contract as _chunk_attn
     by mapping the kernel's normalized (out, lse) to the accumulator
     basis m := lse, l := 1 (then o_unnormalized(m) == out exactly) — so
-    flash- and dense-computed chunks combine interchangeably.
+    flash- and dense-computed chunks combine interchangeably.  Uses the
+    differentiable flash_with_lse pair, so jax.grad flows through the
+    ring merge (both out and lse carry cotangents).
     """
-    from pytorch_operator_tpu.ops.flash_attention import _flash_fwd
+    from pytorch_operator_tpu.ops.flash_attention import flash_with_lse
 
     B, Tq, H, Dh = q.shape
     bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, Dh)  # noqa: E731
-    out, lse = _flash_fwd(bh(q), bh(k), bh(v), scale, causal,
-                          block, block, interpret)
+    out, lse = flash_with_lse(bh(q), bh(k), bh(v), scale, causal,
+                              block, block, interpret)
     o = out.reshape(B, H, Tq, Dh).astype(jnp.float32)
     m = lse.reshape(B, H, Tq)
     return o, m, jnp.ones_like(m)
