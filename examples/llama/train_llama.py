@@ -119,6 +119,10 @@ def main() -> int:
     kernel_kw["remat"] = remat
     if args.remat_policy and not remat:
         parser.error("--remat-policy requires remat (drop --no-remat)")
+    if args.remat_policy and not hasattr(jax.checkpoint_policies,
+                                         args.remat_policy):
+        parser.error(f"unknown --remat-policy {args.remat_policy!r}; see "
+                     f"jax.checkpoint_policies for valid names")
     if remat and args.remat_policy:
         kernel_kw["remat_policy"] = args.remat_policy
     if args.model == "7b":

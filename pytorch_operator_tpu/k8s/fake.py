@@ -226,7 +226,20 @@ class FakeCluster:
         return self._rv
 
     def resource(self, plural: str) -> FakeResourceStore:
+        """Store for ``plural``.  Unknown plurals raise (KeyError →
+        the stub server's 404), matching a real API server with no such
+        CRD installed; install new kinds explicitly via register()."""
         return self.stores[plural]
+
+    def register(self, plural: str, kind: str) -> FakeResourceStore:
+        """Install a new resource kind — the fake-server analogue of
+        applying a CRD, so a second operator (a different job type over
+        the generic runtime) can run against the same fake cluster."""
+        store = self.stores.get(plural)
+        if store is None:
+            store = FakeResourceStore(self, kind)
+            self.stores[plural] = store
+        return store
 
     @property
     def pods(self) -> FakeResourceStore:

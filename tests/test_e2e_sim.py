@@ -150,3 +150,59 @@ def test_metrics_counters(world):
     text = registry.expose()
     assert "pytorch_operator_jobs_created_total 1" in text
     assert "pytorch_operator_jobs_successful_total 1" in text
+
+
+def test_operator_restart_recovers_mid_flight_job():
+    """Crash-and-restart recovery: the operator dies while a job is
+    mid-flight, the pods finish during the outage (events lost — no
+    watcher), and a FRESH controller instance must converge the job to
+    Succeeded purely from listed state.  The reference gets this from
+    informer LIST-on-start + idempotent reconcile; same here."""
+    ns = "default"
+    cluster = FakeCluster()
+    # pods run forever under kubelet #1 (decide -> None keeps them Running)
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+    kubelet.start()
+
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=Registry())
+    stop1 = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop1)
+    try:
+        cluster.jobs.create(ns, new_job(workers=2, name="restart-op").to_dict())
+        assert wait_for(lambda: len(cluster.pods.list(ns)) == 3)
+        assert wait_for(
+            lambda: job_condition(cluster, ns, "restart-op", "Running"))
+    finally:
+        # operator crashes mid-flight
+        stop1.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+
+    # during the outage every pod completes successfully — nothing is
+    # watching, so these events are unobserved by any controller
+    for pod in cluster.pods.list(ns):
+        cluster.pods.set_status(ns, pod["metadata"]["name"], {
+            "phase": "Succeeded",
+            "containerStatuses": [{
+                "name": "pytorch",
+                "restartCount": 0,
+                "state": {"terminated": {"exitCode": 0}},
+            }],
+        })
+
+    # a fresh operator process takes over the same cluster state
+    ctl2 = PyTorchController(cluster, config=JobControllerConfig(),
+                             registry=Registry())
+    stop2 = threading.Event()
+    ctl2.run(threadiness=2, stop_event=stop2)
+    try:
+        assert wait_for(
+            lambda: job_condition(cluster, ns, "restart-op", "Succeeded")), \
+            "restarted operator failed to converge the finished job"
+        job = cluster.jobs.get(ns, "restart-op")
+        rs = (job["status"].get("replicaStatuses") or {})
+        assert rs.get("Master", {}).get("succeeded") == 1
+    finally:
+        stop2.set()
+        ctl2.work_queue.shutdown()
