@@ -6,12 +6,19 @@ the (T, T) score matrix nor the full K/V sequence ever sits in VMEM —
 usable T is bounded by HBM, not the ~16MB VMEM.  Tiles are
 (block_q x d) @ (d x block_k) MXU matmuls with f32 accumulation.
 
-Backward pass is the FlashAttention-2 recipe as two blockwise Pallas
-kernels (O(T) memory, no (T, T) buffer):
+Backward pass is the FlashAttention-2 recipe with O(T) memory and no
+(T, T) buffer.  Two strategies, picked by sequence length:
 
-  dq kernel  — grid (BH, n_q, n_k):  dq[i] = sum_j ds[i,j] @ K[j]
-  dkv kernel — grid (BH, n_k, n_q):  dk[j] = sum_i ds[i,j]^T @ Q[i],
-                                     dv[j] = sum_i  p[i,j]^T @ dO[i]
+  fused kernel (default) — grid (BH, n_k, n_q): one pass computes
+    dk[j]/dv[j] in scratch AND accumulates dq[i] += ds[i,j] @ K[j]
+    into a constant-index (1, T, D) f32 output block that stays
+    VMEM-resident for the whole (j, i) sweep.  p^T and dp^T are
+    recomputed once per tile (5 matmuls/tile, the FA-2 minimum).
+  two-kernel fallback (T*D f32 too big for VMEM) — separate dq and
+    dkv kernels, each recomputing p^T (7 matmuls/tile):
+    dq kernel  — grid (BH, n_q, n_k):  dq[i] = sum_j ds[i,j] @ K[j]
+    dkv kernel — grid (BH, n_k, n_q):  dk[j] = sum_i ds[i,j]^T @ Q[i],
+                                       dv[j] = sum_i  p[i,j]^T @ dO[i]
 
 where p is recomputed blockwise from the saved per-row logsumexp
 (lse = m + log l) and ds = p * (dp - delta) * scale with
@@ -224,6 +231,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_scr, dv_scr, i, j, block_q, block_k, scale, causal):
+    """Shared FA-2 tile math: accumulate dv/dk for one (i, j) tile and
+    return ds^T for the caller (the fused kernel also needs it for dq).
+    """
+    p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
+                            block_q, block_k, scale, causal)
+    do = do_ref[0]                                    # (block_q, d)
+    # dv[j] += p[i,j]^T @ dO[i]
+    dv_scr[...] += lax.dot_general(
+        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (block_k, d)
+    dp_t = lax.dot_general(
+        v_ref[0], do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (block_k, block_q)
+    ds_t = p_t * (dp_t - delta_ref[0]) * scale
+    # dk[j] += ds[i,j]^T @ Q[i]
+    dk_scr[...] += lax.dot_general(
+        ds_t.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (block_k, d)
+    return ds_t
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, block_q, block_k, scale, causal):
@@ -239,21 +269,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
-                                block_q, block_k, scale, causal)
-        do = do_ref[0]                                # (block_q, d)
-        # dv[j] += p[i,j]^T @ dO[i]
-        dv_scr[...] += lax.dot_general(
-            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (block_k, d)
-        dp_t = lax.dot_general(
-            v_ref[0], do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (block_k, block_q)
-        ds_t = p_t * (dp_t - delta_ref[0]) * scale
-        # dk[j] += ds[i,j]^T @ Q[i]
-        dk_scr[...] += lax.dot_general(
-            ds_t.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (block_k, d)
+        _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_scr, dv_scr, i, j, block_q, block_k, scale,
+                       causal)
 
     if causal:
         pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
@@ -264,6 +282,102 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _finalize():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, block_q, block_k, scale, causal):
+    """One-pass backward: dk/dv via scratch accumulation over i, dq via
+    in-place accumulation into the whole-sequence f32 output block.
+
+    dq's block index map is constant in (j, i), so Pallas keeps one
+    (1, T, D) VMEM buffer live across the entire sweep for each
+    batch-head — cross-j accumulation costs no HBM round trips, and
+    p^T / dp^T are computed once per tile instead of once per kernel.
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)   # k block (outer)
+    i = pl.program_id(2)   # q block (inner, accumulated for dk/dv)
+    n_q = pl.num_programs(2)
+
+    @pl.when((j == 0) & (i == 0))
+    def _init_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        ds_t = _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, dk_scr, dv_scr, i, j, block_q,
+                              block_k, scale, causal)
+        # dq[i] += ds[i,j] @ K[j]  ==  ds_t^T @ K  (contract sublanes)
+        rows = pl.ds(i * block_q, block_q)
+        dq_ref[0, rows, :] += lax.dot_general(
+            ds_t.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_q, d)
+
+    if causal:
+        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# The fused kernel keeps a (T, D) f32 dq buffer plus three
+# (block, block) f32 score tiles in VMEM; past this many bytes of dq
+# the dispatcher falls back to the two-kernel path (whose VMEM use is
+# O(block^2) only), which covers arbitrarily long sequences.
+_FUSED_DQ_VMEM_BYTES = 4 * 1024 * 1024
+
+
+def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
+                     block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    BH, T, D = q.shape
+    n_q, n_k = T // block_q, T // block_k
+    qT_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kT_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowT_spec = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                             memory_space=pltpu.VMEM)
+    dq32, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal),
+        grid=(BH, n_k, n_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[
+            pl.BlockSpec((1, T, D), lambda b, j, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq32.astype(q.dtype), dk, dv
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
@@ -284,6 +398,9 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                     axis=-1)[:, None, :]              # (BH, 1, T) f32
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
+    if T * D * 4 <= _FUSED_DQ_VMEM_BYTES:
+        return _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
+                                block_q, block_k, interpret)
     n_q, n_k = T // block_q, T // block_k
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
@@ -391,15 +508,19 @@ flash_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 def _auto_block(T: int, D: int) -> int | None:
     """Largest block size that tiles T, capped by VMEM pressure.
 
-    Measured on TPU v5e (B4/H16/D128, fwd+bwd, scan-chained timing):
-    1024-blocks are 4.8-5.9x faster than the naive 128x128 tiling — a
-    128x128 tile is only ~4 MFLOP, so per-grid-cell overhead dominates;
-    at 1024 each cell does ~270 MFLOP and the kernel reaches ~30% of
-    peak (vs ~6% at 128).  The cap drops to 512 for D > 128 because the
+    Measured on TPU v5e (H16/D128, fwd+bwd with the fused backward,
+    scan-chained timing): 1024-blocks are 3-4x faster than the naive
+    256x256 tiling at T>=4096 — a small tile is only a few MFLOP, so
+    per-grid-cell overhead dominates; at 1024 each cell does ~270
+    MFLOP.  At T=1024 the whole grid is tiny and a 512 block wins
+    (0.50ms vs 0.73ms fwd+bwd) — enough cells to pipeline beats
+    per-cell size.  The cap drops to 512 for D > 128 because the
     backward's three (block_k, block_q) f32 score tiles plus the
     operand tiles approach the ~16MB VMEM at 1024.
     """
     cap = 1024 if D <= 128 else 512
+    if T <= 1024:
+        cap = min(cap, 512)
     for b in (cap, 512, 256, 128):
         if b <= T and T % b == 0:
             return b

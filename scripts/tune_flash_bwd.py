@@ -1,0 +1,113 @@
+"""Quick A/B of the fused vs two-kernel flash backward on the real TPU.
+
+Times jax.grad of a sum-of-squares loss (same non-hoistable structure
+as scripts/bench_detail.py) for each (T, block, strategy) combination.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import importlib  # noqa: E402
+
+fa = importlib.import_module("pytorch_operator_tpu.ops.flash_attention")
+
+
+def timed(fn, c, iters):
+    @jax.jit
+    def run(c):
+        out = jax.lax.scan(lambda cc, _: (fn(cc), None), c, None,
+                           length=iters)[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(run(c))  # compile + warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run(c))
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=int, nargs="*",
+                    default=[1024, 2048, 4096, 8192])
+    ap.add_argument("--fwd-only", action="store_true",
+                    help="time the forward kernel alone per block size")
+    args = ap.parse_args()
+    if args.fwd_only:
+        fwd_only()
+        return
+    B, H, D = 1, 16, 128
+    print(f"device={jax.devices()[0].device_kind}")
+    for T in args.seqs:
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+                   for kk in ks)
+        iters = max(20, (8192 // T) * 20)
+        for block in (256, 512, 1024):
+            if T % block or block > T:
+                continue
+            for strat in ("fused", "twokernel"):
+                saved = fa._FUSED_DQ_VMEM_BYTES
+                fa._FUSED_DQ_VMEM_BYTES = (1 << 40) if strat == "fused" else 0
+
+                def loss(qq, kk, vv):
+                    o = fa.flash_attention(qq, kk, vv, causal=True,
+                                           block_q=block, block_k=block,
+                                           interpret=False)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+
+                grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+                def body(qc):
+                    # mix all three grads into the carry so neither
+                    # backward kernel is dead code
+                    dq, dk, dv = grad_fn(qc, k, v)
+                    gf = (dq + dk + dv).astype(jnp.float32)
+                    return (gf * jax.lax.rsqrt(jnp.mean(gf * gf) + 1e-6)
+                            ).astype(qc.dtype)
+
+                try:
+                    t = timed(body, q, iters)
+                    print(f"T={T:5d} block={block:4d} {strat:9s} "
+                          f"{t * 1e3:8.3f} ms")
+                except Exception as e:  # VMEM OOM etc.
+                    print(f"T={T:5d} block={block:4d} {strat:9s} "
+                          f"FAIL {type(e).__name__}: {str(e)[:120]}")
+                finally:
+                    fa._FUSED_DQ_VMEM_BYTES = saved
+
+
+if __name__ == "__main__":
+    main()
+
+
+def fwd_only():
+    B, H, D = 1, 16, 128
+    for T in (1024, 2048, 4096):
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+                   for kk in ks)
+        iters = max(50, (8192 // T) * 50)
+        for block in (256, 512, 1024):
+            if T % block or block > T:
+                continue
+
+            def body(qc):
+                o = fa.flash_attention(qc, k, v, causal=True,
+                                       block_q=block, block_k=block,
+                                       interpret=False)
+                of = o.astype(jnp.float32)
+                return (of * jax.lax.rsqrt(jnp.mean(of * of) + 1e-6)
+                        ).astype(qc.dtype)
+
+            t = timed(body, q, iters)
+            print(f"T={T:5d} block={block:4d} fwd-only {t * 1e3:8.3f} ms")
