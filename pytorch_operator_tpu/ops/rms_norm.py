@@ -85,14 +85,17 @@ def rms_norm(
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
     N = x2.shape[0]
-    # Ragged row counts can't tile; and the kernel's ~3 f32
-    # (block_rows, D) intermediates must fit VMEM with pipelining
-    # headroom (~12MB of the ~16MB) — beyond that XLA's fused
-    # elementwise pipeline is the right path anyway.  Measured v5e: at
-    # D=2048 and D=4096 the kernel ties XLA standalone-forward within
-    # noise and wins in-model via its analytic VJP (Llama step ~10%
-    # faster at d2048, parity at d4096 — BENCH_DETAIL.md).
-    if N % block_rows or block_rows * shape[-1] * 4 * 3 > 12 * 2**20:
+    # Dispatch boundary (measured v5e, scan-chained best-of-5): the op
+    # is pure HBM bandwidth, so the kernel can only tie or slightly
+    # beat XLA's fused elementwise pipeline.  At D<=2048 it ties or
+    # wins (0.99-1.13x standalone) and wins in-model via the analytic
+    # VJP (~10% Llama step at d2048 — BENCH_DETAIL.md); at D>=4096 it
+    # consistently loses (~0.8x, VMEM pressure limits pipelining), so
+    # wide rows take the XLA path.  Ragged row counts can't tile; and
+    # the kernel's ~3 f32 (block_rows, D) intermediates must fit VMEM
+    # with pipelining headroom (~12MB of the ~16MB).
+    if (N % block_rows or shape[-1] > 2048
+            or block_rows * shape[-1] * 4 * 3 > 12 * 2**20):
         xf = x2.astype(jnp.float32)
         inv = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
         out = (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)

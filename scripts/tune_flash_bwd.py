@@ -86,10 +86,6 @@ def main():
                     fa._FUSED_DQ_VMEM_BYTES = saved
 
 
-if __name__ == "__main__":
-    main()
-
-
 def fwd_only():
     B, H, D = 1, 16, 128
     for T in (1024, 2048, 4096):
@@ -111,3 +107,7 @@ def fwd_only():
 
             t = timed(body, q, iters)
             print(f"T={T:5d} block={block:4d} fwd-only {t * 1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
