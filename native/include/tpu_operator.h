@@ -104,13 +104,46 @@ int rc_plan(int replicas, int restart_policy_exit_code, int tpu_aware,
             int* delete_out, int* n_delete, int* warn_out, int* n_warn,
             int* counts, int* restart_out);
 
-/* ---- HTTP transport (plain TCP; TLS rides the Python fallback) -------- */
+/* ---- HTTP transport (plain TCP or TLS via dlopen'd OpenSSL) ----------- */
 
 /* ht_request return codes. */
 #define HT_OK 0
-#define HT_ERR_CONNECT (-1)  /* resolve/connect failed or timed out */
+#define HT_ERR_CONNECT (-1)  /* resolve/connect/TLS-handshake failed */
 #define HT_ERR_IO (-2)       /* send/recv failed mid-exchange */
 #define HT_ERR_PROTOCOL (-3) /* malformed response framing */
+
+/* 1 when libssl/libcrypto resolved at runtime (no build-time OpenSSL
+ * dependency — tls.cc dlopens them); 0 means TLS endpoints must use the
+ * caller's fallback transport. */
+int ht_tls_available(void);
+
+/* Build a client TLS context: CA file (empty -> system default verify
+ * paths), optional client cert/key (PEM) for mTLS, insecure=1 disables
+ * verification (peer AND hostname — the flag is recorded inside the
+ * context so the two can't drift apart).  Returns NULL on failure with
+ * the reason available via ht_last_error().  Free with ht_tls_ctx_free;
+ * the context is thread-safe and reusable across requests/watches. */
+void* ht_tls_ctx_new(const char* ca_file, const char* cert_file,
+                     const char* key_file, int insecure);
+void ht_tls_ctx_free(void* ctx);
+
+/* Thread-local detail for the calling thread's most recent
+ * connect/TLS failure in this module.  Valid until the thread's next
+ * transport call — copy immediately. */
+const char* ht_last_error(void);
+
+/* ht_request over TLS (tls_ctx from ht_tls_ctx_new; NULL = plain TCP).
+ * server_name drives SNI + hostname/IP verification (NULL/"" -> host). */
+int ht_request2(void* tls_ctx, const char* server_name,
+                const char* host, int port, const char* method,
+                const char* path, const char* headers, const char* body,
+                int body_len, double timeout, char** resp_body,
+                int* resp_len, int* resp_status);
+
+/* ws_open over TLS — same contract as ws_open below. */
+void* ws_open2(void* tls_ctx, const char* server_name,
+               const char* host, int port, const char* path,
+               const char* headers, double timeout, int* resp_status);
 
 /* One request/response exchange (Connection: close).  `headers` is a
  * '\n'-joined list of "Name: value" lines (Host/Content-Length are

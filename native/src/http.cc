@@ -4,14 +4,17 @@
 // (client-go rest.Config -> net/http); here the socket I/O, HTTP
 // framing, chunked-transfer decoding, and watch-stream line splitting
 // are C++ so a blocked read (a watch stream sits in a blocking read for
-// minutes at a time) never holds the Python GIL.  Plain TCP only: the
-// image has no OpenSSL headers, so TLS connections take the Python
-// ssl/http.client fallback (k8s/rest.py picks per scheme).
+// minutes at a time) never holds the Python GIL.  TLS rides the
+// runtime-loaded OpenSSL layer (tls.cc, dlopen'd libssl.so.3 — the
+// image has no OpenSSL headers); when those libraries are absent the
+// Python ssl/http.client fallback takes over (k8s/rest.py probes
+// ht_tls_available).
 //
 // Exported C API (see include/tpu_operator.h):
-//   ht_request    — one request/response exchange (Connection: close)
-//   ws_open/ws_next/ws_close — streaming watch: open a chunked response
-//                   and pop newline-delimited JSON event lines
+//   ht_request/ht_request2 — one request/response exchange
+//   ws_open/ws_open2/ws_next/ws_close — streaming watch: open a chunked
+//                   response and pop newline-delimited JSON event lines
+//   ht_tls_ctx_new/free, ht_tls_available, ht_last_error — TLS config
 //   ht_buf_free   — release any malloc'd buffer returned by this module
 
 #include <arpa/inet.h>
@@ -27,9 +30,60 @@
 #include <cstring>
 #include <string>
 
+#include "tls_internal.h"
 #include "tpu_operator.h"
 
 namespace {
+
+thread_local std::string g_last_error;
+
+// ---- connection: plain fd or TLS session over it -------------------------
+
+struct Conn {
+  int fd = -1;
+  void* tls = nullptr;  // SSL* (owned) when non-null
+
+  ssize_t read_some(char* buf, size_t len) {
+    if (tls != nullptr) return tpuop::tls_recv(tls, buf, len);
+    return recv(fd, buf, len, 0);
+  }
+
+  bool write_all(const char* data, size_t len) {
+    if (tls != nullptr) return tpuop::tls_send_all(tls, data, len);
+    size_t off = 0;
+    while (off < len) {
+      ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      off += static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  // 1 readable, 0 timeout, -1 error.  TLS may hold already-decrypted
+  // bytes poll(2) can't see — report those as readable first.
+  int poll_in(int timeout_ms) {
+    if (tls != nullptr && tpuop::tls_pending(tls) > 0) return 1;
+    pollfd pfd{fd, POLLIN, 0};
+    return poll(&pfd, 1, timeout_ms);
+  }
+
+  void close_all() {
+    if (tls != nullptr) {
+      tpuop::tls_conn_close(tls);
+      tls = nullptr;
+    }
+    if (fd >= 0) {
+      close(fd);
+      fd = -1;
+    }
+  }
+};
+
+// Connect TCP (+ optional TLS handshake).  Returns true and fills
+// *conn; on failure records g_last_error.
+bool open_conn(const char* host, int port, double timeout,
+               tpuop::TlsConfig* tls_cfg, const char* server_name,
+               Conn* conn);
 
 // ---- socket helpers ------------------------------------------------------
 
@@ -87,12 +141,27 @@ int connect_with_timeout(const char* host, int port, double timeout) {
   return fd;
 }
 
-bool send_all(int fd, const char* data, size_t len) {
-  size_t off = 0;
-  while (off < len) {
-    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
-    if (n <= 0) return false;
-    off += static_cast<size_t>(n);
+bool open_conn(const char* host, int port, double timeout,
+               tpuop::TlsConfig* tls_cfg, const char* server_name,
+               Conn* conn) {
+  g_last_error.clear();
+  int fd = connect_with_timeout(host, port, timeout);
+  if (fd < 0) {
+    g_last_error = "connect failed or timed out";
+    return false;
+  }
+  conn->fd = fd;
+  if (tls_cfg != nullptr) {
+    std::string err;
+    const char* name = (server_name != nullptr && server_name[0] != '\0')
+                           ? server_name
+                           : host;
+    conn->tls = tpuop::tls_conn_open(tls_cfg, fd, name, &err);
+    if (conn->tls == nullptr) {
+      g_last_error = err;
+      conn->close_all();
+      return false;
+    }
   }
   return true;
 }
@@ -122,12 +191,12 @@ struct Response {
 // Reads from fd until the header/body separator; parses status line and
 // the two framing headers we act on.  Leftover bytes past the separator
 // (start of the body) are returned in `leftover`.
-bool read_headers(int fd, Response* resp, std::string* leftover) {
+bool read_headers(Conn& conn, Response* resp, std::string* leftover) {
   std::string buf;
   char tmp[4096];
   size_t header_end = std::string::npos;
   while (header_end == std::string::npos) {
-    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    ssize_t n = conn.read_some(tmp, sizeof tmp);
     if (n <= 0) return false;
     buf.append(tmp, static_cast<size_t>(n));
     header_end = buf.find("\r\n\r\n");
@@ -209,13 +278,13 @@ struct ChunkDecoder {
 };
 
 // Reads the full body per the response framing (used by ht_request).
-bool read_body(int fd, Response* resp, const std::string& leftover) {
+bool read_body(Conn& conn, Response* resp, const std::string& leftover) {
   char tmp[16384];
   if (resp->chunked) {
     ChunkDecoder dec;
     if (!dec.feed(leftover.data(), leftover.size(), &resp->body)) return false;
     while (!dec.done) {
-      ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+      ssize_t n = conn.read_some(tmp, sizeof tmp);
       if (n <= 0) return dec.done;
       if (!dec.feed(tmp, static_cast<size_t>(n), &resp->body)) return false;
     }
@@ -224,7 +293,7 @@ bool read_body(int fd, Response* resp, const std::string& leftover) {
   resp->body = leftover;
   if (resp->content_length >= 0) {
     while (resp->body.size() < static_cast<size_t>(resp->content_length)) {
-      ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+      ssize_t n = conn.read_some(tmp, sizeof tmp);
       if (n <= 0) return false;
       resp->body.append(tmp, static_cast<size_t>(n));
     }
@@ -232,7 +301,7 @@ bool read_body(int fd, Response* resp, const std::string& leftover) {
     return true;
   }
   for (;;) {  // Connection: close framing — read to EOF
-    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    ssize_t n = conn.read_some(tmp, sizeof tmp);
     if (n < 0) return false;
     if (n == 0) return true;
     resp->body.append(tmp, static_cast<size_t>(n));
@@ -276,7 +345,7 @@ std::string build_request(const char* method, const char* path,
 // ---- streaming watch handle ---------------------------------------------
 
 struct WatchStream {
-  int fd = -1;
+  Conn conn;
   int status = 0;
   bool chunked = false;
   bool eof = false;
@@ -289,55 +358,93 @@ struct WatchStream {
 
 extern "C" {
 
-int ht_request(const char* host, int port, const char* method,
-               const char* path, const char* headers, const char* body,
-               int body_len, double timeout, char** resp_body,
-               int* resp_len, int* resp_status) {
+int ht_tls_available(void) {
+  return tpuop::tls_runtime_available() ? 1 : 0;
+}
+
+void* ht_tls_ctx_new(const char* ca_file, const char* cert_file,
+                     const char* key_file, int insecure) {
+  std::string err;
+  tpuop::TlsConfig* cfg = tpuop::tls_ctx_create(ca_file, cert_file,
+                                                key_file, insecure, &err);
+  if (cfg == nullptr) g_last_error = err;
+  return cfg;
+}
+
+void ht_tls_ctx_free(void* ctx) {
+  tpuop::tls_ctx_destroy(static_cast<tpuop::TlsConfig*>(ctx));
+}
+
+const char* ht_last_error(void) { return g_last_error.c_str(); }
+
+int ht_request2(void* tls_ctx, const char* server_name,
+                const char* host, int port, const char* method,
+                const char* path, const char* headers, const char* body,
+                int body_len, double timeout, char** resp_body,
+                int* resp_len, int* resp_status) {
   *resp_body = nullptr;
   *resp_len = 0;
   *resp_status = 0;
-  int fd = connect_with_timeout(host, port, timeout);
-  if (fd < 0) return HT_ERR_CONNECT;
+  Conn conn;
+  if (!open_conn(host, port, timeout,
+                 static_cast<tpuop::TlsConfig*>(tls_ctx), server_name,
+                 &conn)) {
+    return HT_ERR_CONNECT;  // detail (TLS verify reason etc.) in ht_last_error
+  }
   std::string req = build_request(method, path, host, headers, body,
                                   body_len, /*close_conn=*/true);
   int rc = HT_OK;
   Response resp;
   std::string leftover;
-  if (!send_all(fd, req.data(), req.size())) {
+  if (!conn.write_all(req.data(), req.size())) {
     rc = HT_ERR_IO;
-  } else if (!read_headers(fd, &resp, &leftover) ||
-             !read_body(fd, &resp, leftover)) {
+  } else if (!read_headers(conn, &resp, &leftover) ||
+             !read_body(conn, &resp, leftover)) {
     rc = HT_ERR_PROTOCOL;
   } else {
     *resp_status = resp.status;
     *resp_body = dup_string(resp.body, resp_len);
     if (*resp_body == nullptr) rc = HT_ERR_IO;
   }
-  close(fd);
+  conn.close_all();
   return rc;
 }
 
-void* ws_open(const char* host, int port, const char* path,
-              const char* headers, double timeout, int* resp_status) {
+int ht_request(const char* host, int port, const char* method,
+               const char* path, const char* headers, const char* body,
+               int body_len, double timeout, char** resp_body,
+               int* resp_len, int* resp_status) {
+  return ht_request2(nullptr, nullptr, host, port, method, path,
+                     headers, body, body_len, timeout, resp_body,
+                     resp_len, resp_status);
+}
+
+void* ws_open2(void* tls_ctx, const char* server_name,
+               const char* host, int port, const char* path,
+               const char* headers, double timeout, int* resp_status) {
   *resp_status = 0;
-  int fd = connect_with_timeout(host, port, timeout);
-  if (fd < 0) return nullptr;
+  Conn conn;
+  if (!open_conn(host, port, timeout,
+                 static_cast<tpuop::TlsConfig*>(tls_ctx), server_name,
+                 &conn)) {
+    return nullptr;
+  }
   // keep the connection open for the stream; the server ends it
   std::string req = build_request("GET", path, host, headers, nullptr, 0,
                                   /*close_conn=*/false);
-  if (!send_all(fd, req.data(), req.size())) {
-    close(fd);
+  if (!conn.write_all(req.data(), req.size())) {
+    conn.close_all();
     return nullptr;
   }
   Response resp;
   std::string leftover;
-  if (!read_headers(fd, &resp, &leftover)) {
-    close(fd);
+  if (!read_headers(conn, &resp, &leftover)) {
+    conn.close_all();
     return nullptr;
   }
   *resp_status = resp.status;
   auto* ws = new WatchStream();
-  ws->fd = fd;
+  ws->conn = conn;
   ws->status = resp.status;
   ws->chunked = resp.chunked;
   if (resp.status >= 400) {
@@ -345,7 +452,7 @@ void* ws_open(const char* host, int port, const char* path,
     // (honouring whatever framing the server chose, incl. a
     // Content-Length body with no trailing newline on a keep-alive
     // connection) and surface it through ws_next before EOF.
-    read_body(fd, &resp, leftover);
+    read_body(ws->conn, &resp, leftover);
     ws->decoded = resp.body;
     ws->eof = true;
     return ws;
@@ -358,6 +465,12 @@ void* ws_open(const char* host, int port, const char* path,
     ws->decoded = leftover;
   }
   return ws;
+}
+
+void* ws_open(const char* host, int port, const char* path,
+              const char* headers, double timeout, int* resp_status) {
+  return ws_open2(nullptr, nullptr, host, port, path, headers, timeout,
+                  resp_status);
 }
 
 char* ws_next(void* w, double timeout, int* len_out, int* state) {
@@ -391,8 +504,7 @@ char* ws_next(void* w, double timeout, int* len_out, int* state) {
       *state = WS_EOF;
       return nullptr;
     }
-    pollfd pfd{ws->fd, POLLIN, 0};
-    int pr = poll(&pfd, 1, static_cast<int>(timeout * 1000));
+    int pr = ws->conn.poll_in(static_cast<int>(timeout * 1000));
     if (pr == 0) {
       *state = WS_TIMEOUT;
       return nullptr;
@@ -402,7 +514,7 @@ char* ws_next(void* w, double timeout, int* len_out, int* state) {
       *state = WS_ERROR;
       return nullptr;
     }
-    ssize_t n = recv(ws->fd, tmp, sizeof tmp, 0);
+    ssize_t n = ws->conn.read_some(tmp, sizeof tmp);
     if (n < 0) {
       *state = WS_ERROR;
       return nullptr;
@@ -431,11 +543,14 @@ void ws_close(void* w) {
   // with a short timeout and checks its stop flag between calls, so no
   // ws_next is ever in flight here).
   auto* ws = static_cast<WatchStream*>(w);
-  if (ws->fd >= 0) {
-    shutdown(ws->fd, SHUT_RDWR);
-    close(ws->fd);
-    ws->fd = -1;
+  if (ws->conn.tls == nullptr && ws->conn.fd >= 0) {
+    // plain TCP: hard-terminate the stream.  For TLS, close_all runs
+    // SSL_shutdown first — shutting the socket down here would turn
+    // the close_notify write into EPIPE (and SIGPIPE in non-Python
+    // hosts: OpenSSL writes without MSG_NOSIGNAL).
+    shutdown(ws->conn.fd, SHUT_RDWR);
   }
+  ws->conn.close_all();
   delete ws;
 }
 

@@ -1,0 +1,58 @@
+// Internal TLS interface between http.cc (transport framing) and
+// tls.cc (dlopen'd OpenSSL 3).  Not part of the public C API.
+//
+// The image ships libssl.so.3/libcrypto.so.3 but no OpenSSL headers, so
+// tls.cc resolves the dozen functions it needs through dlsym against
+// hand-written prototypes (the OpenSSL 1.1+/3.x ABI for these entry
+// points is stable).  When the libraries are absent, every entry point
+// degrades gracefully and the Python layer keeps its ssl fallback.
+
+#ifndef TPU_OPERATOR_TLS_INTERNAL_H_
+#define TPU_OPERATOR_TLS_INTERNAL_H_
+
+#include <string>
+
+namespace tpuop {
+
+// True when libssl/libcrypto resolved (lazily dlopen'd on first call).
+bool tls_runtime_available();
+
+// One TLS client configuration: the OpenSSL context plus the insecure
+// flag it was built with (kept together so callers can't toggle
+// hostname verification out of sync with peer verification).
+struct TlsConfig {
+  void* ssl_ctx = nullptr;  // SSL_CTX*
+  bool insecure = false;
+};
+
+// Build a client TLS config.  ca_file/cert_file/key_file may be
+// null/empty; verification is ON unless `insecure` (no CA file ->
+// system default verify paths).  Returns null and fills *err on failure.
+TlsConfig* tls_ctx_create(const char* ca_file, const char* cert_file,
+                          const char* key_file, int insecure,
+                          std::string* err);
+void tls_ctx_destroy(TlsConfig* cfg);
+
+// TLS handshake over a connected blocking fd (with SO_RCVTIMEO/SNDTIMEO
+// bounding every step).  server_name drives SNI + hostname/IP
+// verification (skipped when the config is insecure).  Returns an
+// opaque connection (SSL*) or null with *err filled.  Does NOT take
+// ownership of fd.
+void* tls_conn_open(TlsConfig* cfg, int fd, const char* server_name,
+                    std::string* err);
+void tls_conn_close(void* conn);
+
+// recv(2)-shaped: >0 bytes read, 0 clean EOF (close_notify or silent
+// TCP close at a record boundary), -1 error/timeout.
+long tls_recv(void* conn, char* buf, unsigned long len);
+
+// Write everything; false on error/timeout.
+bool tls_send_all(void* conn, const char* data, unsigned long len);
+
+// Bytes already decrypted and buffered inside the TLS layer — must be
+// drained before poll(2)ing the fd (poll cannot see them).
+int tls_pending(void* conn);
+
+}  // namespace tpuop
+
+#endif  // TPU_OPERATOR_TLS_INTERNAL_H_

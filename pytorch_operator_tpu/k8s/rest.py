@@ -144,25 +144,47 @@ class KubeConfig:
 class RestClient:
     """Thin JSON-over-HTTP client with k8s error mapping.
 
-    Plain-HTTP endpoints (stub server, `kubectl proxy`, `--master
-    http://...`) ride the native C++ transport when it is available
-    (socket I/O + framing + chunked decoding with the GIL released,
-    native/src/http.cc); TLS endpoints always use the Python
-    ssl/http.client path — the image carries no OpenSSL headers, so the
-    native core does not link TLS.  `PYTORCH_OPERATOR_NATIVE=0` forces
-    the Python path everywhere.
+    Both plain-HTTP endpoints (stub server, `kubectl proxy`, `--master
+    http://...`) and HTTPS endpoints ride the native C++ transport when
+    it is available (socket I/O + framing + chunked decoding with the
+    GIL released, native/src/http.cc; TLS via dlopen'd libssl —
+    native/src/tls.cc — matching the reference Go binary's native TLS,
+    app/server.go:92-99).  The Python ssl/http.client path remains the
+    fallback when the native build or the TLS runtime is unavailable,
+    and `PYTORCH_OPERATOR_NATIVE=0` forces it everywhere.
     """
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0):
         self.config = config
         self.timeout = timeout
         self.native = None
-        if config.scheme == "http":
-            from pytorch_operator_tpu import native as _native
+        from pytorch_operator_tpu import native as _native
 
-            if _native.resolve_backend("http transport"):
+        if _native.resolve_backend("http transport"):
+            if config.scheme == "http":
                 self.native = _native.NativeHttpTransport(
                     config.host, config.port, timeout)
+            elif _native.tls_available():
+                try:
+                    self.native = _native.NativeHttpTransport(
+                        config.host, config.port, timeout,
+                        tls=_native.NativeTlsContext(
+                            ca_file=config.ca_file,
+                            cert_file=config.cert_file,
+                            key_file=config.key_file,
+                            insecure=config.insecure),
+                        server_name=config.host)
+                except OSError as e:
+                    # OpenSSL rejected the material (where Python's ssl
+                    # might still accept it) — keep the promised
+                    # fallback rather than failing construction; truly
+                    # bad material then errors per-request with the
+                    # Python path's message
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "native TLS context failed (%s); using the "
+                        "Python ssl transport", e)
 
     def _connect(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
         ctx = self.config.ssl_context()

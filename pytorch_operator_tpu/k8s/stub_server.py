@@ -34,7 +34,11 @@ _PATH_RE = re.compile(
 
 class StubApiServer:
     def __init__(self, cluster: Optional[FakeCluster] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        """``ssl_context``: server-side ssl.SSLContext — serves HTTPS,
+        exercising the production (TLS) client paths against the same
+        in-memory cluster."""
         self.cluster = cluster if cluster is not None else FakeCluster()
         # Test hook: while set, active watch streams terminate and new watch
         # requests are refused with 500, simulating an API-server outage /
@@ -196,6 +200,9 @@ class StubApiServer:
 
         self._stopping = threading.Event()
         self.server = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            self.server.socket = ssl_context.wrap_socket(
+                self.server.socket, server_side=True)
         self.server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

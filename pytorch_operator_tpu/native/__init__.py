@@ -113,6 +113,27 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ws_open.restype = c_void
     lib.ws_open.argtypes = [c_char, c_int, c_char, c_char,
                             ctypes.c_double, ctypes.POINTER(c_int)]
+    # TLS (dlopen'd OpenSSL inside the native core)
+    lib.ht_tls_available.restype = c_int
+    lib.ht_tls_available.argtypes = []
+    lib.ht_tls_ctx_new.restype = c_void
+    lib.ht_tls_ctx_new.argtypes = [c_char, c_char, c_char, c_int]
+    lib.ht_tls_ctx_free.argtypes = [c_void]
+    lib.ht_last_error.restype = ctypes.c_char_p
+    lib.ht_last_error.argtypes = []
+    lib.ht_request2.restype = c_int
+    lib.ht_request2.argtypes = [
+        c_void, c_char,
+        c_char, c_int, c_char, c_char, c_char, c_char, c_int,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(c_int),
+        ctypes.POINTER(c_int),
+    ]
+    lib.ws_open2.restype = c_void
+    lib.ws_open2.argtypes = [c_void, c_char,
+                             c_char, c_int, c_char, c_char,
+                             ctypes.c_double, ctypes.POINTER(c_int)]
     lib.ws_next.restype = ctypes.POINTER(ctypes.c_char)
     lib.ws_next.argtypes = [c_void, ctypes.c_double,
                             ctypes.POINTER(c_int), ctypes.POINTER(c_int)]
@@ -131,15 +152,31 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
             return _lib
         if _load_error is not None:
             return None  # don't re-run a failed build on every call
-        if not os.path.exists(_LIB_PATH) and build:
+        if build:
+            # always invoke make: it no-ops when up to date and rebuilds
+            # when sources are newer than a stale committed/.so build
+            # (a missing toolchain only matters if the .so is absent).
+            # An inter-process flock serialises concurrent builders
+            # (pytest-xdist workers, operator + sidecar) so one process
+            # can't CDLL a half-linked .so another is writing; the
+            # Makefile additionally links to a temp name and renames.
             try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True, capture_output=True, text=True, timeout=120)
+                os.makedirs(os.path.join(_NATIVE_DIR, "build"),
+                            exist_ok=True)
+                import fcntl
+
+                with open(os.path.join(_NATIVE_DIR, "build", ".lock"),
+                          "w") as lockf:
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR],
+                        check=True, capture_output=True, text=True,
+                        timeout=120)
             except (subprocess.CalledProcessError, OSError,
                     subprocess.TimeoutExpired) as e:
-                _load_error = getattr(e, "stderr", "") or str(e)
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    _load_error = getattr(e, "stderr", "") or str(e)
+                    return None
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
         except OSError as e:
@@ -376,6 +413,50 @@ class NativeHttpError(OSError):
     """Connect/IO/protocol failure inside the native transport."""
 
 
+def tls_available() -> bool:
+    """True when the native core resolved libssl/libcrypto at runtime."""
+    lib = load()
+    return bool(lib and lib.ht_tls_available())
+
+
+class NativeTlsContext:
+    """Owns one C-side SSL_CTX (reused across requests and watches).
+
+    Mirrors KubeConfig's TLS surface: CA file (None -> system default
+    verify paths), optional client cert/key for mTLS, and
+    insecure-skip-verify.  Raises NativeHttpError with the OpenSSL
+    reason when the material can't be loaded.
+    """
+
+    def __init__(self, ca_file=None, cert_file=None, key_file=None,
+                 insecure: bool = False):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        if not lib.ht_tls_available():
+            raise RuntimeError("native TLS runtime (libssl) unavailable")
+        self._lib = lib
+        self.insecure = bool(insecure)
+        self._ctx = lib.ht_tls_ctx_new(
+            (ca_file or "").encode(), (cert_file or "").encode(),
+            (key_file or "").encode(), int(insecure))
+        if not self._ctx:
+            err = lib.ht_last_error()
+            raise NativeHttpError(
+                f"TLS context: {err.decode() if err else 'unknown error'}")
+
+    def close(self) -> None:
+        ctx, self._ctx = getattr(self, "_ctx", None), None
+        if ctx:
+            self._lib.ht_tls_ctx_free(ctx)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 # ht_request return codes (tpu_operator.h)
 _HT_ERRORS = {-1: "connect failed or timed out", -2: "send/recv failed",
               -3: "malformed HTTP response"}
@@ -385,16 +466,19 @@ WS_OK, WS_EOF, WS_TIMEOUT, WS_ERROR = 0, 1, 2, 3
 
 
 class NativeHttpTransport:
-    """Plain-TCP HTTP/1.1 exchanges + streaming watch via the C++ core.
+    """HTTP/1.1 exchanges + streaming watch via the C++ core.
 
-    The native side owns socket I/O, response framing, chunked-transfer
-    decoding and watch line splitting (native/src/http.cc); blocking
-    reads run with the GIL released, so a watch stream parked in a
-    minutes-long read never stalls the interpreter.  TLS endpoints stay
-    on the Python ssl/http.client path (k8s/rest.py selects by scheme).
+    The native side owns socket I/O, TLS (dlopen'd OpenSSL — tls.cc),
+    response framing, chunked-transfer decoding and watch line splitting
+    (native/src/http.cc); blocking reads run with the GIL released, so a
+    watch stream parked in a minutes-long read never stalls the
+    interpreter.  Pass a NativeTlsContext for HTTPS endpoints; when the
+    TLS runtime is unavailable k8s/rest.py keeps the Python ssl path.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 tls: Optional[NativeTlsContext] = None,
+                 server_name: Optional[str] = None):
         lib = load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_load_error}")
@@ -402,6 +486,10 @@ class NativeHttpTransport:
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.tls = tls
+        # SNI + certificate subject checks use server_name (the URL
+        # hostname); host may be an IP from a kubeconfig proxy setup
+        self.server_name = server_name or host
 
     @staticmethod
     def _join_headers(headers: Optional[dict]) -> bytes:
@@ -427,7 +515,9 @@ class NativeHttpTransport:
         out_body = ctypes.POINTER(ctypes.c_char)()
         out_len = ctypes.c_int()
         out_status = ctypes.c_int()
-        rc = self._lib.ht_request(
+        rc = self._lib.ht_request2(
+            self.tls._ctx if self.tls else None,
+            self.server_name.encode(),
             self.host.encode(), self.port, method.encode(), path.encode(),
             self._join_headers(headers), body or b"",
             len(body) if body else 0, timeout or self.timeout,
@@ -436,18 +526,28 @@ class NativeHttpTransport:
         data = self._take(out_body, out_len.value)
         if rc != 0:
             raise NativeHttpError(
-                f"{method} {path}: {_HT_ERRORS.get(rc, f'error {rc}')}")
+                f"{method} {path}: {_HT_ERRORS.get(rc, f'error {rc}')}"
+                f"{self._error_detail()}")
         return out_status.value, data or b""
+
+    def _error_detail(self) -> str:
+        err = self._lib.ht_last_error()
+        return f" ({err.decode()})" if err else ""
 
     def open_watch(self, path: str, headers: Optional[dict] = None,
                    timeout: Optional[float] = None) -> "NativeWatchStream":
         out_status = ctypes.c_int()
-        h = self._lib.ws_open(self.host.encode(), self.port, path.encode(),
-                              self._join_headers(headers),
-                              timeout or self.timeout,
-                              ctypes.byref(out_status))
+        h = self._lib.ws_open2(
+            self.tls._ctx if self.tls else None,
+            self.server_name.encode(),
+            self.host.encode(), self.port, path.encode(),
+            self._join_headers(headers),
+            timeout or self.timeout,
+            ctypes.byref(out_status))
         if not h:
-            raise NativeHttpError(f"watch {path}: connect/handshake failed")
+            raise NativeHttpError(
+                f"watch {path}: connect/handshake failed"
+                f"{self._error_detail()}")
         return NativeWatchStream(self._lib, h, out_status.value)
 
 
