@@ -89,16 +89,25 @@ bool resolve(void* handle, const char* name, F* out) {
 const Api* load_api() {
   static Api api;
   static bool ok = [] {
-    api.ssl_handle = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (api.ssl_handle == nullptr) {
-      api.ssl_handle = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    // Versions must be loaded as a matched PAIR: libssl 1.1 against
+    // libcrypto 3 (or vice versa) means opaque-struct layout mismatch
+    // (X509_VERIFY_PARAM) and split thread error queues.  RTLD_LOCAL:
+    // everything resolves via dlsym on the handle, and injecting
+    // OpenSSL symbols globally could poison later-loaded Python
+    // extensions built against a different bundled OpenSSL.
+    for (const auto& pair : {std::pair<const char*, const char*>{
+                                 "libssl.so.3", "libcrypto.so.3"},
+                             {"libssl.so.1.1", "libcrypto.so.1.1"}}) {
+      api.ssl_handle = dlopen(pair.first, RTLD_NOW | RTLD_LOCAL);
+      if (api.ssl_handle == nullptr) continue;
+      api.crypto_handle = dlopen(pair.second, RTLD_NOW | RTLD_LOCAL);
+      if (api.crypto_handle != nullptr) break;
+      dlclose(api.ssl_handle);
+      api.ssl_handle = nullptr;
     }
-    if (api.ssl_handle == nullptr) return false;
-    api.crypto_handle = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (api.crypto_handle == nullptr) {
-      api.crypto_handle = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    if (api.ssl_handle == nullptr || api.crypto_handle == nullptr) {
+      return false;
     }
-    if (api.crypto_handle == nullptr) return false;
     void* s = api.ssl_handle;
     void* c = api.crypto_handle;
     resolve(s, "SSL_CTX_set_options", &api.SSL_CTX_set_options);  // optional
@@ -256,7 +265,10 @@ void* tls_conn_open(TlsConfig* cfg, int fd, const char* server_name,
   errno = 0;  // a stale errno must not masquerade as the syscall reason
   int rc = api->SSL_connect(ssl);
   if (rc != 1) {
-    long vr = api->SSL_get_verify_result(ssl);
+    // only meaningful when verification was requested: insecure mode
+    // still records the would-be verify result, and reporting it would
+    // send operators chasing certificates for an unrelated I/O failure
+    long vr = insecure ? 0 : api->SSL_get_verify_result(ssl);
     if (vr != 0) {  // X509_V_OK == 0
       *err = std::string("certificate verification failed: ") +
              api->X509_verify_cert_error_string(vr);
@@ -277,6 +289,10 @@ void tls_conn_close(void* conn) {
   if (api == nullptr || conn == nullptr) return;
   api->SSL_shutdown(conn);  // best-effort close_notify; peer may be gone
   api->SSL_free(conn);
+  // SSL_get_error is error-queue-dominant: a failed shutdown (peer RST)
+  // must not leak queued errors that would misclassify the next
+  // connection's clean EOF on this thread as SSL_ERROR_SSL
+  api->ERR_clear_error();
 }
 
 long tls_recv(void* conn, char* buf, unsigned long len) {

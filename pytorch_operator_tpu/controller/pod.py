@@ -15,6 +15,7 @@ from typing import Dict, List
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
 from ..k8s import serde
+from ..k8s.errors import ApiError
 from ..runtime.expectations import expectation_pods_key
 from ..runtime.job_controller import gen_general_name, gen_pod_group_name
 from ..runtime.logger import logger_for_pod, logger_for_replica
@@ -183,9 +184,22 @@ class PodReconcilerMixin:
                 constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION
             ] = gen_pod_group_name(job.metadata.name)
 
-        self.pod_control.create_pod_with_controller_ref(
-            job.metadata.namespace, pod, job_dict, controller_ref
-        )
+        try:
+            self.pod_control.create_pod_with_controller_ref(
+                job.metadata.namespace, pod, job_dict, controller_ref
+            )
+        except ApiError:
+            # Roll back the raised expectation: without this, a failed
+            # create (e.g. AlreadyExists colliding with a pod of the
+            # job's previous incarnation that GC hasn't removed yet)
+            # parks the job unsynced until the 5-minute expectations
+            # TTL.  Upstream kube controllers decrement via
+            # CreationObserved on create failure; the reference's
+            # pod.go:218-226 inherits the leak — this is a deliberate
+            # divergence, surfaced by the 100-job churn bench.
+            self.expectations.creation_observed(
+                expectation_pods_key(job_key, rt))
+            raise
 
     def _is_non_gang_scheduler_set(self, job: PyTorchJob) -> bool:
         for spec in job.spec.pytorch_replica_specs.values():

@@ -100,6 +100,23 @@ class PyTorchController(
         return self.job_informer.store.get_by_key(f"{namespace}/{name}")
 
     def _job_deleted(self, obj: dict) -> None:
+        # Clear the dead incarnation's expectations HERE, in the DELETED
+        # callback, not only in the sync-time cache-miss branch: a
+        # delete followed by an immediate recreate under the same key
+        # can re-populate the cache before any worker observes the miss,
+        # leaving stale unfulfilled expectations to gate the new job
+        # until the 5-minute TTL.  Safe against the new incarnation's
+        # own expectations: the informer dispatches events in order, so
+        # the recreate's ADDED (and any sync that can see it in the
+        # cache) strictly follows this callback.  Surfaced by the churn
+        # scenario (pytorch_operator_tpu/k8s/churn.py).
+        meta = obj.get("metadata") or {}
+        key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+        for rtype in constants.VALID_REPLICA_TYPES:
+            self.expectations.delete_expectations(
+                expectation_pods_key(key, rtype))
+            self.expectations.delete_expectations(
+                expectation_services_key(key, rtype))
         self.enqueue_job(obj)
 
     def _update_job_status(self, job: PyTorchJob) -> None:

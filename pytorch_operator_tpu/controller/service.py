@@ -14,6 +14,7 @@ from typing import List
 
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
+from ..k8s.errors import ApiError
 from ..runtime.expectations import expectation_services_key
 from ..runtime.job_controller import gen_general_name
 from ..runtime.logger import logger_for_replica
@@ -69,6 +70,14 @@ class ServiceReconcilerMixin:
                 "ports": [{"name": constants.DEFAULT_PORT_NAME, "port": port}],
             },
         }
-        self.service_control.create_service_with_controller_ref(
-            job.metadata.namespace, service, job_dict, controller_ref
-        )
+        try:
+            self.service_control.create_service_with_controller_ref(
+                job.metadata.namespace, service, job_dict, controller_ref
+            )
+        except ApiError:
+            # roll back the expectation on create failure (see the
+            # matching divergence note in pod.py create_new_pod) —
+            # otherwise the job parks unsynced until the 5-minute TTL
+            self.expectations.creation_observed(
+                expectation_services_key(job.key, rt))
+            raise

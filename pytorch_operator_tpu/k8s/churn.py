@@ -1,0 +1,154 @@
+"""Shared churn-scenario driver: N jobs with interleaved delete/recreate
+through a threadiness-T controller against the fake cluster.
+
+One implementation serves both the regression test
+(tests/test_e2e_sim.py) and the committed bench
+(scripts/bench_control_plane.py), so the two always measure the same
+regime.  Reference anchor: the workqueue hot loop (controller.go:215-218)
+and the expectations gate (jobcontroller.go:110-131) — this scenario is
+what those structures exist for, and it is the load that surfaced the
+expectation-rollback divergence documented in controller/pod.py.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+import threading
+import time
+from typing import Optional
+
+from ..api.v1 import constants
+from ..metrics.prometheus import Registry
+from .errors import NotFoundError
+from .fake import FakeCluster
+from .fake_kubelet import FakeKubelet
+
+
+def _job_dict(name: str, workers: int) -> dict:
+    tmpl = {"spec": {"containers": [{"name": "pytorch", "image": "img:1"}]}}
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"pytorchReplicaSpecs": {
+            "Master": {"replicas": 1, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+            "Worker": {"replicas": workers, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+        }},
+    }
+
+
+def _condition_true(job: dict, cond_type: str) -> bool:
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c["type"] == cond_type and c["status"] == "True":
+            return True
+    return False
+
+
+def run_churn_scenario(jobs: int = 100, workers: int = 4,
+                       threadiness: int = 4, timeout: float = 300.0,
+                       name_prefix: str = "churn") -> dict:
+    """Drive the scenario to convergence; returns a metrics dict.
+
+    Every 7th job triggers churn: the job submitted 3 positions earlier
+    is deleted mid-flight (GC of its pods/services) and immediately
+    resubmitted under the same name.
+    """
+    from ..controller import PyTorchController
+    from ..runtime import JobControllerConfig
+    from ..runtime.expectations import (
+        expectation_pods_key,
+        expectation_services_key,
+    )
+
+    ns = "default"
+    cluster = FakeCluster()
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=Registry())
+    stop = threading.Event()
+    ctl.run(threadiness=threadiness, stop_event=stop)
+    try:
+        created_at: dict = {}
+        t0 = time.perf_counter()
+        for i in range(jobs):
+            name = f"{name_prefix}-{i}"
+            created_at[name] = time.perf_counter()
+            cluster.jobs.create(ns, _job_dict(name, workers))
+            if i and i % 7 == 0:
+                victim = f"{name_prefix}-{i - 3}"
+                cluster.jobs.delete(ns, victim)
+                created_at[victim] = time.perf_counter()
+                cluster.jobs.create(ns, _job_dict(victim, workers))
+        create_wall = time.perf_counter() - t0
+
+        succeeded_at: dict = {}
+        deadline = t0 + timeout
+        while len(succeeded_at) < jobs and time.perf_counter() < deadline:
+            for i in range(jobs):
+                name = f"{name_prefix}-{i}"
+                if name in succeeded_at:
+                    continue
+                try:
+                    job = cluster.jobs.get(ns, name)
+                except NotFoundError:
+                    continue
+                if _condition_true(job, constants.JOB_SUCCEEDED):
+                    succeeded_at[name] = time.perf_counter()
+            time.sleep(0.01)
+        converged = len(succeeded_at) == jobs
+        wall = (max(succeeded_at.values()) - t0) if succeeded_at else None
+
+        drain_start = time.perf_counter()
+        while len(ctl.work_queue) and time.perf_counter() - drain_start < 30:
+            time.sleep(0.01)
+        drain_s = time.perf_counter() - drain_start
+
+        expectations_satisfied = all(
+            ctl.expectations.satisfied(key_fn(f"{ns}/{name_prefix}-{i}",
+                                              rtype.lower()))
+            for i in range(jobs)
+            for rtype in (constants.REPLICA_TYPE_MASTER,
+                          constants.REPLICA_TYPE_WORKER)
+            for key_fn in (expectation_pods_key, expectation_services_key))
+
+        pods = cluster.pods.list(ns)
+        per_job: dict = {}
+        for p in pods:
+            job_name = (p["metadata"].get("labels") or {}).get(
+                constants.LABEL_PYTORCH_JOB_NAME, "?")
+            per_job[job_name] = per_job.get(job_name, 0) + 1
+        duplicates = {j: c for j, c in per_job.items()
+                      if c != workers + 1}
+
+        lats = sorted(succeeded_at[n] - created_at[n] for n in succeeded_at)
+        idx = max(0, math.ceil(0.95 * len(lats)) - 1) if lats else 0
+        unconverged: Optional[list] = (
+            None if converged else
+            sorted(n for i in range(jobs)
+                   if (n := f"{name_prefix}-{i}") not in succeeded_at))
+        return {
+            "jobs": jobs,
+            "threadiness": threadiness,
+            "converged": converged,
+            "unconverged_jobs": unconverged,
+            "create_wall_s": round(create_wall, 2),
+            "convergence_wall_s": round(wall, 2) if wall else None,
+            "jobs_per_s": round(len(succeeded_at) / wall, 1) if wall else None,
+            "succeeded_median_ms": round(
+                statistics.median(lats) * 1e3, 1) if lats else None,
+            "succeeded_p95_ms": round(lats[idx] * 1e3, 1) if lats else None,
+            "queue_drain_s": round(drain_s, 2),
+            "queue_len_after": len(ctl.work_queue),
+            "expectations_satisfied": expectations_satisfied,
+            "duplicate_pod_jobs": duplicates,
+            "pods_final": len(pods),
+            "pods_expected": jobs * (workers + 1),
+        }
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()

@@ -179,8 +179,12 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
                     return None
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so missing newly-added symbols
+            # (make failed so it couldn't be rebuilt) — degrade to the
+            # Python fallbacks exactly like a failed build would
             _load_error = str(e)
+            _lib = None
             return None
         return _lib
 

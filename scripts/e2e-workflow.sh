@@ -19,12 +19,34 @@
 #     run-defaults.sh + run-cleanpodpolicy-all.sh + teardown in the
 #     reference; teardown runs in an exit handler like
 #     workflows.libsonnet:255-268.
+#
+#   DRYRUN=1 (gke mode) — print the full command plan instead of
+#     executing it, so the cluster tier is checked code: the plan is
+#     asserted by tests/test_scripts.py (referenced files must exist)
+#     without needing gcloud or a cluster.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODE="${MODE:-local}"
+DRYRUN="${DRYRUN:-0}"
 
 step() { echo; echo "=== [$MODE] $1 ==="; }
+
+run() {  # execute, or print one plan line under DRYRUN=1
+  if [ "$DRYRUN" = "1" ]; then
+    echo "PLAN: $*"
+  else
+    "$@"
+  fi
+}
+
+run_sh() {  # shell pipeline variant (quoted as a single plan line)
+  if [ "$DRYRUN" = "1" ]; then
+    echo "PLAN: sh -c '$1'"
+  else
+    bash -c "$1"
+  fi
+}
 
 if [ "$MODE" = "local" ]; then
   step "build: native runtime core"
@@ -51,6 +73,11 @@ if [ "$MODE" != "gke" ]; then
   exit 1
 fi
 
+if [ "$DRYRUN" = "1" ]; then
+  # the plan must print without cloud credentials or env
+  PROJECT="${PROJECT:-example-project}"
+  ZONE="${ZONE:-us-central2-b}"
+fi
 : "${PROJECT:?set PROJECT for MODE=gke}"
 : "${ZONE:?set ZONE for MODE=gke}"
 CLUSTER="${CLUSTER:-pytorch-operator-e2e}"
@@ -61,43 +88,46 @@ KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
 
 teardown() {
   step "teardown"
-  kubectl delete -f manifests/ --ignore-not-found || true
+  run kubectl delete -f manifests/ --ignore-not-found || true
   if [ "$KEEP_CLUSTER" != "1" ]; then
-    gcloud container clusters delete "$CLUSTER" \
+    run gcloud container clusters delete "$CLUSTER" \
       --project "$PROJECT" --zone "$ZONE" --quiet || true
   fi
 }
 trap teardown EXIT
 
 step "build + push operator image"
-BUILDER="${BUILDER:-gcloud}" IMAGE="$IMAGE" PUSH=1 scripts/build-image.sh
+BUILDER="${BUILDER:-gcloud}" IMAGE="$IMAGE" PUSH=1 run scripts/build-image.sh
 
 step "create GKE cluster with a TPU node pool"
 # reference scripts/create-cluster.sh, updated for TPU: a small CPU pool
 # for the operator plus an all-or-nothing TPU slice pool for workloads
-gcloud container clusters create "$CLUSTER" \
+run gcloud container clusters create "$CLUSTER" \
   --project "$PROJECT" --zone "$ZONE" \
   --num-nodes 1 --machine-type e2-standard-4
-gcloud container node-pools create tpu-pool \
+run gcloud container node-pools create tpu-pool \
   --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
   --machine-type "ct5lp-hightpu-8t" --num-nodes 1 \
   --node-labels "cloud.google.com/gke-tpu-accelerator=tpu-${TPU_TYPE%%pod*},cloud.google.com/gke-tpu-topology=2x4"
-gcloud container clusters get-credentials "$CLUSTER" \
+run gcloud container clusters get-credentials "$CLUSTER" \
   --project "$PROJECT" --zone "$ZONE"
 
 step "deploy operator manifests"
-kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
-kubectl apply -f manifests/crd.yaml -f manifests/podgroup.yaml
-kubectl apply -f manifests/rbac.yaml -f manifests/service.yaml
-sed "s#image: .*pytorch-operator.*#image: $IMAGE#" manifests/deployment.yaml \
-  | kubectl apply -f -
-kubectl -n "$NAMESPACE" rollout status deploy/pytorch-operator --timeout=300s
+run_sh "kubectl create namespace $NAMESPACE --dry-run=client -o yaml | kubectl apply -f -"
+run kubectl apply -f manifests/crd.yaml -f manifests/podgroup.yaml
+run kubectl apply -f manifests/rbac.yaml -f manifests/service.yaml
+run_sh "sed 's#image: .*pytorch-operator.*#image: $IMAGE#' manifests/deployment.yaml | kubectl apply -f -"
+run kubectl -n "$NAMESPACE" rollout status deploy/pytorch-operator --timeout=300s
 
 step "e2e: defaults + cleanpodpolicy + SDK (against the live cluster)"
-MASTER="$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')"
-export MASTER
-scripts/v1/run-defaults.sh
-scripts/v1/run-cleanpodpolicy-all.sh
-python -m pytest tests/test_sdk.py -q
+if [ "$DRYRUN" = "1" ]; then
+  echo "PLAN: export MASTER=\$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')"
+else
+  MASTER="$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')"
+  export MASTER
+fi
+run scripts/v1/run-defaults.sh
+run scripts/v1/run-cleanpodpolicy-all.sh
+run python -m pytest tests/test_sdk.py -q
 
-echo; echo "e2e workflow (gke) passed"
+echo; echo "e2e workflow (gke) $([ "$DRYRUN" = "1" ] && echo 'plan printed' || echo 'passed')"

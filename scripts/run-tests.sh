@@ -23,6 +23,15 @@ else
   echo "no linter in image (ruff/flake8) — skipped"
 fi
 
+echo "=== shell lint ==="
+if command -v shellcheck >/dev/null 2>&1; then
+  find scripts -name '*.sh' -print0 | xargs -0 shellcheck --severity=warning
+else
+  # bash -n still catches syntax errors when shellcheck is absent
+  find scripts -name '*.sh' -print0 | xargs -0 -n1 bash -n
+  echo "shellcheck not in image — parsed with bash -n only"
+fi
+
 echo "=== tests ==="
 if python -c "import pytest_cov" >/dev/null 2>&1; then
   python -m pytest tests/ -q --cov=pytorch_operator_tpu --cov-report=term

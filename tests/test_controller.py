@@ -488,6 +488,60 @@ def test_sync_deleted_job_counts_and_clears():
     assert ctl.jobs_deleted_counter.value == 1
 
 
+def test_failed_create_rolls_back_expectation():
+    """A failed pod/service create must decrement the just-raised
+    expectation — otherwise the job parks unsynced until the 5-minute
+    expectations TTL (a divergence from the reference, whose
+    pod.go:218-226 inherits the leak; surfaced by the churn scenario,
+    pytorch_operator_tpu/k8s/churn.py)."""
+    from pytorch_operator_tpu.k8s.errors import AlreadyExistsError
+    from pytorch_operator_tpu.runtime.expectations import (
+        expectation_pods_key,
+        expectation_services_key,
+    )
+
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    inject_job(ctl, job)
+    ctl.pod_control.create_error = AlreadyExistsError("pod exists")
+    ctl.service_control.create_error = AlreadyExistsError("svc exists")
+    ctl.sync_job(KEY)  # reconcile error is logged + requeued, not raised
+    for rtype in ("master", "worker"):
+        assert ctl.expectations.satisfied(
+            expectation_pods_key(KEY, rtype)), rtype
+        assert ctl.expectations.satisfied(
+            expectation_services_key(KEY, rtype)), rtype
+    # with the failure cleared, the very next sync proceeds (no TTL wait)
+    ctl.pod_control.create_error = None
+    ctl.service_control.create_error = None
+    ctl.sync_job(KEY)
+    assert len(ctl.pod_control.templates) == 2
+
+
+def test_job_delete_event_clears_expectations():
+    """Delete-then-instant-recreate race: the DELETED informer callback
+    must clear the dead incarnation's expectations immediately — the
+    sync-time cache-miss branch never runs when the recreate repopulates
+    the cache first, and stale expectations would park the new job for
+    the 5-minute TTL (caught by the churn scenario, ~1-in-20 runs)."""
+    from pytorch_operator_tpu.runtime.expectations import (
+        expectation_pods_key,
+        expectation_services_key,
+    )
+
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    data = inject_job(ctl, job)
+    ctl.sync_job(KEY)  # raises expectations; fake controls never observe
+    assert not ctl.expectations.satisfied(expectation_pods_key(KEY, "master"))
+    ctl._job_deleted(data)
+    for rtype in ("master", "worker"):
+        assert ctl.expectations.satisfied(
+            expectation_pods_key(KEY, rtype)), rtype
+        assert ctl.expectations.satisfied(
+            expectation_services_key(KEY, rtype)), rtype
+
+
 def test_expectations_gate_resync():
     ctl, cluster, _ = make_controller()
     job = new_job(workers=1)

@@ -152,6 +152,35 @@ def test_metrics_counters(world):
     assert "pytorch_operator_jobs_successful_total 1" in text
 
 
+def test_scale_100_jobs_churn_threadiness_4():
+    """The regime the concurrency machinery exists for: 100 jobs x
+    (1 master + 4 workers) through the workqueue with threadiness 4,
+    with interleaved create/delete churn.  Asserts convergence within a
+    bound, a drained workqueue, satisfied expectations for every job,
+    and — the expectation cache's whole purpose — no duplicate pods.
+
+    The driver is shared with scripts/bench_control_plane.py
+    (pytorch_operator_tpu/k8s/churn.py) so the bench and this
+    regression test always measure the same regime.  This load is what
+    surfaced the expectation-rollback-on-create-failure divergence
+    (controller/pod.py create_new_pod)."""
+    from pytorch_operator_tpu.k8s.churn import run_churn_scenario
+
+    # convergence bound: generous (shared CI box) but a real bound —
+    # regressions that serialise the queue or leak expectations (the
+    # 5-minute TTL park) blow straight past it
+    res = run_churn_scenario(jobs=100, workers=4, threadiness=4,
+                             timeout=120.0, name_prefix="scale")
+    assert res["converged"], (
+        f"jobs never reached Succeeded: {res['unconverged_jobs']}")
+    assert res["expectations_satisfied"], "expectation leak"
+    assert res["queue_len_after"] == 0, res
+    assert not res["duplicate_pod_jobs"], (
+        f"expectation leak made duplicate pods: {res['duplicate_pod_jobs']}")
+    assert res["pods_final"] == res["pods_expected"], res
+    assert res["convergence_wall_s"] < 120.0, res
+
+
 def test_operator_restart_recovers_mid_flight_job():
     """Crash-and-restart recovery: the operator dies while a job is
     mid-flight, the pods finish during the outage (events lost — no

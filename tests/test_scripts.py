@@ -1,0 +1,74 @@
+"""The cluster-tier workflow is checked code, not prose: the gke mode's
+DRYRUN plan must print without cloud credentials, reference only files
+that exist, and parse under bash -n (and shellcheck when available)."""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKFLOW = os.path.join(REPO, "scripts", "e2e-workflow.sh")
+
+
+def _plan_lines() -> list[str]:
+    proc = subprocess.run(
+        ["bash", WORKFLOW], cwd=REPO,
+        env={**os.environ, "MODE": "gke", "DRYRUN": "1"},
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    return [line[len("PLAN: "):] for line in proc.stdout.splitlines()
+            if line.startswith("PLAN: ")]
+
+
+def test_gke_plan_references_only_existing_files():
+    lines = _plan_lines()
+    assert lines, "dry run printed no plan"
+    referenced = set()
+    for line in lines:
+        # repo-relative paths the plan expects to exist
+        referenced.update(re.findall(r"(?:scripts|manifests|tests)/[\w./-]+",
+                                     line))
+    assert referenced, "plan references no repo files (suspicious)"
+    missing = [p for p in sorted(referenced)
+               if not os.path.exists(os.path.join(REPO, p))]
+    assert not missing, f"plan references missing files: {missing}"
+
+
+def test_gke_plan_covers_reference_pipeline_stages():
+    """workflows.libsonnet:196-268 stage parity: build -> cluster ->
+    deploy -> e2e (defaults, cleanpodpolicy, sdk) -> teardown."""
+    plan = "\n".join(_plan_lines())
+    for needle in ("build-image.sh", "clusters create", "node-pools create",
+                   "crd.yaml", "rollout status", "run-defaults.sh",
+                   "run-cleanpodpolicy-all.sh", "test_sdk.py",
+                   "clusters delete"):
+        assert needle in plan, f"plan lost the {needle!r} stage"
+
+
+def test_all_shell_scripts_parse():
+    scripts = []
+    for root, _dirs, files in os.walk(os.path.join(REPO, "scripts")):
+        scripts += [os.path.join(root, f) for f in files
+                    if f.endswith(".sh")]
+    assert scripts
+    for path in scripts:
+        proc = subprocess.run(["bash", "-n", path], capture_output=True,
+                              text=True)
+        assert proc.returncode == 0, f"{path}: {proc.stderr}"
+
+
+@pytest.mark.skipif(shutil.which("shellcheck") is None,
+                    reason="shellcheck not installed")
+def test_shellcheck_clean():
+    scripts = []
+    for root, _dirs, files in os.walk(os.path.join(REPO, "scripts")):
+        scripts += [os.path.join(root, f) for f in files
+                    if f.endswith(".sh")]
+    proc = subprocess.run(["shellcheck", "--severity=warning", *scripts],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout

@@ -1,0 +1,215 @@
+"""_KubeBackend (the `kubernetes`-package SDK backend) request-shaping
+tests.
+
+The real package isn't in this image, so a minimal fake of the exact
+API surface the backend calls (CustomObjectsApi / CoreV1Api /
+config loaders / ApiException) is injected via sys.modules, backed by
+the in-memory FakeCluster — the backend's group/version/plural routing,
+404 mapping, selector building and model-object normalisation are
+exercised without the dependency.  Reference parity:
+sdk/python/kubeflow/pytorchjob/api/py_torch_job_client.py:29-393 (which
+is tested upstream against a real cluster only).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.k8s.errors import NotFoundError
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+
+from testutil import new_job
+
+
+class _ApiException(Exception):
+    def __init__(self, status=500, reason=""):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+
+
+class _PodModel:
+    """Mimics the kubernetes client's model objects (attr access +
+    to_dict), so the backend's normalisation path is exercised."""
+
+    def __init__(self, wire: dict):
+        self._wire = wire
+
+    def to_dict(self):
+        return self._wire
+
+
+class _PodList:
+    def __init__(self, items):
+        self.items = items
+
+
+def _make_fake_kubernetes(cluster: FakeCluster, calls: list):
+    """Build fake `kubernetes`, `kubernetes.client`,
+    `kubernetes.client.rest`, `kubernetes.config` modules."""
+
+    class CustomObjectsApi:
+        def create_namespaced_custom_object(self, group, version, namespace,
+                                            plural, body):
+            calls.append(("create", group, version, namespace, plural))
+            return cluster.resource(plural).create(namespace, body)
+
+        def get_namespaced_custom_object(self, group, version, namespace,
+                                         plural, name):
+            calls.append(("get", group, version, namespace, plural, name))
+            try:
+                return cluster.resource(plural).get(namespace, name)
+            except NotFoundError as e:
+                raise _ApiException(status=404, reason=str(e)) from e
+
+        def list_namespaced_custom_object(self, group, version, namespace,
+                                          plural):
+            calls.append(("list", group, version, namespace, plural))
+            return {"items": cluster.resource(plural).list(
+                namespace=namespace)}
+
+        def list_cluster_custom_object(self, group, version, plural):
+            calls.append(("list_cluster", group, version, plural))
+            return {"items": cluster.resource(plural).list()}
+
+        def patch_namespaced_custom_object(self, group, version, namespace,
+                                           plural, name, body):
+            calls.append(("patch", group, version, namespace, plural, name))
+            return cluster.resource(plural).patch(namespace, name, body)
+
+        def delete_namespaced_custom_object(self, group=None, version=None,
+                                            namespace=None, plural=None,
+                                            name=None, body=None):
+            calls.append(("delete", group, version, namespace, plural, name))
+            cluster.resource(plural).delete(namespace, name)
+            return {"status": "Success"}
+
+    class CoreV1Api:
+        def list_namespaced_pod(self, namespace, label_selector=None):
+            calls.append(("list_pods", namespace, label_selector))
+            selector = dict(pair.split("=", 1)
+                            for pair in (label_selector or "").split(",")
+                            if "=" in pair) or None
+            pods = cluster.pods.list(namespace=namespace,
+                                     label_selector=selector)
+            return _PodList([_PodModel(p) for p in pods])
+
+        def read_namespaced_pod_log(self, name, namespace):
+            calls.append(("read_log", namespace, name))
+            pod = cluster.pods.get(namespace, name)
+            annotations = (pod.get("metadata") or {}).get(
+                "annotations") or {}
+            return annotations.get("fake.kubelet/logs", "")
+
+    kubernetes = types.ModuleType("kubernetes")
+    client_mod = types.ModuleType("kubernetes.client")
+    rest_mod = types.ModuleType("kubernetes.client.rest")
+    config_mod = types.ModuleType("kubernetes.config")
+    client_mod.CustomObjectsApi = CustomObjectsApi
+    client_mod.CoreV1Api = CoreV1Api
+    rest_mod.ApiException = _ApiException
+    client_mod.rest = rest_mod
+    config_mod.load_kube_config = lambda **kw: calls.append(
+        ("load_kube_config", kw))
+    config_mod.load_incluster_config = lambda: calls.append(
+        ("load_incluster_config",))
+    kubernetes.client = client_mod
+    kubernetes.config = config_mod
+    return {"kubernetes": kubernetes,
+            "kubernetes.client": client_mod,
+            "kubernetes.client.rest": rest_mod,
+            "kubernetes.config": config_mod}
+
+
+@pytest.fixture
+def kube_world(monkeypatch):
+    cluster = FakeCluster()
+    calls: list = []
+    for name, mod in _make_fake_kubernetes(cluster, calls).items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    from pytorch_operator_tpu.sdk.client import PyTorchJobClient
+
+    client = PyTorchJobClient()  # no cluster/master -> _KubeBackend
+    from pytorch_operator_tpu.sdk.client import _KubeBackend
+
+    assert isinstance(client._backend, _KubeBackend)
+    return cluster, calls, client
+
+
+class TestKubeBackendRequestShaping:
+    def test_kubeconfig_loaded_outside_cluster(self, kube_world):
+        _cluster, calls, _client = kube_world
+        assert calls[0][0] == "load_kube_config"
+
+    def test_create_routes_group_version_plural(self, kube_world):
+        cluster, calls, client = kube_world
+        client.create(new_job(workers=1, name="kb-job"),
+                      namespace="default")
+        op = next(c for c in calls if c[0] == "create")
+        assert op[1:] == (constants.GROUP_NAME, constants.VERSION,
+                          "default", constants.PLURAL)
+        assert cluster.jobs.get("default", "kb-job")
+
+    def test_get_maps_404_to_not_found(self, kube_world):
+        _cluster, _calls, client = kube_world
+        with pytest.raises(NotFoundError):
+            client.get("absent", namespace="default")
+
+    def test_list_namespaced_and_cluster_wide(self, kube_world):
+        cluster, calls, client = kube_world
+        cluster.jobs.create("default", new_job(workers=0, name="a").to_dict())
+        items = client.get(namespace="default")["items"]
+        assert [j["metadata"]["name"] for j in items] == ["a"]
+        # cluster-wide list goes through list_cluster_custom_object
+        client._backend.list_jobs(None)
+        assert any(c[0] == "list_cluster" for c in calls)
+
+    def test_patch_and_delete_route(self, kube_world):
+        cluster, calls, client = kube_world
+        cluster.jobs.create("default",
+                            new_job(workers=0, name="pd").to_dict())
+        client.patch("pd", {"metadata": {"labels": {"x": "y"}}},
+                     namespace="default")
+        assert cluster.jobs.get("default", "pd")[
+            "metadata"]["labels"]["x"] == "y"
+        client.delete("pd", namespace="default")
+        op = next(c for c in calls if c[0] == "delete")
+        assert op[1:] == (constants.GROUP_NAME, constants.VERSION,
+                          "default", constants.PLURAL, "pd")
+        with pytest.raises(NotFoundError):
+            cluster.jobs.get("default", "pd")
+
+    def test_pod_listing_builds_selector_and_normalises_models(
+            self, kube_world):
+        cluster, calls, client = kube_world
+        cluster.pods.create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "kb-job-master-0", "namespace": "default",
+                         "labels": {"group-name": "kubeflow.org",
+                                    "controller-name": "pytorch-operator",
+                                    "pytorch-job-name": "kb-job",
+                                    "job-role": "master"},
+                         "annotations": {"fake.kubelet/logs": "ok\n"}},
+            "spec": {"containers": [{"name": "pytorch", "image": "i"}]},
+        })
+        names = client.get_pod_names("kb-job", namespace="default",
+                                     master=True)
+        assert names == ["kb-job-master-0"]
+        sel = next(c for c in calls if c[0] == "list_pods")[2]
+        assert "pytorch-job-name=kb-job" in sel and "job-role=master" in sel
+        logs = client.get_logs("kb-job", namespace="default")
+        assert logs == {"kb-job-master-0": "ok\n"}
+
+    def test_wait_for_job_reaches_succeeded(self, kube_world):
+        cluster, _calls, client = kube_world
+        cluster.jobs.create("default",
+                            new_job(workers=0, name="w").to_dict())
+        cluster.jobs.set_status("default", "w", {
+            "conditions": [{"type": "Succeeded", "status": "True"}]})
+        job = client.wait_for_job("w", namespace="default",
+                                  timeout_seconds=5, polling_interval=1)
+        assert job["metadata"]["name"] == "w"
