@@ -149,9 +149,7 @@ def _launch_overhead() -> float:
 
 
 def bench_llama_mfu(smoke: bool) -> dict:
-    import jax
     import jax.numpy as jnp
-    import optax
 
     from pytorch_operator_tpu.models import llama
 
@@ -169,7 +167,7 @@ def bench_llama_mfu(smoke: bool) -> dict:
         # buys nothing here.  B3+ without remat fails to compile (OOM);
         # multi-chip / longer-seq configs re-enable remat
         # (remat_policy="dots_with_no_batch_dims_saveable" was the best
-        # remat variant: 58.0% at B4).
+        # remat variant: 58.0% at B4 — see bench_llama_long_seq).
         cfg = llama.LlamaConfig(
             vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
             n_kv_heads=16, ffn_dim=5632, max_seq_len=2048,
@@ -178,6 +176,15 @@ def bench_llama_mfu(smoke: bool) -> dict:
         )
         batch, seq = 2, 2048
         iters = 20
+    return _measure_llama_step(cfg, batch, seq, iters)
+
+
+def _measure_llama_step(cfg, batch: int, seq: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (kept: cfg dtypes reference jnp)
+    import optax
+
+    from pytorch_operator_tpu.models import llama
 
     params = llama.init_params(jax.random.key(0), cfg)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -246,8 +253,43 @@ def bench_llama_mfu(smoke: bool) -> dict:
         "mfu_pct": round(100 * achieved_tflops / peak, 1) if peak else None,
         "final_loss": round(final_loss, 4),
         "flags": f"use_flash={cfg.use_flash} use_fused_norm={cfg.use_fused_norm} "
-                 f"remat={cfg.remat} {jnp.dtype(cfg.dtype).name} AdamW",
+                 f"remat={cfg.remat}"
+                 + (f"({cfg.remat_policy})" if cfg.remat_policy else "")
+                 + f" {jnp.dtype(cfg.dtype).name} AdamW",
     }
+
+
+def bench_llama_long_seq(smoke: bool) -> list[dict]:
+    """Long-sequence Llama MFU: the same ~0.9B model trained at T=4096
+    and T=8192 on one chip.
+
+    Activations at these lengths no longer fit without remat, so this
+    uses the measured-best policy from the 2026-07-30 sweep
+    (remat_policy="dots_with_no_batch_dims_saveable" — save matmul
+    outputs, recompute elementwise).  Together with section 4 (flash at
+    16k/32k) this is the single-chip long-context story; ring/Ulysses
+    SP extend it across a mesh.
+    """
+    import jax.numpy as jnp
+
+    from pytorch_operator_tpu.models import llama
+
+    if smoke:
+        cfg = llama.tiny(use_flash=False, use_fused_norm=False, remat=True,
+                         remat_policy="dots_with_no_batch_dims_saveable",
+                         dtype=jnp.bfloat16)
+        return [_measure_llama_step(cfg, 1, 128, 2)]
+    rows = []
+    for seq, iters in ((4096, 10), (8192, 5)):
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, ffn_dim=5632, max_seq_len=seq,
+            dtype=jnp.bfloat16, remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable",
+            use_flash=True, use_fused_norm=True,
+        )
+        rows.append(_measure_llama_step(cfg, 1, seq, iters))
+    return rows
 
 
 # ---------------------------------------------------------------------------
@@ -454,7 +496,7 @@ def bench_long_context(smoke: bool) -> list[dict]:
 
 
 def render_md(mfu: dict, flash: list[dict], norm: list[dict],
-              longctx: list[dict]) -> str:
+              longctx: list[dict], longseq: list[dict]) -> str:
     now = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC")
     lines = [
@@ -482,6 +524,24 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
         "numbers (its headline is the dist-MNIST envelope — bench.py), so "
         "this is the repo's own flagship baseline to beat in later rounds.",
         "",
+        "### 1b. Long-sequence training MFU (same model, remat on)",
+        "",
+        "| batch x seq | step ms | tokens/s/chip | TFLOP/s | MFU | flags |",
+        "|---|---|---|---|---|---|",
+    ] + [
+        (f"| {r['batch']} x {r['seq']} | {r['step_ms']} | "
+         f"{r['tokens_per_sec']:.0f} | {r['achieved_tflops']} | "
+         f"**{r['mfu_pct']}%** | {r['flags']} |")
+        for r in longseq
+    ] + [
+        "",
+        "Activations at 4k/8k tokens exceed HBM without rematerialisation; "
+        "the measured-best policy (dots_with_no_batch_dims_saveable: keep "
+        "matmul outputs, recompute elementwise) trades ~4/3x hardware "
+        "FLOPs for O(T) activation memory.  MFU here counts only useful "
+        "(non-recompute) FLOPs, so the remat tax shows up honestly as a "
+        "lower MFU than section 1's no-remat number.",
+        "",
         "## 2. Flash attention (Pallas) vs dense XLA",
         "",
         "| shape | fwd flash | fwd dense | fwd speedup | fwd+bwd flash | fwd+bwd dense | fwd+bwd speedup |",
@@ -494,17 +554,25 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
             f"{r['fwdbwd_dense_ms']} ms | **{r['fwdbwd_speedup']}x** |")
     lines += [
         "",
-        "Backward is the blockwise Pallas dq/dk/dv kernel "
-        "(ops/flash_attention.py) — O(T) memory, no (T,T) buffer.",
+        "Backward is the FUSED single-pass Pallas kernel "
+        "(ops/flash_attention.py): dk/dv in scratch plus dq accumulated "
+        "in a VMEM-resident f32 block, so p^T/dp^T are recomputed once "
+        "per tile (the FA-2 5-matmul minimum) — O(T) memory, no (T,T) "
+        "buffer.  Sequences whose dq exceeds the 4MB VMEM budget "
+        "(T>8192 at D=128) take the two-kernel fallback.",
         "",
         "Timing: two-point jitted lax.scan chains (the region auto-grows "
         "to >=0.3s and the fixed per-launch tunnel cost cancels in the "
         "subtraction), best of 3 rounds on a shared chip.  Flash blocks "
-        "auto-tune per shape (ops/flash_attention._auto_block; 1024 at "
-        "D<=128 — measured 4.8-5.9x over the naive 128x128 tiling).  At "
-        "seq 1024 the (T,T) buffer still fits XLA's fused softmax "
-        "pipeline so the paths tie; the flash win grows with T^2 "
-        "alongside the O(T)-memory advantage.",
+        "auto-tune per shape (ops/flash_attention._auto_block; 512 at "
+        "T<=1024, else 1024 at D<=128 — fused-backward sweep "
+        "2026-07-30; the tuning objective is fwd+bwd, i.e. training).  "
+        "At seq 1024 the (T,T) buffer fits XLA's fused softmax pipeline "
+        "and dense wins the FORWARD outright (see the table's fwd "
+        "column) while flash keeps the training (fwd+bwd) edge — "
+        "callers doing short-sequence inference can force the dense "
+        "path with block_q=0.  The flash win grows with T^2 alongside "
+        "the O(T)-memory advantage.",
         "",
         "## 3. Fused RMSNorm (Pallas) vs XLA",
         "",
@@ -517,15 +585,18 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
     lines += [
         "",
         "Standalone-forward, XLA's fused elementwise pipeline is at "
-        "the HBM roofline and the raw kernel does not beat it.  "
-        "In-model the kernel still wins: the measured-best Llama step "
-        "is ~10% faster with use_fused_norm=True (190.8 vs 212.9 ms at "
-        "B2/T2048 d2048; parity 71.0 vs 71.9 ms on a d4096 4-layer "
-        "slice, 2026-07-30) because the custom VJP's analytic backward "
-        "avoids the f32 intermediates XLA materializes through the "
-        "norm in the backward pass — which is why it stays on by "
-        "default (ops/rms_norm.py falls back to XLA only for ragged "
-        "rows or when kernel intermediates would exceed ~12MB VMEM).",
+        "the HBM roofline and the raw kernel does not beat it (the "
+        "rows above call the raw kernel directly).  The dispatcher "
+        "(ops/rms_norm.py) therefore routes wide rows (D>2048, where "
+        "the kernel consistently loses ~0.8x) to the XLA path, plus "
+        "ragged rows and >~12MB-VMEM shapes.  In-model the kernel "
+        "still wins where dispatched: the measured-best Llama step is "
+        "~10% faster with use_fused_norm=True (190.8 vs 212.9 ms at "
+        "B2/T2048 d2048, 2026-07-30) because the custom VJP's analytic "
+        "backward avoids the f32 intermediates XLA materializes "
+        "through the norm in the backward pass — enforced by the "
+        "tests/test_perf_fused_norm.py regression guard (interleaved "
+        "A/B on the real chip, fused must stay within 15% of unfused).",
         "",
         "## 4. Long context: flash at lengths dense attention cannot hold",
         "",
@@ -552,8 +623,8 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
         "## Raw JSON",
         "",
         "```json",
-        json.dumps({"mfu": mfu, "flash": flash, "rms_norm": norm,
-                    "long_context": longctx}, indent=2),
+        json.dumps({"mfu": mfu, "long_seq": longseq, "flash": flash,
+                    "rms_norm": norm, "long_context": longctx}, indent=2),
         "```",
         "",
     ]
@@ -601,6 +672,7 @@ def main() -> None:
 
 SECTIONS = {
     "mfu": bench_llama_mfu,
+    "long_seq": bench_llama_long_seq,
     "flash": bench_flash_vs_dense,
     "rms_norm": bench_rms_norm,
     "long_context": bench_long_context,
@@ -609,7 +681,7 @@ SECTIONS = {
 
 def _emit(results: dict, out: str | None) -> None:
     md = render_md(results["mfu"], results["flash"], results["rms_norm"],
-                   results["long_context"])
+                   results["long_context"], results["long_seq"])
     if out:
         with open(out, "w") as f:
             f.write(md)

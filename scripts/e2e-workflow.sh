@@ -44,7 +44,8 @@ run_sh() {  # shell pipeline variant (quoted as a single plan line)
   if [ "$DRYRUN" = "1" ]; then
     echo "PLAN: sh -c '$1'"
   else
-    bash -c "$1"
+    # child shell must keep the parent's errexit/pipefail discipline
+    bash -c "set -euo pipefail; $1"
   fi
 }
 
