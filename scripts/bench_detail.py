@@ -280,24 +280,26 @@ def bench_llama_long_seq(smoke: bool) -> list[dict]:
                          dtype=jnp.bfloat16)
         return [_measure_llama_step(cfg, 1, 128, 2)]
     rows = []
-    # Per-length remat policy: dots_with_no_batch_dims_saveable (save
-    # matmul outputs) is fastest while its saved activations fit, but
-    # at T>=16384 the compile itself blows the tunnel compile-helper's
-    # memory (HTTP 500, reproducible) — full remat (policy None, save
-    # nothing per layer) compiles in ~9s and runs, which is what makes
-    # single-chip 16k/32k full-model training possible at all.
-    for seq, iters, policy in (
-            (4096, 10, "dots_with_no_batch_dims_saveable"),
-            (8192, 5, "dots_with_no_batch_dims_saveable"),
-            (16384, 3, None),
-            (32768, 2, None)):
+    # Per-length measured-best batch + remat policy (2026-07-30 sweep):
+    # dots_with_no_batch_dims_saveable (save matmul outputs) is fastest
+    # while its saved activations fit — B2 beats B1 at T=4096 (58.8% vs
+    # 55.2% MFU).  At larger token counts the policy's compile blows
+    # the tunnel compile-helper's memory (HTTP 500, reproducible; B2
+    # T8192 fails even with full remat) — full remat (policy None,
+    # save nothing per layer) compiles in ~9s and runs, which is what
+    # makes single-chip 16k/32k full-model training possible at all.
+    for batch, seq, iters, policy in (
+            (2, 4096, 6, "dots_with_no_batch_dims_saveable"),
+            (1, 8192, 5, "dots_with_no_batch_dims_saveable"),
+            (1, 16384, 3, None),
+            (1, 32768, 2, None)):
         cfg = llama.LlamaConfig(
             vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
             n_kv_heads=16, ffn_dim=5632, max_seq_len=seq,
             dtype=jnp.bfloat16, remat=True, remat_policy=policy,
             use_flash=True, use_fused_norm=True,
         )
-        rows.append(_measure_llama_step(cfg, 1, seq, iters))
+        rows.append(_measure_llama_step(cfg, batch, seq, iters))
     return rows
 
 
