@@ -43,7 +43,10 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from pytorch_operator_tpu.models import mnist_cnn
 
-    batch_size = 1024
+    # Measured-best batch (2026-07-30 v5e sweep): 1024 -> 1.34M img/s,
+    # 2048 -> 1.58M, 4096 -> 1.08M (larger batches spill the small CNN's
+    # activations past VMEM-friendly tiling and throughput falls off).
+    batch_size = 2048
     # Long enough that the fixed per-launch cost (~tens of ms through
     # the device tunnel: dispatch round-trip + completion fetch) is <2%
     # of the timed region instead of ~50% at 50 steps — the region is
