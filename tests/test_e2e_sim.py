@@ -12,42 +12,17 @@ Mirrors the reference's e2e drivers:
 from __future__ import annotations
 
 import threading
-import time
 
 import pytest
 
 from pytorch_operator_tpu.api.v1 import constants
 from pytorch_operator_tpu.controller import PyTorchController
-from pytorch_operator_tpu.controller import status as sm
-from pytorch_operator_tpu.k8s.errors import NotFoundError
 from pytorch_operator_tpu.k8s.fake import FakeCluster
 from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
 from pytorch_operator_tpu.metrics.prometheus import Registry
 from pytorch_operator_tpu.runtime import JobControllerConfig
 
-from testutil import new_job
-
-TIMEOUT = 15.0
-
-
-def wait_for(predicate, timeout=TIMEOUT, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
-
-
-def job_condition(cluster, ns, name, cond_type):
-    try:
-        job = cluster.jobs.get(ns, name)
-    except NotFoundError:
-        return False
-    for c in (job.get("status") or {}).get("conditions") or []:
-        if c["type"] == cond_type and c["status"] == "True":
-            return True
-    return False
+from testutil import job_condition, new_job, wait_for
 
 
 @pytest.fixture

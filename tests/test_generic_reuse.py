@@ -18,8 +18,10 @@ from pytorch_operator_tpu.api.v1 import constants
 from pytorch_operator_tpu.k8s.fake import FakeCluster
 from pytorch_operator_tpu.runtime import JobController, JobControllerConfig
 from pytorch_operator_tpu.runtime.expectations import expectation_pods_key
-from pytorch_operator_tpu.runtime.informer import Informer, meta_namespace_key
+from pytorch_operator_tpu.runtime.informer import Informer
 from pytorch_operator_tpu.runtime.job_controller import gen_general_name
+
+from testutil import wait_for
 
 
 class SleepJobController(JobController):
@@ -118,15 +120,6 @@ class SleepJobController(JobController):
             if status.get("phase") != "Done":
                 status["phase"] = "Done"
                 self.store.set_status(namespace, name, status)
-
-
-def wait_for(pred, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.02)
-    return False
 
 
 def test_second_job_type_over_generic_runtime():

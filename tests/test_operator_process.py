@@ -185,6 +185,81 @@ class TestLeaderElection:
         assert "stopped" in events
 
 
+class TestHaFailover:
+    def test_standby_takes_over_and_reconciles(self):
+        """Two full operator instances (controller + elector) over one
+        cluster: only the leader reconciles; when it dies, the standby
+        acquires the lease after expiry and converges new work.  The
+        reference gets this path from client-go leaderelection +
+        OnStartedLeading -> tc.Run (server.go:146-171) but never tests
+        the actual handover; this does, end-to-end."""
+        from pytorch_operator_tpu.controller import PyTorchController
+        from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+        from pytorch_operator_tpu.runtime import JobControllerConfig
+
+        from testutil import job_condition, wait_for
+
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)
+        kubelet.start()
+        leads = []
+
+        def make_instance(name):
+            ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                                    registry=Registry())
+            stop = threading.Event()
+
+            def on_start():
+                leads.append(name)
+                ctl.run(threadiness=2, stop_event=stop)
+
+            el = LeaderElector(
+                cluster.resource("leases"), name,
+                lease_duration=0.6, renew_interval=0.15,
+                retry_interval=0.05, on_started_leading=on_start,
+                on_stopped_leading=stop.set)
+            return ctl, el, stop
+
+        ctl_a, el_a, stop_a = make_instance("op-a")
+        ctl_b, el_b, stop_b = make_instance("op-b")
+        try:
+            el_a.start(stop_a)
+            # wait on the callback's side effect, not is_leader — the
+            # elector sets is_leader before running the callback
+            assert wait_for(lambda: "op-a" in leads), "A never acquired"
+            el_b.start(stop_b)
+            time.sleep(0.3)
+            assert not el_b.is_leader, "standby acquired a held lease"
+
+            # leader reconciles work
+            cluster.jobs.create("default",
+                                new_job(workers=1, name="ha-1").to_dict())
+            assert wait_for(lambda: job_condition(
+                cluster, "default", "ha-1", "Succeeded")), \
+                "leader failed to reconcile"
+            assert leads == ["op-a"]
+
+            # leader dies (stops renewing AND stops its workers)
+            stop_a.set()
+            ctl_a.work_queue.shutdown()
+            assert wait_for(lambda: "op-b" in leads, timeout=15.0), \
+                "standby never took over after lease expiry"
+            assert leads == ["op-a", "op-b"]
+
+            # new work converges under the new leader
+            cluster.jobs.create("default",
+                                new_job(workers=1, name="ha-2").to_dict())
+            assert wait_for(lambda: job_condition(
+                cluster, "default", "ha-2", "Succeeded")), \
+                "new leader failed to reconcile"
+        finally:
+            stop_a.set()
+            stop_b.set()
+            ctl_a.work_queue.shutdown()
+            ctl_b.work_queue.shutdown()
+            kubelet.stop()
+
+
 class TestStructuredLogging:
     """VERDICT r1 missing 3 / logger.go:26-80 parity: operator log lines
     carry job/replica/pod fields in both JSON and text formats."""

@@ -73,3 +73,29 @@ def new_job(
         metadata=ObjectMeta(name=name, namespace=namespace, uid="test-uid-" + name),
         spec=PyTorchJobSpec(pytorch_replica_specs=specs),
     )
+
+
+def wait_for(predicate, timeout: float = 15.0, interval: float = 0.02) -> bool:
+    """Poll ``predicate`` until true or ``timeout`` elapses."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def job_condition(cluster, ns: str, name: str, cond_type: str) -> bool:
+    """True when the job has ``cond_type`` with status "True"."""
+    from pytorch_operator_tpu.k8s.errors import NotFoundError
+
+    try:
+        job = cluster.jobs.get(ns, name)
+    except NotFoundError:
+        return False
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c["type"] == cond_type and c["status"] == "True":
+            return True
+    return False
