@@ -95,7 +95,7 @@ class LeaderElector:
             "metadata": {"name": self.name, "namespace": self.namespace},
             "spec": {
                 "holderIdentity": self.identity,
-                "leaseDurationSeconds": int(self.lease_duration),
+                "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
                 "acquireTime": ts,
                 "renewTime": ts,
                 "leaseTransitions": 0,
@@ -143,7 +143,7 @@ class LeaderElector:
         taking_over = holder != self.identity
         lease["spec"] = {
             "holderIdentity": self.identity,
-            "leaseDurationSeconds": int(self.lease_duration),
+            "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
             "acquireTime": ts if taking_over else (spec.get("acquireTime") or ts),
             "renewTime": ts,
             "leaseTransitions": int(spec.get("leaseTransitions") or 0)

@@ -213,10 +213,13 @@ class TestHaFailover:
                 leads.append(name)
                 ctl.run(threadiness=2, stop_event=stop)
 
+            # integer lease (the wire field is whole seconds) with a
+            # 6x renew margin, so a multi-second GIL/CI stall can't
+            # flap leadership mid-test
             el = LeaderElector(
                 cluster.resource("leases"), name,
-                lease_duration=0.6, renew_interval=0.15,
-                retry_interval=0.05, on_started_leading=on_start,
+                lease_duration=3.0, renew_interval=0.5,
+                retry_interval=0.2, on_started_leading=on_start,
                 on_stopped_leading=stop.set)
             return ctl, el, stop
 
@@ -228,7 +231,7 @@ class TestHaFailover:
             # elector sets is_leader before running the callback
             assert wait_for(lambda: "op-a" in leads), "A never acquired"
             el_b.start(stop_b)
-            time.sleep(0.3)
+            time.sleep(0.8)  # several retry rounds against a held lease
             assert not el_b.is_leader, "standby acquired a held lease"
 
             # leader reconciles work
