@@ -188,13 +188,15 @@ def _attention(q, k, v, cfg: LlamaConfig):
     """
     B, T, H, Dh = q.shape
     groups = cfg.n_heads // cfg.n_kv_heads
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
     if cfg.use_flash:
         from pytorch_operator_tpu.ops import flash_attention
 
+        # GQA-native kernel: shared K/V streamed per group, never
+        # materialised at H heads (ops/flash_attention.py)
         return flash_attention(q, k, v, causal=True)
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
     scores = scores * (Dh ** -0.5)
     mask = jnp.tril(jnp.ones((T, T), bool))
