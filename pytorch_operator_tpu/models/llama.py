@@ -321,11 +321,13 @@ def forward_sp(
       impl="ring"     K/V rotation with online softmax
                       (parallel/ring_attention.py; any head count)
 
-    GQA KV heads are broadcast before the strategy, matching what
-    _attention does internally.  Params replicate (``sp_param_specs``) —
-    sequence parallelism shards activations, not weights.  Reference
-    scope: the reference scales only DP replica count (SURVEY §2.4);
-    long-context is a TPU-build extension (SURVEY §5).
+    GQA-native: the ring always rotates UNREPEATED K/V chunks (ICI
+    traffic / group), and ulysses shards the kv heads through its
+    all-to-all when n_kv_heads divides the sp axis; K/V is broadcast
+    only for ulysses when it doesn't.  Params replicate
+    (``sp_param_specs``) — sequence parallelism shards activations, not
+    weights.  Reference scope: the reference scales only DP replica
+    count (SURVEY §2.4); long-context is a TPU-build extension (§5).
     """
     from pytorch_operator_tpu.parallel.ring_attention import ring_attention
     from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
@@ -334,16 +336,19 @@ def forward_sp(
         raise ValueError(f"unknown sp impl {impl!r}")
 
     def attn(q, k, v, cfg):
-        groups = cfg.n_heads // cfg.n_kv_heads
-        if groups > 1:
-            k2 = jnp.repeat(k, groups, axis=2)
-            v2 = jnp.repeat(v, groups, axis=2)
-        else:
-            k2, v2 = k, v
+        # Both SP strategies are GQA-native: the ring rotates unrepeated
+        # K/V chunks (ICI traffic / group), and ulysses shards kv heads
+        # through the all-to-all when they divide the axis.  Only the
+        # ulysses-with-too-few-kv-heads case still broadcasts.
+        sp_deg = mesh.shape[axis_name]
+        if impl == "ulysses" and cfg.n_kv_heads % sp_deg:
+            groups = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
         if impl == "ulysses":
-            return ulysses_attention(q, k2, v2, mesh, axis_name=axis_name,
+            return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      use_flash=cfg.use_flash)
-        return ring_attention(q, k2, v2, mesh, axis_name=axis_name).astype(q.dtype)
+        return ring_attention(q, k, v, mesh, axis_name=axis_name).astype(q.dtype)
 
     def apply_stack(layers, h, body):
         # pin the (B, T, D) activations to the sequence-sharded layout;

@@ -29,8 +29,13 @@ def _chunk_attn(q, k, v, q_off, k_off, scale, causal):
     """Scores + masked row-stats for one (q-chunk, kv-chunk) pair.
 
     Returns (o_part, row_max, row_sum) with shapes
-    (B,H,Tq,Dh), (B,H,Tq), (B,H,Tq) — all f32.
+    (B,H,Tq,Dh), (B,H,Tq), (B,H,Tq) — all f32.  Grouped (GQA) K/V is
+    repeated locally here — the repeat never rides the ring.
     """
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
@@ -60,7 +65,10 @@ def _chunk_attn_flash(q, k, v, scale, causal, block, interpret):
     from pytorch_operator_tpu.ops.flash_attention import flash_with_lse
 
     B, Tq, H, Dh = q.shape
-    bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, Dh)  # noqa: E731
+
+    def bh(x):  # each tensor's own head count (k/v may be grouped)
+        return x.transpose(0, 2, 1, 3).reshape(B * x.shape[2], -1, Dh)
+
     out, lse = flash_with_lse(bh(q), bh(k), bh(v), scale, causal,
                               block, block, interpret)
     o = out.reshape(B, H, Tq, Dh).astype(jnp.float32)
@@ -143,8 +151,12 @@ def ring_attention(
 ) -> jax.Array:
     """Exact causal attention with sequence sharded over ``axis_name``.
 
-    q/k/v: global-view (B, T, H, Dh) arrays; T must divide evenly by the
-    mesh's ``axis_name`` size.  Returns (B, T, H, Dh).
+    q: global-view (B, T, H, Dh); T must divide evenly by the mesh's
+    ``axis_name`` size.  GQA-native: k/v may carry fewer heads (H_kv
+    dividing H) — the ring then rotates the UNREPEATED K/V chunks, so
+    ICI traffic drops by the group factor; the flash chunk kernel
+    streams grouped K/V directly and the dense fallback repeats only
+    device-locally.  Returns (B, T, H, Dh).
 
     Per-chunk compute routes through the Pallas flash kernel when the
     local chunk length tiles (ops.flash_attention._auto_block), dense
@@ -154,6 +166,13 @@ def ring_attention(
 
     Dh = q.shape[-1]
     T = q.shape[1]
+    H, Hk = q.shape[2], k.shape[2]
+    if v.shape[2] != Hk or H % Hk:
+        # must reject here: the flash chunk path's kv block index map
+        # would silently clamp out-of-bounds groups into garbage
+        raise ValueError(
+            f"kv heads must divide q heads: q has {H}, k/v have "
+            f"{k.shape[2]}/{v.shape[2]}")
     sp = mesh.shape[axis_name]
     t_local = T // sp
     block = _auto_block(t_local, Dh)

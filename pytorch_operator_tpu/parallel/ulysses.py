@@ -36,12 +36,18 @@ from jax.sharding import PartitionSpec as P
 def _local_attention(q, k, v, scale, causal, use_flash):
     """Plain full-sequence attention on the local head slice.
 
-    q/k/v: (B, T, Hl, Dh).  f32 accumulation, bf16-safe.
+    q (B, T, Hl, Dh); k/v may carry fewer (grouped) heads — the flash
+    path is GQA-native, the dense path repeats locally (the repeat then
+    exists only in the local einsum operand, never on the wire).
     """
     if use_flash:
         from pytorch_operator_tpu.ops import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     T = q.shape[1]
     s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
     if causal:
@@ -75,20 +81,32 @@ def ulysses_attention(
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
-    q/k/v: global-view (B, T, H, Dh); T and H must divide by the mesh's
-    ``axis_name`` size (broadcast GQA KV heads before calling, as with
-    ops.flash_attention).  Differentiable: reverse mode flows back
-    through the two all_to_alls.  Returns (B, T, H, Dh) sharded the same
-    way as the inputs.
+    q: global-view (B, T, H, Dh); T and H must divide by the mesh's
+    ``axis_name`` size.  GQA-native: k/v may carry H_kv < H heads as
+    long as H_kv also divides by the axis — the all-to-all's contiguous
+    head split preserves the query-group -> kv-head mapping on every
+    device (q heads [i·H/n, (i+1)·H/n) pair exactly with kv heads
+    [i·H_kv/n, (i+1)·H_kv/n)), so grouped K/V moves 1/group the bytes
+    over ICI.  Broadcast KV heads before calling only when H_kv does
+    not divide the axis.  Differentiable: reverse mode flows back
+    through the two all_to_alls.  Returns (B, T, H, Dh) sharded the
+    same way as the inputs.
     """
     n = mesh.shape[axis_name]
     B, T, H, Dh = q.shape
+    Hk = k.shape[2]
     if T % n:
         raise ValueError(f"seq len {T} not divisible by {axis_name}={n}")
     if H % n:
         raise ValueError(f"{H} heads not divisible by {axis_name}={n} "
                          f"(all-to-all SP shards heads; use ring_attention "
                          f"for head counts below the mesh axis)")
+    if H % Hk:
+        raise ValueError(f"kv heads ({Hk}) must divide q heads ({H})")
+    if Hk % n:
+        raise ValueError(f"{Hk} kv heads not divisible by {axis_name}={n} "
+                         f"(broadcast KV heads to a multiple of the axis, "
+                         f"or use ring_attention)")
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         partial(_ulysses_body, axis_name=axis_name, causal=causal,
