@@ -128,8 +128,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     for q row b is simply b // group (group = H // H_kv), so grouped
     queries stream each K/V block from HBM once per group instead of
     materialising repeated K/V (1/group the k/v read traffic).
-    Measured v5e (T4096 H16/kv4, bf16): 1.41x repeat-KV forward,
-    ~1.2x forward+backward.
+    Honest perf note (v5e, T4096 H16/kv4, two-point scan timing): the
+    kernel is MXU-bound at these shapes and K/V DMA fully overlaps, so
+    wall time is at PARITY with repeat-KV (~1.0x, BENCH_DETAIL §2b);
+    the wins are HBM capacity (no H-head K/V ever materialised) and
+    wire traffic where K/V actually moves (ring SP rotates 1/group the
+    bytes over ICI — parallel/ring_attention.py).
 
     lse is stored (BH, 1, T) — q positions in the *lane* dimension — so
     both the forward write and the backward reads use (1, 1, block_q)

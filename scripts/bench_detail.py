@@ -382,6 +382,81 @@ def bench_flash_vs_dense(smoke: bool) -> list[dict]:
     return rows
 
 
+def bench_gqa(smoke: bool) -> list[dict]:
+    """GQA-native flash vs the repeat-KV formulation.
+
+    The kernel streams shared K/V blocks via the b//group index map
+    (ops/flash_attention.py) instead of materialising K/V at H heads —
+    1/group the k/v HBM read traffic.  The baseline repeats K/V
+    explicitly and runs the same kernel (both are exact)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_operator_tpu.ops import flash_attention
+
+    if smoke:
+        shapes = [(256, 4, 2)]
+    else:
+        shapes = [(4096, 16, 4), (4096, 16, 8)]
+    B, D = 1, 128 if not smoke else 32
+    rows = []
+    for T, H, G in shapes:
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, T, H // G, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, T, H // G, D), jnp.bfloat16)
+
+        def native(qq, kk, vv):
+            return flash_attention(qq, kk, vv, causal=True)
+
+        def repeat(qq, kk, vv):
+            return flash_attention(qq, jnp.repeat(kk, G, axis=2),
+                                   jnp.repeat(vv, G, axis=2), causal=True)
+
+        def _normed(x):
+            xf = x.astype(jnp.float32)
+            return (xf * jax.lax.rsqrt(jnp.mean(xf * xf) + 1e-6)
+                    ).astype(x.dtype)
+
+        def fwd_body(fn):
+            return lambda qc: _normed(fn(qc, k, v))
+
+        def bwd_body(fn):
+            def loss(qq, kk, vv):
+                o = fn(qq, kk, vv).astype(jnp.float32)
+                return jnp.sum(o * o)
+
+            grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+            def body(qc):
+                dq, dk, dv = grad_fn(qc, k, v)
+                s = (jnp.sum(dk.astype(jnp.float32) ** 2)
+                     + jnp.sum(dv.astype(jnp.float32) ** 2))
+                return _normed(dq.astype(jnp.float32) + s).astype(qc.dtype)
+
+            return body
+
+        iters = 2 if smoke else 40
+        t_nf = _time_scanned(fwd_body(native), q, iters, repeats=3,
+                             calibrate=not smoke)
+        t_rf = _time_scanned(fwd_body(repeat), q, iters, repeats=3,
+                             calibrate=not smoke)
+        t_nb = _time_scanned(bwd_body(native), q, iters, repeats=3,
+                             calibrate=not smoke)
+        t_rb = _time_scanned(bwd_body(repeat), q, iters, repeats=3,
+                             calibrate=not smoke)
+        rows.append({
+            "shape": f"B{B} T{T} H{H}/kv{H // G} D{D} bf16 causal",
+            "fwd_native_ms": round(t_nf * 1e3, 3),
+            "fwd_repeat_ms": round(t_rf * 1e3, 3),
+            "fwd_speedup": round(t_rf / t_nf, 2),
+            "fwdbwd_native_ms": round(t_nb * 1e3, 3),
+            "fwdbwd_repeat_ms": round(t_rb * 1e3, 3),
+            "fwdbwd_speedup": round(t_rb / t_nb, 2),
+        })
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # 3. Fused RMSNorm vs XLA
 
@@ -507,7 +582,8 @@ def bench_long_context(smoke: bool) -> list[dict]:
 
 
 def render_md(mfu: dict, flash: list[dict], norm: list[dict],
-              longctx: list[dict], longseq: list[dict]) -> str:
+              longctx: list[dict], longseq: list[dict],
+              gqa: list[dict]) -> str:
     now = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC")
     lines = [
@@ -591,6 +667,30 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
         "path with block_q=0.  The flash win grows with T^2 alongside "
         "the O(T)-memory advantage.",
         "",
+        "### 2b. GQA-native streaming vs repeat-KV (same kernel)",
+        "",
+        "| shape | fwd native | fwd repeat | speedup | fwd+bwd native "
+        "| fwd+bwd repeat | speedup |",
+        "|---|---|---|---|---|---|---|",
+    ] + [
+        (f"| {r['shape']} | {r['fwd_native_ms']} ms | "
+         f"{r['fwd_repeat_ms']} ms | **{r['fwd_speedup']}x** | "
+         f"{r['fwdbwd_native_ms']} ms | {r['fwdbwd_repeat_ms']} ms | "
+         f"**{r['fwdbwd_speedup']}x** |")
+        for r in gqa
+    ] + [
+        "",
+        "Grouped-query K/V streams through the kernel's b//group block "
+        "index map (1/group the k/v HBM reads, no repeated K/V "
+        "materialised); dk/dv return at the kv head count.  Honest "
+        "reading of the ~1.0x wall times: the kernel is MXU-bound at "
+        "these shapes and K/V DMA overlaps compute entirely, so the "
+        "saved bandwidth does not show up as speed here — the wins are "
+        "HBM capacity (no H-head K/V ever exists) and wire traffic "
+        "where K/V actually moves: the ring rotates unrepeated chunks "
+        "(ICI bytes / group) and ulysses shards kv heads through its "
+        "all-to-all (parallel/).",
+        "",
         "## 3. Fused RMSNorm (Pallas) vs XLA",
         "",
         "| shape | fused | XLA | speedup |",
@@ -641,7 +741,8 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
         "",
         "```json",
         json.dumps({"mfu": mfu, "long_seq": longseq, "flash": flash,
-                    "rms_norm": norm, "long_context": longctx}, indent=2),
+                    "gqa": gqa, "rms_norm": norm,
+                    "long_context": longctx}, indent=2),
         "```",
         "",
     ]
@@ -691,6 +792,7 @@ SECTIONS = {
     "mfu": bench_llama_mfu,
     "long_seq": bench_llama_long_seq,
     "flash": bench_flash_vs_dense,
+    "gqa": bench_gqa,
     "rms_norm": bench_rms_norm,
     "long_context": bench_long_context,
 }
@@ -698,7 +800,8 @@ SECTIONS = {
 
 def _emit(results: dict, out: str | None) -> None:
     md = render_md(results["mfu"], results["flash"], results["rms_norm"],
-                   results["long_context"], results["long_seq"])
+                   results["long_context"], results["long_seq"],
+                   results["gqa"])
     if out:
         with open(out, "w") as f:
             f.write(md)
