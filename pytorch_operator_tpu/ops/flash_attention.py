@@ -31,9 +31,17 @@ lanes without any cross-lane reshape; the only sublane<->lane transpose
 in the whole pipeline is the (block_q, 1) -> (1, block_q) lse write at
 the end of the forward.
 
-Falls back to a dense jnp implementation for shapes that don't tile
-(seq not a multiple of the block size) or when Pallas is unavailable;
-``interpret=True`` runs the same kernels on CPU test meshes.
+Arbitrary sequence lengths: when T is not a multiple of the block
+size, inputs are zero-padded up to the next block multiple and the
+kernels mask padded key positions in-register (``kpos < seq_len`` →
+NEG_INF, same iota guard the causal mask uses); tiles that lie wholly
+in the padded region are skipped by the grid guards.  Padded *query*
+rows need no mask: their outputs are sliced away, and in the backward
+their cotangents are zero (g rows are zero ⇒ dp = 0 and delta = 0 ⇒
+ds = 0), so they contribute nothing to dk/dv.  Every T ≥ 1 therefore
+takes the Pallas path; ``_dense_reference`` remains only as a ground
+truth for tests.  ``interpret=True`` runs the same kernels on CPU test
+meshes.
 
 Reference parity note: the reference operator has no attention kernels
 at all (its data plane is examples/mnist/mnist.py); this module is part
@@ -44,6 +52,7 @@ torch ops.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -67,8 +76,48 @@ def _dense_reference(q, k, v, scale, causal):
 # --------------------------------------------------------------------------
 
 
+def _live_tile(i, j, block_q, block_k, causal, seq_len):
+    """Static-shape guard: does tile (q block i, k block j) contribute?
+
+    Skips blocks strictly above the causal diagonal and (for padded
+    tails) blocks whose q rows or k columns lie entirely past the true
+    sequence length.  Returns None when every tile is live.
+    """
+    live = None
+    if causal:
+        live = j * block_k <= i * block_q + block_q - 1
+    if seq_len is not None:
+        tail = (i * block_q < seq_len) & (j * block_k < seq_len)
+        live = tail if live is None else live & tail
+    return live
+
+
+def _score_mask(s, i, j, bq, bk, transposed, causal, seq_len):
+    """Apply causal and/or padded-tail masking to a score tile.
+
+    ``transposed`` selects the (block_k, block_q) layout the backward
+    kernels use (k in sublanes, q in lanes).  Padded key positions are
+    masked to NEG_INF; padded query rows are deliberately left alone
+    (see module docstring — their cotangents are zero).
+    """
+    if not causal and seq_len is None:
+        return s
+    shape = s.shape
+    q_dim, k_dim = (1, 0) if transposed else (0, 1)
+    kpos = j * bk + lax.broadcasted_iota(jnp.int32, shape, k_dim)
+    ok = None
+    if causal:
+        qpos = i * bq + lax.broadcasted_iota(jnp.int32, shape, q_dim)
+        ok = qpos >= kpos
+    if seq_len is not None:
+        valid = kpos < seq_len
+        ok = valid if ok is None else ok & valid
+    return jnp.where(ok, s, NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, block_q, block_k, scale, causal):
+                m_scr, l_scr, acc_scr, *, block_q, block_k, scale, causal,
+                seq_len):
     import jax.experimental.pallas as pl
 
     i = pl.program_id(1)
@@ -88,12 +137,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (block_q, block_k)
-        if causal:
-            qpos = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _score_mask(s, i, j, block_q, block_k, False, causal, seq_len)
         m_prev = m_scr[...]                           # (block_q, 1)
         l_prev = l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -105,11 +149,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
-        pl.when(j * block_k <= i * block_q + block_q - 1)(_compute)
-    else:
+    live = _live_tile(i, j, block_q, block_k, causal, seq_len)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -120,7 +164,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.transpose(lse)               # (1, block_q)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               seq_len=None):
     """Returns (out (BH,T,D), lse (BH,1,T) f32).
 
     GQA-native: k/v may carry fewer heads than q — (B*H_kv, T, D) with
@@ -149,7 +194,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     grid = (BH, T // block_q, T // block_k)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k,
-        scale=scale, causal=causal)
+        scale=scale, causal=causal, seq_len=seq_len)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -190,28 +235,31 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _transposed_probs(q_ref, k_ref, lse_ref, i, j, block_q, block_k, scale,
-                      causal):
+                      causal, seq_len):
     """Recompute p^T = exp(s^T - lse) for one (i, j) tile.
 
     Returns (block_k, block_q) f32 with q rows in *lanes* so the
     (1, block_q) lse/delta blocks broadcast without reshapes.
+
+    Padded-tail note: wholly-padded q blocks (lse = NEG_INF, where this
+    exp would blow up to +inf) NEVER reach this function — _live_tile's
+    tail guard skips their tiles, and that guard is what keeps the
+    backward NaN-free.  In a partially padded last q block every valid
+    row has finite lse, and the padded *lanes* there carry zero
+    cotangents (do = 0, delta = 0 ⇒ ds = 0), so dk/dv stay exact and
+    the garbage dq rows are sliced away by the caller.
     """
     q = q_ref[0]                                      # (block_q, d)
     k = k_ref[0]                                      # (block_k, d)
     s_t = lax.dot_general(
         k, q, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # (block_k, block_q)
-    if causal:
-        kpos = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 0)
-        qpos = i * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 1)
-        s_t = jnp.where(qpos >= kpos, s_t, NEG_INF)
+    s_t = _score_mask(s_t, i, j, block_q, block_k, True, causal, seq_len)
     return jnp.exp(s_t - lse_ref[0])                  # (block_k, block_q)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, block_q, block_k, scale, causal):
+                   dq_scr, *, block_q, block_k, scale, causal, seq_len):
     import jax.experimental.pallas as pl
 
     i = pl.program_id(1)
@@ -224,7 +272,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     def _compute():
         p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
-                                block_q, block_k, scale, causal)
+                                block_q, block_k, scale, causal, seq_len)
         v = v_ref[0]
         do = do_ref[0]
         dp_t = lax.dot_general(
@@ -236,10 +284,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds_t.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (block_q, d)
 
-    if causal:
-        pl.when(j * block_k <= i * block_q + block_q - 1)(_compute)
-    else:
+    live = _live_tile(i, j, block_q, block_k, causal, seq_len)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -247,12 +296,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dk_scr, dv_scr, i, j, block_q, block_k, scale, causal):
+                   dk_scr, dv_scr, i, j, block_q, block_k, scale, causal,
+                   seq_len):
     """Shared FA-2 tile math: accumulate dv/dk for one (i, j) tile and
     return ds^T for the caller (the fused kernel also needs it for dq).
     """
     p_t = _transposed_probs(q_ref, k_ref, lse_ref, i, j,
-                            block_q, block_k, scale, causal)
+                            block_q, block_k, scale, causal, seq_len)
     do = do_ref[0]                                    # (block_q, d)
     # dv[j] += p[i,j]^T @ dO[i]
     dv_scr[...] += lax.dot_general(
@@ -271,7 +321,7 @@ def _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, block_q, block_k, scale, causal):
+                    *, block_q, block_k, scale, causal, seq_len):
     import jax.experimental.pallas as pl
 
     j = pl.program_id(1)   # k block (outer)
@@ -286,12 +336,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_scr, dv_scr, i, j, block_q, block_k, scale,
-                       causal)
+                       causal, seq_len)
 
-    if causal:
-        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
-    else:
+    live = _live_tile(i, j, block_q, block_k, causal, seq_len)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(i == n_q - 1)
     def _finalize():
@@ -301,7 +352,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                      *, block_q, block_k, scale, causal):
+                      *, block_q, block_k, scale, causal, seq_len):
     """One-pass backward: dk/dv via scratch accumulation over i, dq via
     in-place accumulation into the whole-sequence f32 output block.
 
@@ -328,17 +379,18 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         ds_t = _dkv_tile_step(q_ref, k_ref, v_ref, do_ref, lse_ref,
                               delta_ref, dk_scr, dv_scr, i, j, block_q,
-                              block_k, scale, causal)
+                              block_k, scale, causal, seq_len)
         # dq[i] += ds[i,j] @ K[j]  ==  ds_t^T @ K  (contract sublanes)
         rows = pl.ds(i * block_q, block_q)
         dq_ref[0, rows, :] += lax.dot_general(
             ds_t.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (block_q, d)
 
-    if causal:
-        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
-    else:
+    live = _live_tile(i, j, block_q, block_k, causal, seq_len)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(i == n_q - 1)
     def _finalize():
@@ -346,11 +398,22 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-# The fused kernel keeps a (T, D) f32 dq buffer plus three
-# (block, block) f32 score tiles in VMEM; past this many bytes of dq
-# the dispatcher falls back to the two-kernel path (whose VMEM use is
-# O(block^2) only), which covers arbitrarily long sequences.
+# The fused kernel keeps a (T, D) f32 dq buffer plus score-shaped
+# (block_k, block_q) f32 tiles in VMEM; past this many bytes of dq the
+# dispatcher falls back to the two-kernel path (whose VMEM use is
+# O(block^2) only), which covers arbitrarily long sequences.  The dq
+# gate alone ignores the block-dependent tile term, so the fused path
+# is additionally clamped to tiles no larger than the measured-working
+# _auto_block maximum (1024x1024, benched at T=8192/D=128) — explicit
+# larger blocks take the two-kernel path instead of risking VMEM
+# exhaustion near the dq boundary.
 _FUSED_DQ_VMEM_BYTES = 4 * 1024 * 1024
+_FUSED_MAX_TILE = 1024 * 1024
+
+
+def _use_fused_bwd(T, D, block_q, block_k):
+    return (T * D * 4 <= _FUSED_DQ_VMEM_BYTES
+            and block_q * block_k <= _FUSED_MAX_TILE)
 
 
 def _reduce_kv_partials(partials, group, out_dtype):
@@ -370,7 +433,7 @@ def _reduce_kv_partials(partials, group, out_dtype):
 
 
 def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
-                     block_q, block_k, interpret):
+                     block_q, block_k, interpret, seq_len=None):
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
@@ -386,7 +449,8 @@ def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
                              memory_space=pltpu.VMEM)
     dq32, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q,
-                          block_k=block_k, scale=scale, causal=causal),
+                          block_k=block_k, scale=scale, causal=causal,
+                          seq_len=seq_len),
         grid=(BH, n_k, n_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[
@@ -416,7 +480,7 @@ def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-               interpret, g_lse=None):
+               interpret, g_lse=None, seq_len=None):
     """dq/dk/dv for upstream cotangents on out (``g``) and, optionally,
     on lse (``g_lse``, (BH, 1, T) f32).
 
@@ -433,9 +497,9 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                     axis=-1)[:, None, :]              # (BH, 1, T) f32
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
-    if T * D * 4 <= _FUSED_DQ_VMEM_BYTES:
+    if _use_fused_bwd(T, D, block_q, block_k):
         return _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
-                                block_q, block_k, interpret)
+                                block_q, block_k, interpret, seq_len)
     group = BH // k.shape[0]
     n_q, n_k = T // block_q, T // block_k
 
@@ -449,7 +513,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+                          scale=scale, causal=causal, seq_len=seq_len),
         grid=(BH, n_q, n_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
@@ -471,7 +535,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                              memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+                          scale=scale, causal=causal, seq_len=seq_len),
         grid=(BH, n_k, n_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[
@@ -496,56 +560,71 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             _reduce_kv_partials(dv, group, v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret,
+           seq_len=None):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        seq_len)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                   seq_len=None):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, seq_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, seq_len,
+                   res, g):
     q, k, v, out, lse = res
     return _flash_bwd(q, k, v, out, lse, g, scale, causal,
-                      block_q, block_k, interpret)
+                      block_q, block_k, interpret, seq_len=seq_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_with_lse(q, k, v, scale, causal, block_q, block_k, interpret,
+                   seq_len=None):
     """Differentiable (out, lse) pair over (BH, T, D) inputs.
 
     For consumers that combine partial attention results across chunks
     (ring attention's online-softmax merge): both outputs carry
     cotangents, and the backward routes the lse cotangent through the
-    delta shift in _flash_bwd.
+    delta shift in _flash_bwd.  ``seq_len`` (static) enables the padded
+    -tail mask when the caller padded T up to a block multiple.
     """
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      seq_len)
 
 
-def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                       seq_len=None):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
+                          interpret, seq_len)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, res, gs):
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, seq_len,
+                       res, gs):
     q, k, v, out, lse = res
     g_out, g_lse = gs
     return _flash_bwd(q, k, v, out, lse, g_out, scale, causal,
-                      block_q, block_k, interpret, g_lse=g_lse)
+                      block_q, block_k, interpret, g_lse=g_lse,
+                      seq_len=seq_len)
 
 
 flash_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
-def _auto_block(T: int, D: int) -> int | None:
-    """Largest block size that tiles T, capped by VMEM pressure.
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _auto_block(T: int, D: int) -> int:
+    """Preferred block size for sequence length T (any T >= 1).
 
     Measured on TPU v5e (H16/D128, fwd+bwd with the fused backward,
     scan-chained timing): 1024-blocks are 3-4x faster than the naive
@@ -556,6 +635,10 @@ def _auto_block(T: int, D: int) -> int | None:
     per-cell size.  The cap drops to 512 for D > 128 because the
     backward's three (block_k, block_q) f32 score tiles plus the
     operand tiles approach the ~16MB VMEM at 1024.
+
+    When T is not a block multiple the caller pads the tail (masked
+    in-kernel); a short non-multiple T rounds up to a single
+    lane-aligned tile so the pad waste stays below one 128-lane row.
     """
     cap = 1024 if D <= 128 else 512
     if T <= 1024:
@@ -563,7 +646,20 @@ def _auto_block(T: int, D: int) -> int | None:
     for b in (cap, 512, 256, 128):
         if b <= T and T % b == 0:
             return b
-    return None
+    if T < cap:
+        return _round_up(T, 128)
+    return cap
+
+
+def _exact_block(T: int, D: int) -> int | None:
+    """Largest preferred block that tiles T exactly, or None.
+
+    For callers that cannot pad-and-slice (ring attention's per-device
+    chunks, where padding would corrupt the cross-chunk online-softmax
+    merge): None means "use a dense chunk path"; flash_attention itself
+    never needs this — it pads the tail instead."""
+    b = _auto_block(T, D)
+    return b if T >= b and T % b == 0 else None
 
 
 def flash_attention(
@@ -576,14 +672,17 @@ def flash_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Causal attention over (B, T, H, D) queries.
+    """Causal attention over (B, T, H, D) queries — any T >= 1.
 
     GQA-native: k/v may carry H_kv <= H heads (H % H_kv == 0) — the
     kernels stream the shared K/V blocks directly (no repeated K/V is
-    ever materialised; dk/dv come back at H_kv heads).  Dispatches to
-    the Pallas kernels when the sequence tiles evenly, dense XLA
-    otherwise.  Block sizes default to the measured-fastest tiling for
-    the shape (see _auto_block)."""
+    ever materialised; dk/dv come back at H_kv heads).  Every length
+    takes the Pallas path: when T is not a block multiple the inputs
+    are zero-padded to the next multiple and the kernels mask the
+    padded key positions (see module docstring), so long-context
+    training works at arbitrary T, not just block multiples.  Block
+    sizes default to the measured-fastest tiling for the shape (see
+    _auto_block)."""
     B, T, H, D = q.shape
     Hk = k.shape[2]
     if v.shape[2] != Hk or H % Hk:
@@ -594,23 +693,24 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None:
-        block_q = _auto_block(T, D) or 0
+        block_q = _auto_block(T, D)
     if block_k is None:
-        block_k = _auto_block(T, D) or 0
+        block_k = _auto_block(T, D)
+    T_pad = _round_up(T, math.lcm(block_q, block_k))
 
     def to_bh(x):
         h = x.shape[2]
-        return x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
+        bh = x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
+        if T_pad != T:
+            bh = jnp.pad(bh, ((0, 0), (0, T_pad - T), (0, 0)))
+        return bh
 
     def from_bh(x):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
-    if not block_q or not block_k or T % block_q or T % block_k:
-        if Hk != H:  # dense fallback needs materialised heads
-            k = jnp.repeat(k, H // Hk, axis=2)
-            v = jnp.repeat(v, H // Hk, axis=2)
-        return from_bh(_dense_reference(to_bh(q), to_bh(k), to_bh(v),
-                                        scale, causal))
     out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret,
+                 T if T_pad != T else None)
+    if T_pad != T:
+        out = out[:, :T]
     return from_bh(out)

@@ -159,10 +159,10 @@ def ring_attention(
     device-locally.  Returns (B, T, H, Dh).
 
     Per-chunk compute routes through the Pallas flash kernel when the
-    local chunk length tiles (ops.flash_attention._auto_block), dense
+    local chunk length tiles (ops.flash_attention._exact_block), dense
     XLA otherwise; fully-masked chunks are skipped either way.
     """
-    from pytorch_operator_tpu.ops.flash_attention import _auto_block
+    from pytorch_operator_tpu.ops.flash_attention import _exact_block
 
     Dh = q.shape[-1]
     T = q.shape[1]
@@ -175,7 +175,7 @@ def ring_attention(
             f"{k.shape[2]}/{v.shape[2]}")
     sp = mesh.shape[axis_name]
     t_local = T // sp
-    block = _auto_block(t_local, Dh)
+    block = _exact_block(t_local, Dh)
     interpret = jax.default_backend() != "tpu"
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
