@@ -43,9 +43,17 @@ struct Conn {
   int fd = -1;
   void* tls = nullptr;  // SSL* (owned) when non-null
 
+  // Returns bytes read, or one of the kTlsRecv* codes (tls_internal.h):
+  // 0 clean EOF, -1 error, -2 ragged EOF (TLS only — plain TCP cannot
+  // tell a FIN from truncation), -3 timeout.
   ssize_t read_some(char* buf, size_t len) {
     if (tls != nullptr) return tpuop::tls_recv(tls, buf, len);
-    return recv(fd, buf, len, 0);
+    ssize_t n = recv(fd, buf, len, 0);
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                  errno == EINTR)) {
+      return tpuop::kTlsRecvTimeout;  // SO_RCVTIMEO expiry, retryable
+    }
+    return n;
   }
 
   bool write_all(const char* data, size_t len) {
@@ -302,6 +310,12 @@ bool read_body(Conn& conn, Response* resp, const std::string& leftover) {
   }
   for (;;) {  // Connection: close framing — read to EOF
     ssize_t n = conn.read_some(tmp, sizeof tmp);
+    // Only a CLEAN EOF (close_notify under TLS) ends this framing
+    // successfully: a ragged EOF (kTlsRecvRaggedEof) here is
+    // indistinguishable from a mid-body truncation by an on-path
+    // attacker, so it fails the request rather than silently
+    // forfeiting TLS truncation protection.  Length-checked framings
+    // above detect truncation on their own.
     if (n < 0) return false;
     if (n == 0) return true;
     resp->body.append(tmp, static_cast<size_t>(n));
@@ -515,6 +529,24 @@ char* ws_next(void* w, double timeout, int* len_out, int* state) {
       return nullptr;
     }
     ssize_t n = ws->conn.read_some(tmp, sizeof tmp);
+    if (n == tpuop::kTlsRecvTimeout) {
+      // SSL_read can block past a positive poll when only a partial
+      // TLS record arrived; that is a timeout, not a dead stream —
+      // the caller's watch loop retries instead of relisting
+      *state = WS_TIMEOUT;
+      return nullptr;
+    }
+    if (n == tpuop::kTlsRecvRaggedEof) {
+      // FIN without close_notify: a chunked stream that never saw its
+      // terminal chunk was truncated — relist (GAP semantics) rather
+      // than risk resuming past half-delivered events
+      if (ws->chunked && !ws->dec.done) {
+        *state = WS_ERROR;
+        return nullptr;
+      }
+      ws->eof = true;
+      continue;
+    }
     if (n < 0) {
       *state = WS_ERROR;
       return nullptr;

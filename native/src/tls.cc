@@ -33,26 +33,29 @@ constexpr int kSslVerifyPeer = 1;            // SSL_VERIFY_PEER
 constexpr int kSslFiletypePem = 1;           // SSL_FILETYPE_PEM
 constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
 constexpr long kTlsextNametypeHostName = 0;  // TLSEXT_NAMETYPE_host_name
-constexpr int kSslErrorZeroReturn = 6;       // SSL_ERROR_ZERO_RETURN
+constexpr int kSslErrorWantRead = 2;         // SSL_ERROR_WANT_READ
+constexpr int kSslErrorWantWrite = 3;        // SSL_ERROR_WANT_WRITE
 constexpr int kSslErrorSyscall = 5;          // SSL_ERROR_SYSCALL
-// OpenSSL 3 reports a TCP close without close_notify as a hard error
-// (SSL_R_UNEXPECTED_EOF_WHILE_READING) unless this option is set; with
-// it, ragged EOF surfaces as SSL_ERROR_ZERO_RETURN like 1.1 semantics.
-// kube-apiserver and most proxies close exactly this way, and the HTTP
-// framing layer above still validates body completeness.
-constexpr unsigned long long kSslOpIgnoreUnexpectedEof = 1ULL << 7;
+constexpr int kSslErrorZeroReturn = 6;       // SSL_ERROR_ZERO_RETURN
+constexpr int kSslErrorSsl = 1;              // SSL_ERROR_SSL
+// OpenSSL 3 reports a TCP close without close_notify as SSL_ERROR_SSL
+// with this reason code (SSL_R_UNEXPECTED_EOF_WHILE_READING).  The
+// option to suppress it (SSL_OP_IGNORE_UNEXPECTED_EOF) is deliberately
+// NOT set: tls_recv classifies ragged EOF distinctly so the HTTP layer
+// can reject truncated read-to-EOF bodies (kTlsRecvRaggedEof) instead
+// of silently forfeiting TLS truncation protection.  1.1 reports the
+// same condition as SSL_ERROR_SYSCALL with errno == 0.
+constexpr int kSslReasonUnexpectedEof = 294;
+constexpr unsigned long kSslReasonMask3 = 0x7FFFFF;  // ERR_GET_REASON, 3.x
 
 struct Api {
   void* ssl_handle = nullptr;
   void* crypto_handle = nullptr;
+  bool v3 = false;  // libssl.so.3 (reason-code layout differs from 1.1)
 
   const void* (*TLS_client_method)(void) = nullptr;
   void* (*SSL_CTX_new)(const void*) = nullptr;
   void (*SSL_CTX_free)(void*) = nullptr;
-  // uint64_t in 3.x, unsigned long in 1.1 — identical on LP64; may be
-  // absent on exotic builds, so it is resolved optionally.
-  unsigned long long (*SSL_CTX_set_options)(void*,
-                                            unsigned long long) = nullptr;
   void (*SSL_CTX_set_verify)(void*, int, void*) = nullptr;
   int (*SSL_CTX_load_verify_locations)(void*, const char*,
                                        const char*) = nullptr;
@@ -101,7 +104,10 @@ const Api* load_api() {
       api.ssl_handle = dlopen(pair.first, RTLD_NOW | RTLD_LOCAL);
       if (api.ssl_handle == nullptr) continue;
       api.crypto_handle = dlopen(pair.second, RTLD_NOW | RTLD_LOCAL);
-      if (api.crypto_handle != nullptr) break;
+      if (api.crypto_handle != nullptr) {
+        api.v3 = std::strstr(pair.first, ".so.3") != nullptr;
+        break;
+      }
       dlclose(api.ssl_handle);
       api.ssl_handle = nullptr;
     }
@@ -110,7 +116,6 @@ const Api* load_api() {
     }
     void* s = api.ssl_handle;
     void* c = api.crypto_handle;
-    resolve(s, "SSL_CTX_set_options", &api.SSL_CTX_set_options);  // optional
     return resolve(s, "TLS_client_method", &api.TLS_client_method) &&
            resolve(s, "SSL_CTX_new", &api.SSL_CTX_new) &&
            resolve(s, "SSL_CTX_free", &api.SSL_CTX_free) &&
@@ -184,9 +189,6 @@ TlsConfig* tls_ctx_create(const char* ca_file, const char* cert_file,
   if (ctx == nullptr) {
     *err = openssl_error(api, "SSL_CTX_new");
     return nullptr;
-  }
-  if (api->SSL_CTX_set_options != nullptr) {
-    api->SSL_CTX_set_options(ctx, kSslOpIgnoreUnexpectedEof);
   }
   if (insecure != 0) {
     api->SSL_CTX_set_verify(ctx, kSslVerifyNone, nullptr);
@@ -297,19 +299,34 @@ void tls_conn_close(void* conn) {
 
 long tls_recv(void* conn, char* buf, unsigned long len) {
   const Api* api = load_api();
-  if (api == nullptr) return -1;
+  if (api == nullptr) return kTlsRecvError;
   errno = 0;  // distinguish real syscall errors from stale errno
   int n = api->SSL_read(conn, buf, static_cast<int>(len));
   if (n > 0) return n;
   int e = api->SSL_get_error(conn, n);
-  // Clean EOF: close_notify, or (with SSL_OP_IGNORE_UNEXPECTED_EOF set
-  // on 3.x / natively on 1.1) a TCP close without close_notify —
-  // kube-apiserver and most proxies close that way; Python's ssl also
-  // suppresses ragged EOF, and HTTP framing above validates the body.
-  if (e == kSslErrorZeroReturn) return 0;
-  if (e == kSslErrorSyscall && errno == 0) return 0;  // 1.1 ragged EOF
+  if (e == kSslErrorZeroReturn) return kTlsRecvCleanEof;  // close_notify
+  if (e == kSslErrorWantRead || e == kSslErrorWantWrite) {
+    return kTlsRecvTimeout;
+  }
+  if (e == kSslErrorSyscall) {
+    if (errno == 0) return kTlsRecvRaggedEof;  // 1.1 FIN w/o close_notify
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+      // SO_RCVTIMEO expired inside SSL_read (partial TLS record after a
+      // positive poll) — a retryable timeout, not a dead stream
+      return kTlsRecvTimeout;
+    }
+    return kTlsRecvError;
+  }
+  if (e == kSslErrorSsl && api->v3) {
+    unsigned long code = api->ERR_get_error();
+    api->ERR_clear_error();
+    if ((code & kSslReasonMask3) == kSslReasonUnexpectedEof) {
+      return kTlsRecvRaggedEof;  // 3.x FIN without close_notify
+    }
+    return kTlsRecvError;
+  }
   api->ERR_clear_error();
-  return -1;
+  return kTlsRecvError;
 }
 
 bool tls_send_all(void* conn, const char* data, unsigned long len) {

@@ -42,8 +42,26 @@ void* tls_conn_open(TlsConfig* cfg, int fd, const char* server_name,
                     std::string* err);
 void tls_conn_close(void* conn);
 
-// recv(2)-shaped: >0 bytes read, 0 clean EOF (close_notify or silent
-// TCP close at a record boundary), -1 error/timeout.
+// tls_recv return convention (richer than recv(2) so the framing layer
+// can act on HOW a stream ended — see ADVICE round-3 items on ragged
+// EOF and watch timeouts):
+//   >0  bytes read
+//    0  clean EOF: the peer sent close_notify
+//   -1  hard error
+//   -2  ragged EOF: TCP FIN with no close_notify.  Indistinguishable
+//       from truncation by an on-path attacker, so the read-to-EOF
+//       framing in read_body treats it as an error; length-checked
+//       framings (Content-Length, chunked) already detect truncation
+//       themselves and treat it like EOF.
+//   -3  timeout: SO_RCVTIMEO expired inside SSL_read (a partial TLS
+//       record can arrive after poll(2) reported readable), or
+//       WANT_READ/WANT_WRITE.  ws_next maps this to WS_TIMEOUT so a
+//       slow network doesn't tear down a healthy watch stream.
+constexpr long kTlsRecvCleanEof = 0;
+constexpr long kTlsRecvError = -1;
+constexpr long kTlsRecvRaggedEof = -2;
+constexpr long kTlsRecvTimeout = -3;
+
 long tls_recv(void* conn, char* buf, unsigned long len);
 
 // Write everything; false on error/timeout.
