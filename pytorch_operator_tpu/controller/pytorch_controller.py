@@ -54,6 +54,9 @@ class PyTorchController(
         # informer follows suit so tests stay deterministic.
         factory_resync = self.config.resync_period_seconds
         job_resync = min(30.0, factory_resync) if factory_resync > 0 else 0.0
+        # key -> UID of the incarnation whose sync last ran; lets sync_job
+        # detect expectations raised by a dead incarnation (see sync_job)
+        self._synced_uid: dict = {}
         self.job_informer = Informer(cluster.jobs, resync_period=job_resync)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
@@ -110,6 +113,15 @@ class PyTorchController(
         # the recreate's ADDED (and any sync that can see it in the
         # cache) strictly follows this callback.  Surfaced by the churn
         # scenario (pytorch_operator_tpu/k8s/churn.py).
+        #
+        # Residual race (informer thread vs sync workers): a worker
+        # already mid-reconcile of the OLD incarnation can call
+        # expect_creations after this clear, re-raising a stale
+        # expectation.  That case is closed at sync time — the next sync
+        # of the key compares the cached object's UID against the one
+        # whose sync raised the expectations (_synced_uid) and clears
+        # again on mismatch; the workqueue's one-worker-per-key rule
+        # makes that check race-free.
         meta = obj.get("metadata") or {}
         key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
         for rtype in constants.VALID_REPLICA_TYPES:
@@ -180,6 +192,7 @@ class PyTorchController(
             logger_for_key(self.logger, key).info(
                 "PyTorchJob has been deleted: %s", key)
             self.jobs_deleted_counter.inc()
+            self._synced_uid.pop(key, None)
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
@@ -195,6 +208,20 @@ class PyTorchController(
             return True, None
 
         set_defaults(job)
+        # Delete-recreate UID fence: expectations raised by a worker
+        # that was still reconciling the old incarnation when
+        # _job_deleted's clear ran would gate the new incarnation until
+        # the TTL.  The workqueue processes a key on one worker at a
+        # time, so by the time this sync observes the NEW UID in the
+        # cache, the old incarnation's reconcile (and any expectation it
+        # could raise) has finished — clearing here is authoritative.
+        uid = job.metadata.uid or ""
+        prev_uid = self._synced_uid.get(key)
+        if prev_uid is not None and prev_uid != uid:
+            for rtype in constants.VALID_REPLICA_TYPES:
+                self.expectations.delete_expectations(expectation_pods_key(key, rtype))
+                self.expectations.delete_expectations(expectation_services_key(key, rtype))
+        self._synced_uid[key] = uid
         job_needs_sync = self.satisfied_expectations(job)
 
         err = None

@@ -542,6 +542,35 @@ def test_job_delete_event_clears_expectations():
             expectation_services_key(KEY, rtype)), rtype
 
 
+def test_uid_fence_clears_stale_expectations_from_old_incarnation():
+    """Residual worker-thread race (ADVICE round 3): a worker still
+    mid-reconcile of the OLD incarnation can raise expectations AFTER
+    _job_deleted's clear ran.  The sync-time UID fence must clear them
+    when the next sync observes the recreated object's new UID, instead
+    of parking the new job until the 5-minute TTL."""
+    from pytorch_operator_tpu.runtime.expectations import (
+        expectation_pods_key,
+    )
+
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=1)
+    job.metadata.uid = "uid-old"
+    data = inject_job(ctl, job)
+    ctl.sync_job(KEY)  # old incarnation's sync raises expectations
+    ctl._job_deleted(data)
+    # worker mid-reconcile of the old object re-raises after the clear
+    ctl.expectations.expect_creations(expectation_pods_key(KEY, "master"), 1)
+    assert not ctl.expectations.satisfied(expectation_pods_key(KEY, "master"))
+    # recreate under the same key with a new UID; next sync must reconcile
+    ctl.job_informer.store.delete(data)
+    job2 = new_job(workers=1)
+    job2.metadata.uid = "uid-new"
+    inject_job(ctl, job2)
+    ctl.pod_control.templates.clear()
+    ctl.sync_job(KEY)
+    assert len(ctl.pod_control.templates) == 2  # gate opened, pods created
+
+
 def test_expectations_gate_resync():
     ctl, cluster, _ = make_controller()
     job = new_job(workers=1)
