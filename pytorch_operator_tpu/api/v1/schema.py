@@ -1,0 +1,105 @@
+"""openAPIV3Schema generation from the dataclass API types.
+
+The reference keeps its CRD schema and Go types in sync mechanically:
+``hack/update-codegen.sh:63-74`` regenerates the typed machinery and
+``hack/verify-codegen.sh`` (gating CI via ``.travis.yml:13-25``) fails
+the build when generated output drifts from the source types.  This
+repo replaced generated code with hand-written dataclasses
+(``api/v1/types.py``) and a hand-written ``manifests/crd.yaml`` — which
+re-opens exactly the drift class codegen existed to prevent.
+
+This module closes it: ``generate`` walks a dataclass into the
+openAPIV3Schema that describes its wire format (reusing the same
+snake_case -> camelCase field-name rules the serde layer applies), and
+``tests/test_schema_drift.py`` asserts ``manifests/crd.yaml`` agrees —
+mutating either side without the other fails the suite, the in-process
+equivalent of ``verify-codegen.sh``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, get_args, get_origin
+
+from ...k8s import serde
+
+_SCALARS = {
+    bool: "boolean",   # before int: bool is an int subclass in Python
+    int: "integer",
+    float: "number",
+    str: "string",
+}
+
+
+def generate(cls: type) -> dict:
+    """openAPIV3Schema for ``cls``'s JSON wire format.
+
+    Nested dataclasses recurse into ``properties``; ``Dict[str, X]``
+    becomes an object with ``additionalProperties`` (the CRD may pin
+    specific keys — e.g. Master/Worker — whose schemas must then match
+    the value type's schema); ``List[X]`` becomes an array.  Types with
+    no static wire schema (plain dict payloads like PodTemplateSpec
+    fields) map to a bare object.
+    """
+    return _walk(cls)
+
+
+def _walk(tp: Any) -> dict:
+    tp = serde._unwrap_optional(tp)
+    scalar = _SCALARS.get(tp)
+    if scalar is not None:
+        return {"type": scalar}
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        args = get_args(tp)
+        item = _walk(args[0]) if args else {"type": "object"}
+        return {"type": "array", "items": item}
+    if origin is dict:
+        args = get_args(tp)
+        value = _walk(args[1]) if len(args) == 2 else {"type": "object"}
+        return {"type": "object", "additionalProperties": value}
+    if dataclasses.is_dataclass(tp):
+        props = {}
+        hints = serde._hints(tp)
+        for f in dataclasses.fields(tp):
+            props[serde._wire_name(f)] = _walk(hints[f.name])
+        return {"type": "object", "properties": props}
+    # Anything else (untyped payloads) is an opaque object on the wire.
+    return {"type": "object"}
+
+
+def assert_subschema(declared: dict, generated: dict, path: str = "") -> None:
+    """Assert a CRD-declared schema node agrees with the generated one.
+
+    Agreement rules (drift in either direction raises AssertionError):
+      * a declared ``type`` must equal the generated type;
+      * every declared property must exist in the generated schema
+        (catches properties invented or renamed only in the YAML);
+      * declared properties under a generated ``additionalProperties``
+        map (e.g. Master/Worker) are each checked against the value
+        schema.
+    Extra *generated* properties are reported by the caller, which
+    compares the full property sets at each object level — this helper
+    checks the declared side so partially-specified CRD nodes (ones
+    leaning on x-kubernetes-preserve-unknown-fields) stay legal.
+    """
+    dtype = declared.get("type")
+    gtype = generated.get("type")
+    if dtype is not None and gtype is not None:
+        assert dtype == gtype, (
+            f"{path or '<root>'}: crd.yaml declares type {dtype!r} but the "
+            f"dataclass wire format is {gtype!r}")
+    gen_props = generated.get("properties")
+    add_props = generated.get("additionalProperties")
+    for name, sub in (declared.get("properties") or {}).items():
+        sub_path = f"{path}.{name}" if path else name
+        if gen_props is not None:
+            assert name in gen_props, (
+                f"{sub_path}: declared in crd.yaml but api/v1/types.py has "
+                f"no such field (stale schema or missing dataclass field)")
+            assert_subschema(sub, gen_props[name], sub_path)
+        elif add_props is not None:
+            assert_subschema(sub, add_props, sub_path)
+    if "items" in declared and "items" in generated:
+        assert_subschema(declared["items"], generated["items"],
+                         f"{path}[]")

@@ -1,0 +1,88 @@
+"""CRD schema <-> dataclass drift gate (verify-codegen.sh equivalent).
+
+The reference gates CI on hack/verify-codegen.sh (.travis.yml:13-25) so
+its CRD machinery can't drift from the Go types.  Here the two
+hand-maintained sides are manifests/crd.yaml's openAPIV3Schema and
+api/v1/types.py; api/v1/schema.py generates the schema from the
+dataclasses and these tests assert the YAML agrees.  Mutating either
+side alone fails the suite:
+  * add/rename a PyTorchJobSpec field  -> missing-property assertion
+  * add/retype a crd.yaml property     -> assert_subschema failure
+"""
+
+import pathlib
+
+import pytest
+import yaml
+
+from pytorch_operator_tpu.api.v1 import constants, schema, types
+
+CRD_PATH = pathlib.Path(__file__).resolve().parent.parent / "manifests" / "crd.yaml"
+
+
+@pytest.fixture(scope="module")
+def crd_spec_schema():
+    crd = yaml.safe_load(CRD_PATH.read_text())
+    versions = crd["spec"]["versions"]
+    assert len(versions) == 1 and versions[0]["name"] == "v1"
+    root = versions[0]["schema"]["openAPIV3Schema"]
+    return root["properties"]["spec"]
+
+
+class TestSchemaDrift:
+    def test_declared_spec_agrees_with_dataclasses(self, crd_spec_schema):
+        generated = schema.generate(types.PyTorchJobSpec)
+        schema.assert_subschema(crd_spec_schema, generated)
+
+    def test_every_spec_field_is_declared(self, crd_spec_schema):
+        # superset direction: a new dataclass field must be added to the
+        # CRD validation schema too (or consciously listed here)
+        generated = schema.generate(types.PyTorchJobSpec)
+        declared = set(crd_spec_schema["properties"])
+        # schedulingPolicy is applied by the controller (PodGroup
+        # minMember), not validated at admission — the reference's
+        # v1beta1 CRD leaves it unvalidated the same way.
+        undeclared_ok = {"schedulingPolicy"}
+        missing = set(generated["properties"]) - declared - undeclared_ok
+        assert not missing, (
+            f"PyTorchJobSpec fields missing from manifests/crd.yaml "
+            f"openAPIV3Schema: {sorted(missing)}")
+
+    def test_replica_spec_keys_match_value_type(self, crd_spec_schema):
+        # Master/Worker subtrees in the CRD must describe ReplicaSpec's
+        # wire format (the generated map's additionalProperties schema)
+        generated = schema.generate(types.PyTorchJobSpec)
+        value_schema = (
+            generated["properties"]["pytorchReplicaSpecs"]
+            ["additionalProperties"])
+        declared = crd_spec_schema["properties"]["pytorchReplicaSpecs"]
+        keys = set(declared["properties"])
+        assert keys == {constants.REPLICA_TYPE_MASTER,
+                        constants.REPLICA_TYPE_WORKER}
+        for key, sub in declared["properties"].items():
+            schema.assert_subschema(sub, value_schema, path=key)
+
+    def test_schema_encodes_validation_contract(self, crd_spec_schema):
+        # exactly-one-Master (validation.py mirror of validation.go:23-77)
+        master = (crd_spec_schema["properties"]["pytorchReplicaSpecs"]
+                  ["properties"][constants.REPLICA_TYPE_MASTER]
+                  ["properties"]["replicas"])
+        assert master.get("minimum") == 1 and master.get("maximum") == 1
+        # CleanPodPolicy enum must match the constants the controller
+        # accepts (api/v1/constants.py:41-44)
+        enum = set(crd_spec_schema["properties"]["cleanPodPolicy"]["enum"])
+        assert enum == {constants.CLEAN_POD_POLICY_ALL,
+                        constants.CLEAN_POD_POLICY_RUNNING,
+                        constants.CLEAN_POD_POLICY_NONE}
+
+    def test_mutating_generated_side_fails(self):
+        # the gate actually bites: a retyped field trips assert_subschema
+        generated = schema.generate(types.PyTorchJobSpec)
+        broken = {"type": "object",
+                  "properties": {"backoffLimit": {"type": "string"}}}
+        with pytest.raises(AssertionError):
+            schema.assert_subschema(broken, generated)
+        unknown = {"type": "object",
+                   "properties": {"notAField": {"type": "integer"}}}
+        with pytest.raises(AssertionError):
+            schema.assert_subschema(unknown, generated)
