@@ -52,7 +52,13 @@ class LlamaConfig:
     remat: bool = False
     # name of a jax.checkpoint_policies policy (e.g. "dots_saveable",
     # "dots_with_no_batch_dims_saveable") — None reproduces full remat
-    # (save nothing, recompute the whole layer in backward)
+    # (save nothing, recompute the whole layer in backward).  The
+    # special value "save_attn" keeps only the flash kernel's (out,
+    # lse) pair per layer (ops.flash_attention.FLASH_SAVE_NAMES): the
+    # remat backward then recomputes norms/projections/MLP but never
+    # the O(T^2) attention forward — the right trade at 16k/32k where
+    # dots policies blow the compile-memory ceiling and full remat pays
+    # a ~2x attention tax (BENCH_DETAIL §1b).  Requires use_flash.
     remat_policy: Any = None
     use_flash: bool = False       # pallas flash-attention kernel (ops/)
     use_fused_norm: bool = False  # pallas fused RMSNorm kernel (ops/)
@@ -226,19 +232,36 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin, attn=None):
 
 
 def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-                  apply_stack, attn=None) -> jax.Array:
+                  apply_stack, attn=None, return_hidden: bool = False
+                  ) -> jax.Array:
     """Shared prologue/epilogue around the decoder stack: embed + RoPE
     tables in, final norm + weight-tied head out.  ``apply_stack(layers,
     h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
     ring); ``attn`` overrides the per-layer attention (the SP forward
-    routes it through ring/all-to-all shard_map strategies)."""
+    routes it through ring/all-to-all shard_map strategies).
+    ``return_hidden`` skips the output head and returns the final-normed
+    (B, T, D) hidden states — long-context losses apply the tied head
+    per sequence chunk instead (parallel.train.chunked_tied_ce), so the
+    (T, vocab) f32 logits never exist as one buffer."""
     T = tokens.shape[1]
     h = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_table(cfg, T)
 
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin, attn=attn)
     if cfg.remat:
-        if cfg.remat_policy:
+        if cfg.remat_policy == "save_attn":
+            from pytorch_operator_tpu.ops.flash_attention import (
+                FLASH_SAVE_NAMES,
+            )
+
+            if not cfg.use_flash:
+                raise ValueError(
+                    "remat_policy='save_attn' saves the flash kernel's "
+                    "(out, lse) residuals and requires use_flash=True")
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.save_only_these_names(
+                    *FLASH_SAVE_NAMES))
+        elif cfg.remat_policy:
             body = jax.checkpoint(
                 body, policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
         else:
@@ -246,6 +269,8 @@ def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
     h = apply_stack(params["layers"], h, body)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    if return_hidden:
+        return h
     # weight-tied output head
     return jnp.einsum("btd,vd->btv", h, params["embed"]).astype(jnp.float32)
 
@@ -257,6 +282,24 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
         return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
 
     return _forward_with(params, tokens, cfg, apply_stack)
+
+
+def forward_hidden(params: Params, tokens: jax.Array,
+                   cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, T) int32 -> final-normed hidden states (B, T, dim).
+
+    The output head is deliberately NOT applied; pair with
+    parallel.train.chunked_tied_ce for long sequences, where the
+    (T, vocab) f32 logits (and the two same-sized scatter-add buffers
+    their CE backward needs) dominate HBM — 3.9 GB each at T=32k/V=32k,
+    the allocation that OOMs the 32k single-chip config if the head
+    runs unchunked."""
+
+    def apply_stack(layers, h, body):
+        return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
+
+    return _forward_with(params, tokens, cfg, apply_stack,
+                         return_hidden=True)
 
 
 def activation_spec() -> P:

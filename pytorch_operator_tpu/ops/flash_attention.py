@@ -226,6 +226,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        name="flash_fwd",
     )(q, k, v)
 
 
@@ -473,6 +474,7 @@ def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
+        name="flash_bwd_fused",
     )(q, k, v, g, lse, delta)
     return (dq32.astype(q.dtype),
             _reduce_kv_partials(dk, group, k.dtype),
@@ -523,6 +525,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        name="flash_bwd_dq",
     )(q, k, v, g, lse, delta)
 
     # dkv grid walks (b, k-block, q-block): q is the accumulated inner dim
@@ -555,9 +558,30 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        name="flash_bwd_dkv",
     )(q, k, v, g, lse, delta)
     return (dq, _reduce_kv_partials(dk, group, k.dtype),
             _reduce_kv_partials(dv, group, v.dtype))
+
+
+# checkpoint_name tags for the attention-preserving remat policy: under
+# jax.checkpoint(policy=save_only_these_names(*FLASH_SAVE_NAMES)) the
+# saved (out, lse) pair is exactly the flash vjp's kernel-derived
+# residuals, so the remat backward recomputes only the cheap q/k/v
+# projections while the O(T^2) forward kernel is dead-code-eliminated
+# from the recompute.  The names are applied INSIDE the vjp forward and
+# the NAMED values are returned as both primal outputs and residuals —
+# that identity is what lets partial-eval mark the pallas call dead.
+FLASH_SAVE_NAMES = ("flash_attn_out", "flash_attn_lse")
+
+
+def _named_fwd(q, k, v, scale, causal, block_q, block_k, interpret, seq_len):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, seq_len)
+    return (checkpoint_name(out, FLASH_SAVE_NAMES[0]),
+            checkpoint_name(lse, FLASH_SAVE_NAMES[1]))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -570,7 +594,7 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret,
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                    seq_len=None):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+    out, lse = _named_fwd(q, k, v, scale, causal, block_q, block_k,
                           interpret, seq_len)
     return out, (q, k, v, out, lse)
 
@@ -602,7 +626,7 @@ def flash_with_lse(q, k, v, scale, causal, block_q, block_k, interpret,
 
 def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                        seq_len=None):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+    out, lse = _named_fwd(q, k, v, scale, causal, block_q, block_k,
                           interpret, seq_len)
     return (out, lse), (q, k, v, out, lse)
 
@@ -696,6 +720,19 @@ def flash_attention(
         block_q = _auto_block(T, D)
     if block_k is None:
         block_k = _auto_block(T, D)
+    if not block_q or not block_k:
+        # explicit dense escape (block 0): short-sequence inference where
+        # XLA's fused softmax wins the forward (BENCH_DETAIL §2), and the
+        # A/B side of the perf guards.  Never chosen automatically.
+        if Hk != H:
+            k = jnp.repeat(k, H // Hk, axis=2)
+            v = jnp.repeat(v, H // Hk, axis=2)
+
+        def bh(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+        out = _dense_reference(bh(q), bh(k), bh(v), scale, causal)
+        return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
     T_pad = _round_up(T, math.lcm(block_q, block_k))
 
     def to_bh(x):

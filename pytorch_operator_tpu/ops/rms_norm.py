@@ -90,10 +90,16 @@ def rms_norm(
     # beat XLA's fused elementwise pipeline.  At D<=2048 it ties or
     # wins (0.99-1.13x standalone) and wins in-model via the analytic
     # VJP (~10% Llama step at d2048 — BENCH_DETAIL.md); at D>=4096 it
-    # consistently loses (~0.8x, VMEM pressure limits pipelining), so
-    # wide rows take the XLA path.  Ragged row counts can't tile; and
-    # the kernel's ~3 f32 (block_rows, D) intermediates must fit VMEM
-    # with pipelining headroom (~12MB of the ~16MB).
+    # consistently loses, so wide rows take the XLA path.  The kernel
+    # is d<=2048-only BY DESIGN: a round-4 sweep of row blocks
+    # {8..256} at D=4096/8192 plateaus at ~0.45x XLA (whole rows must
+    # sit in VMEM before the row mean closes, which caps the minor-dim
+    # pipelining XLA's fused reduce+scale keeps), and a two-pass
+    # variant (reduce pass + scale pass) reads x from HBM twice in a
+    # bandwidth-bound op, so it cannot reach 1.0x even in principle.
+    # Ragged row counts can't tile; and the kernel's ~3 f32
+    # (block_rows, D) intermediates must fit VMEM with pipelining
+    # headroom (~12MB of the ~16MB).
     if (N % block_rows or shape[-1] > 2048
             or block_rows * shape[-1] * 4 * 3 > 12 * 2**20):
         xf = x2.astype(jnp.float32)

@@ -36,6 +36,39 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
+def chunked_tied_ce(h: jax.Array, embed: jax.Array, targets: jax.Array,
+                    chunk: int = 2048) -> jax.Array:
+    """Mean next-token CE with the weight-tied head applied per T-chunk.
+
+    h (B, T, D) final hidden states, embed (V, D), targets (B, T).
+    Equivalent to cross_entropy_loss(h @ embed^T, targets) but the
+    (T, V) f32 logits — and the two logits-sized scatter-add buffers
+    the CE backward materialises — only ever exist chunk rows at a
+    time (jax.checkpoint recomputes each chunk's logits in the
+    backward).  At T=32k/V=32k that's 260 MB of transient instead of
+    3.9 GB x2 resident, which is what lets the 32k single-chip config
+    train (the attention-preserving save_attn remat fits; these CE
+    buffers were the next OOM).
+    """
+    B, T, D = h.shape
+    chunk = min(chunk, T)
+    # a ragged final slice (T % chunk) just becomes a smaller chunk —
+    # at most one extra trace; collapsing to a single full-T chunk here
+    # would silently reintroduce the resident (T, V) buffers this
+    # function exists to avoid
+
+    @jax.checkpoint
+    def one(hc, tc):
+        logits = jnp.einsum("btd,vd->btv", hc, embed).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.sum(jnp.take_along_axis(logp, tc[..., None], axis=-1))
+
+    total = jnp.zeros((), jnp.float32)
+    for i in range(0, T, chunk):
+        total += one(h[:, i:i + chunk], targets[:, i:i + chunk])
+    return -total / (B * T)
+
+
 def sharded_init(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
@@ -95,14 +128,21 @@ def _make_step(
     forward_fn: Callable[[Any, jax.Array], jax.Array],
     data_sharding: NamedSharding,
     optimizer: optax.GradientTransformation,
+    hidden_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    ce_chunk: int = 2048,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Shared step builder: grad of next-token loss over ``forward_fn``,
     optimizer update, donated state.  The forward (dense vs pipelined)
     and the batch layout are the only things that vary between the
-    parallel strategies."""
+    parallel strategies.  When ``hidden_fn`` is given the loss runs the
+    weight-tied head per sequence chunk (chunked_tied_ce) so the
+    (T, vocab) logits never materialise — the long-context path."""
 
     def loss_fn(params, batch):
         inputs, targets = batch[:, :-1], batch[:, 1:]
+        if hidden_fn is not None:
+            h = hidden_fn(params, inputs)
+            return chunked_tied_ce(h, params["embed"], targets, ce_chunk)
         return cross_entropy_loss(forward_fn(params, inputs), targets)
 
     def step(state: TrainState, batch: jax.Array):
@@ -124,16 +164,24 @@ def make_train_step(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
+    *,
+    chunked_ce: bool = False,
+    ce_chunk: int = 2048,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Build the jitted full training step.
 
     Batch is an int32 (B, T+1) token array; step returns the new state
-    (donated in-place) and a metrics dict.
+    (donated in-place) and a metrics dict.  ``chunked_ce`` applies the
+    tied output head per ``ce_chunk`` tokens (see chunked_tied_ce) —
+    required for 32k single-chip training, profitable from ~16k.
     """
     return _make_step(
         lambda params, inputs: llama.forward(params, inputs, cfg),
         NamedSharding(mesh, batch_spec()),
         optimizer,
+        hidden_fn=(lambda params, inputs: llama.forward_hidden(
+            params, inputs, cfg)) if chunked_ce else None,
+        ce_chunk=ce_chunk,
     )
 
 

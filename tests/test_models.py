@@ -53,6 +53,103 @@ class TestLlama:
         b = llama.forward(params, tokens, cfg_r)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
 
+    def test_save_attn_remat_skips_flash_recompute(self):
+        """remat_policy='save_attn' (VERDICT r3 item 2): the saved
+        (out, lse) names must make the flash FORWARD kernel dead code in
+        the remat backward — one flash_fwd pallas call in the whole grad
+        jaxpr instead of full remat's two — while grads stay exact."""
+        import dataclasses
+
+        cfg0 = llama.tiny(max_seq_len=256, n_heads=4, n_kv_heads=2,
+                          dim=128, use_flash=True)
+        params = llama.init_params(jax.random.key(0), cfg0)
+        tokens = jax.random.randint(jax.random.key(1), (2, 256), 0,
+                                    cfg0.vocab_size)
+
+        def kernel_counts(cfg):
+            def loss(p):
+                return jnp.mean(llama.forward(p, tokens, cfg) ** 2)
+
+            jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+            counts: dict = {}
+            seen: set = set()
+
+            def walk(jx):
+                if id(jx) in seen:
+                    return
+                seen.add(id(jx))
+                for eqn in jx.eqns:
+                    if eqn.primitive.name == "pallas_call":
+                        nm = str(eqn.params["name"])
+                        counts[nm] = counts.get(nm, 0) + 1
+                    for v in eqn.params.values():
+                        stack = [v]
+                        while stack:
+                            x = stack.pop()
+                            if hasattr(x, "eqns"):
+                                walk(x)
+                            elif hasattr(x, "jaxpr"):
+                                walk(x.jaxpr)
+                            elif isinstance(x, (list, tuple)):
+                                stack.extend(x)
+
+            walk(jaxpr.jaxpr)
+            return counts
+
+        full = dataclasses.replace(cfg0, remat=True, remat_policy=None)
+        save = dataclasses.replace(cfg0, remat=True,
+                                   remat_policy="save_attn")
+        c_full, c_save = kernel_counts(full), kernel_counts(save)
+        assert c_full.get("flash_fwd") == 2, c_full  # primal + recompute
+        assert c_save.get("flash_fwd") == 1, c_save  # recompute DCE'd
+
+        def grads(cfg):
+            def loss(p):
+                return jnp.mean(llama.forward(p, tokens, cfg) ** 2)
+
+            return jax.grad(loss)(params)
+
+        for a, b in zip(jax.tree.leaves(grads(cfg0)),
+                        jax.tree.leaves(grads(save))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+
+    def test_save_attn_requires_flash(self):
+        cfg = llama.tiny(remat=True, remat_policy="save_attn")
+        params = llama.init_params(jax.random.key(0), cfg)
+        with pytest.raises(ValueError, match="use_flash"):
+            llama.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+
+    @pytest.mark.parametrize("T,chunk", [(256, 128), (300, 128), (64, 2048)])
+    def test_chunked_tied_ce_matches_full_head(self, T, chunk):
+        """chunked_tied_ce == cross_entropy_loss(full logits) for exact,
+        RAGGED (300 % 128 != 0 — must stay chunked, not collapse to one
+        full-T chunk) and chunk>T cases, values and grads."""
+        from pytorch_operator_tpu.parallel.train import (
+            chunked_tied_ce,
+            cross_entropy_loss,
+        )
+
+        cfg = llama.tiny(max_seq_len=T)
+        params = llama.init_params(jax.random.key(0), cfg)
+        tokens = jax.random.randint(jax.random.key(1), (2, T + 1), 0,
+                                    cfg.vocab_size)
+
+        def loss_chunked(p):
+            h = llama.forward_hidden(p, tokens[:, :-1], cfg)
+            return chunked_tied_ce(h, p["embed"], tokens[:, 1:], chunk)
+
+        def loss_full(p):
+            return cross_entropy_loss(llama.forward(p, tokens[:, :-1], cfg),
+                                      tokens[:, 1:])
+
+        la, ga = jax.value_and_grad(loss_chunked)(params)
+        lb, gb = jax.value_and_grad(loss_full)(params)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+
     def test_param_specs_cover_params(self, tiny_cfg):
         params = llama.init_params(jax.random.key(0), tiny_cfg)
         specs = llama.param_specs(tiny_cfg)
