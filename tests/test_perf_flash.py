@@ -1,11 +1,28 @@
 """Perf regression guard for the flash-attention headline claim.
 
-BENCH_DETAIL.md §2 reports the Pallas kernel at 12.5x (fwd) / 8.3x
+BENCH_DETAIL.md §2 reports the Pallas kernel at ~11x (fwd) / ~8-9x
 (fwd+bwd) over dense XLA at seq 4096.  This enforces a conservative
 floor — flash must stay >=4x dense on fwd+bwd at 4096 — so a kernel or
 block-policy regression fails the suite instead of surviving until the
-next manual bench run.  Subprocess escapes the suite's CPU pin; skips
-without hardware (same pattern as test_perf_fused_norm.py).
+next manual bench run.
+
+Contention robustness (round-3 verdict item 3: the old min-of-3,
+interleave-free guard let a 2.3x transient slowdown fail a healthy
+kernel): flash and dense now run in INTERLEAVED windows (ABAB...) in
+one process, so a load spike on the shared chip inflates both sides
+and mostly cancels in the ratio; the verdict uses the median of the
+per-window times; and when the floor would fail WITH high dispersion
+in either series (the contention signature), the whole measurement
+re-runs once before failing.  On failure both raw series are printed.
+
+Sensitivity check (one-off, 2026-07-30, re-runnable via the
+_GUARD_DEGRADE=1 env hook): forcing the degraded two-kernel backward
+path AND 128-blocks (a real multi-x fwd+bwd regression, per the
+block-size sweep in _auto_block's docstring) makes this guard fail at
+1.48x < 4.0 with low dispersion (flash 10.85 ms vs the healthy ~1.9) —
+the robustness changes did not blunt it.  Subprocess escapes the
+suite's CPU pin; skips without hardware (same pattern as
+test_perf_fused_norm.py).
 """
 
 import json
@@ -16,7 +33,7 @@ import sys
 import pytest
 
 _PAYLOAD = r"""
-import json, time
+import json, statistics, time
 import jax
 import jax.numpy as jnp
 
@@ -25,42 +42,83 @@ if jax.default_backend() not in ("tpu", "axon") and \
     print(json.dumps({"skip": f"no TPU ({jax.default_backend()})"}))
     raise SystemExit(0)
 
+import os
 from pytorch_operator_tpu.ops import flash_attention
+
+# _GUARD_DEGRADE: sensitivity self-test hook — force a known-slow
+# configuration (two-kernel backward + 128 blocks) that a healthy guard
+# MUST flag.  Never set in the suite.
+DEGRADE = bool(os.environ.get("_GUARD_DEGRADE"))
+if DEGRADE:
+    import pytorch_operator_tpu.ops.flash_attention as _fa
+    _fa._FUSED_DQ_VMEM_BYTES = 0
 
 B, T, H, D = 1, 4096, 16, 128
 ks = jax.random.split(jax.random.key(0), 3)
 q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
 
-def timed(kw, iters=30):
+def make_runner(kw, iters):
+    # Two-point timer: (time of 2N-iter scan) - (time of N-iter scan)
+    # cancels the fixed per-launch cost, which through the device tunnel
+    # is tens-to-hundreds of ms — at small N that overhead, divided by
+    # N, would otherwise swamp a ~2 ms kernel and compress the A/B
+    # ratio (the same method scripts/bench_detail.py uses).
     def loss(qq, kk, vv):
         o = flash_attention(qq, kk, vv, causal=True, **kw)
         return jnp.sum(o.astype(jnp.float32) ** 2)
     grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
-    @jax.jit
-    def run(qc):
-        def body(c, _):
-            dq, dk, dv = grad_fn(c, k, v)
-            g = (dq + dk + dv).astype(jnp.float32)
-            return (g * jax.lax.rsqrt(jnp.mean(g * g) + 1e-6)
-                    ).astype(c.dtype), None
-        out = jax.lax.scan(body, qc, None, length=iters)[0]
-        return jnp.sum(out.astype(jnp.float32))
+    def make_run(length):
+        @jax.jit
+        def run(qc):
+            def body(c, _):
+                dq, dk, dv = grad_fn(c, k, v)
+                g = (dq + dk + dv).astype(jnp.float32)
+                return (g * jax.lax.rsqrt(jnp.mean(g * g) + 1e-6)
+                        ).astype(c.dtype), None
+            out = jax.lax.scan(body, qc, None, length=length)[0]
+            return jnp.sum(out.astype(jnp.float32))
+        return run
 
-    float(run(q))  # compile + warmup
-    best = float("inf")
-    for _ in range(3):
+    run1, run2 = make_run(iters), make_run(2 * iters)
+    float(run1(q))  # compile + warmup
+    float(run2(q))
+
+    def timed():
         t0 = time.perf_counter()
-        float(run(q))
-        best = min(best, time.perf_counter() - t0)
-    return best / iters
+        float(run1(q))
+        t1 = time.perf_counter()
+        float(run2(q))
+        t2 = time.perf_counter()
+        return ((t2 - t1) - (t1 - t0)) / iters
+    return timed
 
-# interleave-free but min-of-3 on both sides; the 4x floor leaves a
-# 2x+ margin under the measured 8.3x for shared-chip noise
-t_flash = timed({})
-t_dense = timed({"block_q": 0, "block_k": 0})
-print(json.dumps({"flash_ms": t_flash * 1e3, "dense_ms": t_dense * 1e3,
-                  "speedup": t_dense / t_flash}))
+flash_kw = ({"block_q": 128, "block_k": 128} if DEGRADE else {})
+runners = {"flash": make_runner(flash_kw, 40),
+           "dense": make_runner({"block_q": 0, "block_k": 0}, 10)}
+
+def measure(rounds=5):
+    series = {"flash": [], "dense": []}
+    for _ in range(rounds):
+        for name, timed in runners.items():  # interleaved ABAB windows
+            series[name].append(timed())
+    med = {n: statistics.median(s) for n, s in series.items()}
+    disp = {n: (max(s) - min(s)) / med[n] for n, s in series.items()}
+    return {"speedup": med["dense"] / med["flash"],
+            "flash_ms": med["flash"] * 1e3,
+            "dense_ms": med["dense"] * 1e3,
+            "dispersion": disp,
+            "series_ms": {n: [round(t * 1e3, 3) for t in s]
+                          for n, s in series.items()}}
+
+result = measure()
+if result["speedup"] < 4.0 and max(result["dispersion"].values()) > 0.4:
+    # contention signature: noisy windows AND a failing ratio — one
+    # full re-measure before letting the failure stand
+    retry = measure()
+    retry["retried_after"] = result
+    result = retry
+print(json.dumps(result))
 """
 
 
@@ -85,5 +143,10 @@ def test_flash_fwdbwd_keeps_headline_speedup():
         pytest.skip(result["skip"])
     assert result["speedup"] >= 4.0, (
         f"flash fwd+bwd regressed to {result['speedup']:.2f}x dense at "
-        f"seq 4096 (flash {result['flash_ms']:.2f}ms, "
-        f"dense {result['dense_ms']:.2f}ms); headline is 8.3x")
+        f"seq 4096 (median flash {result['flash_ms']:.2f}ms, dense "
+        f"{result['dense_ms']:.2f}ms; headline ~9x).  Raw interleaved "
+        f"series (ms): {json.dumps(result['series_ms'])}; dispersion "
+        f"{result['dispersion']}"
+        + (f"; first attempt (re-measured due to contention): "
+           f"{json.dumps(result['retried_after']['series_ms'])}"
+           if "retried_after" in result else ""))
