@@ -106,6 +106,13 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(params_spec, P()),
         out_specs=P(),  # psum in the body makes the output truly replicated
+        # Partial-manual: only the pipeline axis is manual; any OTHER
+        # mesh axis (tp/dp/...) stays an auto GSPMD axis, so pp composes
+        # with tensor parallelism — weights additionally sharded over tp
+        # keep that sharding through the boundary and the stage body's
+        # einsums are partitioned (collectives inserted) over tp as
+        # usual, instead of being all-gathered at shard_map entry.
+        axis_names={axis_name},
         # callers with jax.checkpoint-wrapped stage bodies (rematerialised
         # Llama stages) must pass check_vma=False — the vma checker rejects
         # remat bodies outright; everyone else keeps the replication check
