@@ -26,7 +26,14 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libtpu_operator.so")
+# PYTORCH_OPERATOR_NATIVE_LIB points the bindings at an alternate build
+# of the same library — the sanitizer tier (scripts/run-tests.sh) sets
+# it to build/libtpu_operator_asan.so so test_native/test_rest/the
+# malformed-input corpus run under ASan+UBSan without a rebuild race
+# against the default .so.
+_LIB_PATH = os.environ.get(
+    "PYTORCH_OPERATOR_NATIVE_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libtpu_operator.so"))
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -43,6 +43,24 @@ else
   python -m pytest tests/ -q
 fi
 
+echo "=== sanitize: native core under ASan+UBSan ==="
+# The C++ transport parses network bytes (http.cc framing/chunked
+# decoding, tls.cc glue) — the reference gets memory safety from Go for
+# free; this tier earns it.  The host python binary is uninstrumented,
+# so libasan must be preloaded; leak detection is off (the Python
+# runtime itself reports spurious leaks at exit).
+LIBASAN="$(g++ -print-file-name=libasan.so)"
+if [ -f "$LIBASAN" ]; then
+  make -C native sanitize
+  LD_PRELOAD="$LIBASAN" \
+    ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+    PYTORCH_OPERATOR_NATIVE_LIB="$PWD/native/build/libtpu_operator_asan.so" \
+    python -m pytest tests/test_native.py tests/test_native_fuzz.py \
+      tests/test_rest.py tests/test_rest_tls.py -q
+else
+  echo "libasan not found in toolchain — sanitize tier skipped"
+fi
+
 echo "=== driver compile checks ==="
 python __graft_entry__.py 8
 
