@@ -72,6 +72,13 @@ class _FakeBackend:
         annotations = (pod.get("metadata") or {}).get("annotations") or {}
         return annotations.get("fake.kubelet/logs", "")
 
+    def job_store(self):
+        """The watchable job store (add_listener interface) — both
+        FakeCluster and RestCluster stores expose it; sdk.watch rides
+        the event stream when this returns non-None."""
+        store = getattr(self.cluster, "jobs", None)
+        return store if hasattr(store, "add_listener") else None
+
 
 class _KubeBackend:
     """Adapter over the `kubernetes` client package (real API server)."""
@@ -143,6 +150,12 @@ class _KubeBackend:
 
     def read_pod_log(self, namespace, name):
         return self.core_api.read_namespaced_pod_log(name, namespace)
+
+    def job_store(self):
+        """CustomObjectsApi hides its watch machinery — no stream
+        interface; sdk.watch falls back to polling (the reference's
+        own watch helper polls the list endpoint too)."""
+        return None
 
 
 class PyTorchJobClient:

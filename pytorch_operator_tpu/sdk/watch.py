@@ -1,33 +1,124 @@
 """Table-printing watch over a PyTorchJob until it terminates.
 
 Reference: sdk/python/kubeflow/pytorchjob/api/py_torch_job_watch.py:29-60
-(tabulated NAME/STATE/TIME stream that stops on Succeeded/Failed).  The
-fake backend has no server-side watch stream for jobs exposed through
-the SDK, so this polls — same observable behavior, same output shape.
+(tabulated NAME/STATE/TIME stream that stops on Succeeded/Failed).
+
+Event-driven, matching the reference's server-side stream: the SDK
+subscribes to the backend job store's watch interface (``job_store()``
+on the backend adapter) — for RestCluster that is the real chunked-HTTP
+watch stream (k8s/rest.py add_listener, the same machinery the
+informers consume, native C++ ws_next or the Python fallback), for
+FakeCluster the in-memory listener bus.  A GAP event (stream error +
+relist semantics) re-reads the job so no terminal transition can be
+missed — including a deletion that happened during the outage, which
+reports as Deleted.  Polling survives only as the fallback for
+backends that expose no watch interface (the `kubernetes`-package
+adapter hides its streams behind CustomObjectsApi).
 """
 
 from __future__ import annotations
 
+import queue
 import time
+
+from pytorch_operator_tpu.k8s.errors import NotFoundError
+
+_FMT = "{:<30.30} {:<20.20} {:<30.30}"
+_TERMINAL = ("Succeeded", "Failed")
+
+
+def _emit_row(name: str, job: dict, last):
+    """Print the newest condition row if it changed.
+
+    Returns (new_last, terminal): the dedup state to carry and whether
+    the newest condition is terminal.  Shared by the event-driven path
+    and the poll fallback so the table format, dedup rule and terminal
+    set cannot diverge between the two modes.
+    """
+    conditions = ((job.get("status") or {}).get("conditions")) or []
+    if not conditions:
+        return last, False
+    cond = conditions[-1]
+    row = (cond.get("type", ""), cond.get("lastTransitionTime", ""))
+    if row != last:
+        print(_FMT.format(name, row[0], row[1]), flush=True)
+    return row, row[0] in _TERMINAL
 
 
 def watch(client, name: str, namespace: str, timeout_seconds: int = 600,
           polling_interval: float = 2.0) -> None:
-    fmt = "{:<30.30} {:<20.20} {:<30.30}"
-    print(fmt.format("NAME", "STATE", "TIME"), flush=True)
+    job_store = getattr(client._backend, "job_store", lambda: None)
+    store = job_store()
+    if store is None:  # kubernetes-package backend: no stream access
+        return _poll_watch(client, name, namespace, timeout_seconds,
+                           polling_interval)
+
+    print(_FMT.format("NAME", "STATE", "TIME"), flush=True)
+    events: queue.Queue = queue.Queue()
+
+    def on_event(etype: str, obj: dict) -> None:
+        if etype == "GAP":
+            events.put(("GAP", None))
+            return
+        meta = obj.get("metadata") or {}
+        if meta.get("name") == name and \
+                (meta.get("namespace") or "default") == namespace:
+            events.put((etype, obj))
+
+    def deleted() -> None:
+        print(_FMT.format(name, "Deleted", ""), flush=True)
+
+    last = None
+    store.add_listener(on_event)
+    try:
+        deadline = time.monotonic() + timeout_seconds
+        # initial state: the listener only sees events from now on
+        try:
+            last, terminal = _emit_row(name, client.get(name, namespace),
+                                       last)
+            if terminal:
+                return
+        except NotFoundError:
+            pass  # watch opened before create — events will arrive
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                etype, obj = events.get(timeout=min(1.0, remaining))
+            except queue.Empty:
+                continue
+            if etype == "GAP":
+                # stream error: events may have been lost — re-read;
+                # a job gone after the outage means the DELETED event
+                # was among the lost ones
+                try:
+                    obj = client.get(name, namespace)
+                except NotFoundError:
+                    deleted()
+                    return
+            elif etype == "DELETED":
+                deleted()
+                return
+            last, terminal = _emit_row(name, obj, last)
+            if terminal:
+                return
+        raise RuntimeError(
+            f"timeout watching PyTorchJob {namespace}/{name}")
+    finally:
+        store.remove_listener(on_event)
+
+
+def _poll_watch(client, name: str, namespace: str, timeout_seconds: int,
+                polling_interval: float) -> None:
+    """GET-poll fallback for backends without a stream interface."""
+    print(_FMT.format("NAME", "STATE", "TIME"), flush=True)
     deadline = time.monotonic() + timeout_seconds
     last = None
     while time.monotonic() < deadline:
-        job = client.get(name, namespace)
-        conditions = ((job.get("status") or {}).get("conditions")) or []
-        if conditions:
-            cond = conditions[-1]
-            row = (cond.get("type", ""), cond.get("lastTransitionTime", ""))
-            if row != last:
-                print(fmt.format(name, row[0], row[1]), flush=True)
-                last = row
-            if row[0] in ("Succeeded", "Failed"):
-                return
+        last, terminal = _emit_row(name, client.get(name, namespace), last)
+        if terminal:
+            return
         time.sleep(polling_interval)
     raise RuntimeError(
         f"timeout watching PyTorchJob {namespace}/{name}")

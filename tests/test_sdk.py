@@ -120,6 +120,46 @@ class TestSdkUtils:
         assert sdk_utils.get_default_target_namespace() == "default"
 
 
+def test_watch_gap_with_deleted_job_reports_deleted(world, capsys):
+    """A job deleted during a watch-stream outage must surface as
+    Deleted when the GAP re-read finds it gone — not hang to timeout
+    (round-4 review finding on sdk/watch.py)."""
+    client = PyTorchJobClient(cluster=world)
+    # the job is never created: to the GAP re-read this is exactly the
+    # deleted-during-outage state, without racing the fake kubelet
+    # driving a real job to Succeeded before the injected deletion
+
+    done = {}
+
+    def run():
+        try:
+            client.get("gap-job", watch=True, timeout_seconds=20)
+            done["ok"] = True
+        except Exception as e:  # pragma: no cover - surfaced below
+            done["error"] = e
+
+    base_listeners = len(world.jobs._listeners)  # controller's informer
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    pause = threading.Event()
+    # wait for the WATCHER's listener (beyond the controller's), then
+    # delete + inject a GAP the way a stream error would deliver it
+    for _ in range(200):
+        if len(world.jobs._listeners) > base_listeners:
+            break
+        pause.wait(0.05)
+    else:
+        pytest.fail("watcher never subscribed")
+    # deliver a GAP (stream error; any DELETED was lost in the outage)
+    for fn in list(world.jobs._listeners):
+        fn("GAP", {})
+    t.join(timeout=10)
+    assert not t.is_alive(), "watch hung after GAP + deletion"
+    assert done.get("ok"), done.get("error")
+    out = capsys.readouterr().out
+    assert "Deleted" in out
+
+
 def test_watch_table_output(world, capsys):
     client = PyTorchJobClient(cluster=world)
     client.create(new_job(workers=0, name="w-job").to_dict())
