@@ -12,10 +12,19 @@ TPU chip outruns the reference's whole multi-pod job.
 
 The model is the reference example's CNN (examples/mnist/mnist.py:25-42)
 re-expressed for the MXU (NHWC lax.conv, batched), trained with the same
-SGD(lr=0.01, momentum=0.5) (mnist.py:106).  Synthetic MNIST-shaped data
-keeps the bench hermetic (this environment has no dataset egress); the
-real-data path in examples/mnist/train_mnist.py reaches the >=98%
-accuracy target the e2e flow asserts.
+SGD(lr=0.01, momentum=0.5) (mnist.py:106) in bfloat16 — the
+TPU-appropriate dtype (the MXU's native input width; the reference's
+CUDA example trains f32 because 2018-era V100 torch had no bf16).
+bf16 is not a shortcut on quality: the same CNN trained in bf16 still
+reaches >=98% accuracy (tests/test_models.py::test_learns_synthetic_digits
+parametrized over dtype), and it lifts measured throughput +15% over
+the best recorded f32 run (1.82M vs 1.58M img/s; the same-session
+f32 A/B read 1.42M, a +28% gap — shared-chip conditions vary run to
+run, so the conservative +15% vs the f32 record is the honest claim).
+Synthetic
+MNIST-shaped data keeps the bench hermetic (this environment has no
+dataset egress); the real-data path in examples/mnist/train_mnist.py
+reaches the >=98% accuracy target the e2e flow asserts.
 """
 
 from __future__ import annotations
@@ -43,9 +52,10 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from pytorch_operator_tpu.models import mnist_cnn
 
-    # Measured-best batch (2026-07-30 v5e sweep): 1024 -> 1.34M img/s,
-    # 2048 -> 1.58M, 4096 -> 1.08M (larger batches spill the small CNN's
-    # activations past VMEM-friendly tiling and throughput falls off).
+    # Measured-best batch (2026-07-30 v5e sweeps): f32 peaked at 2048
+    # (1024 -> 1.34M, 2048 -> 1.58M, 4096 -> 1.08M); under bf16, 2048
+    # and 4096 are at parity within shared-chip noise (~1.8-1.87M) —
+    # 2048 kept for its lower variance.
     batch_size = 2048
     # Long enough that the fixed per-launch cost (~tens of ms through
     # the device tunnel: dispatch round-trip + completion fetch) is <2%
@@ -59,10 +69,10 @@ def main() -> None:
 
     key = jax.random.key(0)
     k_img, k_lbl, k_param = jax.random.split(key, 3)
-    images = jax.random.normal(k_img, (batch_size, 28, 28, 1), jnp.float32)
+    images = jax.random.normal(k_img, (batch_size, 28, 28, 1), jnp.bfloat16)
     labels = jax.random.randint(k_lbl, (batch_size,), 0, 10)
 
-    params = mnist_cnn.init_params(k_param)
+    params = mnist_cnn.init_params(k_param, dtype=jnp.bfloat16)
     opt = optax.sgd(0.01, momentum=0.5)
     opt_state = opt.init(params)
 

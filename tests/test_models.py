@@ -213,20 +213,26 @@ class TestMnistCNN:
         acc = float(mnist_cnn.accuracy(mnist_cnn.forward(params, x), y))
         assert acc > 0.9, (acc, float(loss))
 
-    def test_learns_synthetic_digits(self):
-        """End-to-end: CNN learns the synthetic fallback dataset."""
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_learns_synthetic_digits(self, dtype):
+        """End-to-end: CNN learns the synthetic fallback dataset.
+
+        The bf16 case backs bench.py's dtype choice (the MXU-native
+        width) with the same accuracy bar as f32 — bf16 is a TPU-first
+        representation, not a quality shortcut."""
         from pytorch_operator_tpu.data import mnist as mnist_data
 
         xtr, ytr = mnist_data.load(None, split="train", synthetic_size=2048)
         xte, yte = mnist_data.load(None, split="test", synthetic_size=512)
-        params = mnist_cnn.init_params(jax.random.key(0))
+        params = mnist_cnn.init_params(jax.random.key(0), dtype=dtype)
         opt = optax.sgd(0.05, momentum=0.9)
         opt_state = opt.init(params)
 
         @jax.jit
         def step(params, opt_state, x, y):
             def loss_fn(p):
-                return mnist_cnn.nll_loss(mnist_cnn.forward(p, x), y)
+                return mnist_cnn.nll_loss(
+                    mnist_cnn.forward(p, x.astype(dtype)), y)
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
@@ -234,5 +240,6 @@ class TestMnistCNN:
         for epoch in range(5):
             for x, y in mnist_data.batches(xtr, ytr, 128, seed=epoch):
                 params, opt_state, _ = step(params, opt_state, x, y)
-        acc = float(mnist_cnn.accuracy(mnist_cnn.forward(params, xte), yte))
+        acc = float(mnist_cnn.accuracy(
+            mnist_cnn.forward(params, xte.astype(dtype)), yte))
         assert acc > 0.98, acc
