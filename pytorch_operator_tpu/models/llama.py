@@ -24,6 +24,7 @@ Sharding convention (axes from parallel.mesh):
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Dict
 
@@ -366,8 +367,10 @@ def forward_sp(
 
     GQA-native: the ring always rotates UNREPEATED K/V chunks (ICI
     traffic / group), and ulysses shards the kv heads through its
-    all-to-all when n_kv_heads divides the sp axis; K/V is broadcast
-    only for ulysses when it doesn't.  Params replicate
+    all-to-all when n_kv_heads divides the sp axis; when it doesn't,
+    K/V repeats only to lcm(n_kv_heads, sp) heads — the minimum the
+    all-to-all can shard — not to the full H (e.g. H=16/kv=2/sp=8
+    moves 8 kv heads over ICI, not 16).  Params replicate
     (``sp_param_specs``) — sequence parallelism shards activations, not
     weights.  Reference scope: the reference scales only DP replica
     count (SURVEY §2.4); long-context is a TPU-build extension (§5).
@@ -381,13 +384,21 @@ def forward_sp(
     def attn(q, k, v, cfg):
         # Both SP strategies are GQA-native: the ring rotates unrepeated
         # K/V chunks (ICI traffic / group), and ulysses shards kv heads
-        # through the all-to-all when they divide the axis.  Only the
-        # ulysses-with-too-few-kv-heads case still broadcasts.
+        # through the all-to-all when they divide the axis.  When they
+        # don't, repeat only to the MINIMAL head count that does —
+        # lcm(kv, sp) when it divides H — instead of the full H: e.g.
+        # H=16/kv=2/sp=8 moves 8 kv heads over ICI, not 16.  Correct
+        # for any repeat factor r with H % (r*kv) == 0: contiguous
+        # repeat keeps the query-group -> kv-head mapping, since
+        # (h // (H/kv_new)) // r == h // (H/kv).
         sp_deg = mesh.shape[axis_name]
         if impl == "ulysses" and cfg.n_kv_heads % sp_deg:
-            groups = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
+            # lcm(kv, sp) always divides H for configs ulysses accepts
+            # (it requires sp | H, and kv | H by construction), so the
+            # minimal repeat is always valid
+            r = math.lcm(cfg.n_kv_heads, sp_deg) // cfg.n_kv_heads
+            k = jnp.repeat(k, r, axis=2)
+            v = jnp.repeat(v, r, axis=2)
         if impl == "ulysses":
             return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      use_flash=cfg.use_flash)

@@ -273,6 +273,35 @@ class TestSpGqa:
             np.testing.assert_allclose(np.asarray(gu), np.asarray(gd),
                                        atol=5e-5, rtol=5e-4)
 
+    def test_sp_forward_minimal_kv_repeat_matches_dense(self, monkeypatch):
+        """forward_sp's ulysses path with kv % sp != 0 must repeat K/V
+        only to lcm(kv, sp) — H=8/kv=2/sp=4 moves 4 kv heads over the
+        all-to-all, not 8 — and still match the dense model exactly."""
+        import importlib
+
+        from pytorch_operator_tpu.models import llama
+
+        uly = importlib.import_module("pytorch_operator_tpu.parallel.ulysses")
+        seen_kv = []
+        real = uly.ulysses_attention
+
+        def spy(q, k, v, *a, **kw):
+            seen_kv.append(k.shape[2])
+            return real(q, k, v, *a, **kw)
+
+        monkeypatch.setattr(uly, "ulysses_attention", spy)
+        mesh = make_sp_mesh(dp=2, sp=4)
+        cfg = llama.tiny(max_seq_len=64, n_heads=8, n_kv_heads=2, dim=64)
+        params = llama.init_params(jax.random.key(31), cfg)
+        tokens = jax.random.randint(jax.random.key(32), (2, 64), 0,
+                                    cfg.vocab_size)
+        out = llama.forward_sp(params, tokens, cfg, mesh, impl="ulysses")
+        ref = llama.forward(params, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=1e-3)
+        # the wire must carry lcm(kv=2, sp=4) = 4 heads, not H = 8
+        assert seen_kv and set(seen_kv) == {4}, seen_kv
+
     def test_ring_rejects_non_dividing_kv_heads(self):
         mesh = make_sp_mesh(dp=1, sp=8)
         ks = jax.random.split(jax.random.key(29), 3)
