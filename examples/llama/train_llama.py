@@ -80,8 +80,16 @@ def main() -> int:
     parser.add_argument("--remat-policy", type=str, default=None,
                         help="jax.checkpoint_policies name for selective "
                              "remat (e.g. dots_with_no_batch_dims_saveable "
-                             "— measured-best remat variant; default: full "
-                             "remat)")
+                             "— measured-best at 4k/8k) or 'save_attn' "
+                             "(keep flash out+lse, never recompute the "
+                             "O(T^2) attention forward — measured-best at "
+                             "16k/32k, requires flash; default: full remat)")
+    parser.add_argument("--chunked-ce", action="store_true",
+                        help="apply the tied output head per --ce-chunk "
+                             "tokens so the (T, vocab) logits never "
+                             "materialise (required for 32k single-chip; "
+                             "see parallel.train.chunked_tied_ce)")
+    parser.add_argument("--ce-chunk", type=int, default=1024)
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a TensorBoard-loadable XLA trace of "
                              "steps 2..--profile-steps into this directory")
@@ -119,10 +127,19 @@ def main() -> int:
     kernel_kw["remat"] = remat
     if args.remat_policy and not remat:
         parser.error("--remat-policy requires remat (drop --no-remat)")
-    if args.remat_policy and not hasattr(jax.checkpoint_policies,
-                                         args.remat_policy):
+    if args.chunked_ce and (args.sp or args.pp):
+        parser.error("--chunked-ce applies to the dp/fsdp/tp step only "
+                     "(SP/PP steps keep the unchunked head for now)")
+    if args.ce_chunk < 1:
+        parser.error(f"--ce-chunk must be >= 1, got {args.ce_chunk}")
+    if args.remat_policy == "save_attn" and not kernel_kw["use_flash"]:
+        parser.error("--remat-policy save_attn saves the flash kernel's "
+                     "(out, lse) residuals and requires --flash")
+    if args.remat_policy and args.remat_policy != "save_attn" and \
+            not hasattr(jax.checkpoint_policies, args.remat_policy):
         parser.error(f"unknown --remat-policy {args.remat_policy!r}; see "
-                     f"jax.checkpoint_policies for valid names")
+                     f"jax.checkpoint_policies for valid names, or "
+                     f"'save_attn' (models/llama.py)")
     if remat and args.remat_policy:
         kernel_kw["remat_policy"] = args.remat_policy
     if args.model == "7b":
@@ -185,7 +202,9 @@ def main() -> int:
         print(f"[worker {pid}/{nprocs}] mesh dp={dp} fsdp={fsdp} tp={tp} "
               f"over {n} devices", flush=True)
         state = sharded_init(cfg, mesh, optimizer)
-        step_fn = make_train_step(cfg, mesh, optimizer)
+        step_fn = make_train_step(cfg, mesh, optimizer,
+                                  chunked_ce=args.chunked_ce,
+                                  ce_chunk=args.ce_chunk)
 
     start_step = 0
     if args.checkpoint_dir:
