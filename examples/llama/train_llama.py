@@ -88,8 +88,13 @@ def main() -> int:
                         help="apply the tied output head per --ce-chunk "
                              "tokens so the (T, vocab) logits never "
                              "materialise (required for 32k single-chip; "
-                             "see parallel.train.chunked_tied_ce)")
-    parser.add_argument("--ce-chunk", type=int, default=1024)
+                             "composes with --sp/--pp; see "
+                             "parallel.train.chunked_tied_ce)")
+    parser.add_argument("--ce-chunk", type=int, default=1024,
+                        help="tokens per tied-head CE chunk under "
+                             "--chunked-ce (1024 fits the 32k single-chip "
+                             "config with ~4MB HBM to spare; matches the "
+                             "library default)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a TensorBoard-loadable XLA trace of "
                              "steps 2..--profile-steps into this directory")
@@ -127,9 +132,6 @@ def main() -> int:
     kernel_kw["remat"] = remat
     if args.remat_policy and not remat:
         parser.error("--remat-policy requires remat (drop --no-remat)")
-    if args.chunked_ce and (args.sp or args.pp):
-        parser.error("--chunked-ce applies to the dp/fsdp/tp step only "
-                     "(SP/PP steps keep the unchunked head for now)")
     if args.ce_chunk < 1:
         parser.error(f"--ce-chunk must be >= 1, got {args.ce_chunk}")
     if args.remat_policy == "save_attn" and not kernel_kw["use_flash"]:
@@ -170,7 +172,9 @@ def main() -> int:
         state = sharded_init(cfg, mesh, optimizer,
                              specs=llama.sp_param_specs(cfg))
         step_fn = make_sp_train_step(cfg, mesh, optimizer,
-                                     impl=args.sp_impl)
+                                     impl=args.sp_impl,
+                                     chunked_ce=args.chunked_ce,
+                                     ce_chunk=args.ce_chunk)
     elif args.pp:
         if args.dp or args.fsdp or args.tp:
             parser.error("--pp is a pure GPipe layout; it cannot be "
@@ -186,7 +190,9 @@ def main() -> int:
         state = sharded_init(cfg, mesh, optimizer,
                              specs=llama.pp_param_specs(cfg))
         step_fn = make_pp_train_step(cfg, mesh, optimizer,
-                                     n_microbatches=args.microbatches)
+                                     n_microbatches=args.microbatches,
+                                     chunked_ce=args.chunked_ce,
+                                     ce_chunk=args.ce_chunk)
     else:
         flags = (args.dp, args.fsdp, args.tp)
         if all(flags):

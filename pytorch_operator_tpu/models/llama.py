@@ -316,6 +316,7 @@ def forward_pipelined(
     *,
     n_microbatches: int,
     axis_name: str = "pp",
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Pipeline-parallel forward: the decoder stack runs as GPipe stages.
 
@@ -341,7 +342,8 @@ def forward_pipelined(
             check_vma=not cfg.remat,
         )
 
-    return _forward_with(params, tokens, cfg, apply_stack)
+    return _forward_with(params, tokens, cfg, apply_stack,
+                         return_hidden=return_hidden)
 
 
 def forward_sp(
@@ -352,6 +354,7 @@ def forward_sp(
     *,
     axis_name: str = "sp",
     impl: str = "ulysses",
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Sequence-parallel forward for long-context training.
 
@@ -413,7 +416,8 @@ def forward_sp(
             h, NamedSharding(mesh, P(None, axis_name, None)))
         return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
 
-    return _forward_with(params, tokens, cfg, apply_stack, attn=attn)
+    return _forward_with(params, tokens, cfg, apply_stack, attn=attn,
+                         return_hidden=return_hidden)
 
 
 def sp_param_specs(cfg: LlamaConfig) -> Params:

@@ -37,7 +37,7 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def chunked_tied_ce(h: jax.Array, embed: jax.Array, targets: jax.Array,
-                    chunk: int = 2048) -> jax.Array:
+                    chunk: int = 1024) -> jax.Array:
     """Mean next-token CE with the weight-tied head applied per T-chunk.
 
     h (B, T, D) final hidden states, embed (V, D), targets (B, T).
@@ -129,7 +129,7 @@ def _make_step(
     data_sharding: NamedSharding,
     optimizer: optax.GradientTransformation,
     hidden_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
-    ce_chunk: int = 2048,
+    ce_chunk: int = 1024,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Shared step builder: grad of next-token loss over ``forward_fn``,
     optimizer update, donated state.  The forward (dense vs pipelined)
@@ -166,7 +166,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     *,
     chunked_ce: bool = False,
-    ce_chunk: int = 2048,
+    ce_chunk: int = 1024,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Build the jitted full training step.
 
@@ -192,6 +192,8 @@ def make_sp_train_step(
     *,
     axis_name: str = "sp",
     impl: str = "ulysses",
+    chunked_ce: bool = False,
+    ce_chunk: int = 1024,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Jitted sequence-parallel training step for long contexts.
 
@@ -202,12 +204,20 @@ def make_sp_train_step(
     params replicate (pair with ``sharded_init(..., specs=
     llama.sp_param_specs(cfg))``); gradients of the replicated params
     are reduced by the collectives GSPMD inserts, like the dp path.
+    ``chunked_ce`` applies the tied head per T-chunk on the (already
+    T/n-per-device) hidden states — SP shrinks the resident logits by
+    the axis degree, chunking bounds the transient too.
     """
+    def fwd(params, inputs, **kw):
+        return llama.forward_sp(params, inputs, cfg, mesh,
+                                axis_name=axis_name, impl=impl, **kw)
+
     return _make_step(
-        lambda params, inputs: llama.forward_sp(
-            params, inputs, cfg, mesh, axis_name=axis_name, impl=impl),
+        fwd,
         NamedSharding(mesh, P()),
         optimizer,
+        hidden_fn=partial(fwd, return_hidden=True) if chunked_ce else None,
+        ce_chunk=ce_chunk,
     )
 
 
@@ -218,6 +228,8 @@ def make_pp_train_step(
     *,
     n_microbatches: int,
     axis_name: str = "pp",
+    chunked_ce: bool = False,
+    ce_chunk: int = 1024,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """Jitted training step through the GPipe pipeline.
 
@@ -227,11 +239,15 @@ def make_pp_train_step(
     the way the activations came.  Pair with
     ``sharded_init(..., specs=llama.pp_param_specs(cfg))``.
     """
-    return _make_step(
-        lambda params, inputs: llama.forward_pipelined(
+    def fwd(params, inputs, **kw):
+        return llama.forward_pipelined(
             params, inputs, cfg, mesh,
-            n_microbatches=n_microbatches, axis_name=axis_name,
-        ),
+            n_microbatches=n_microbatches, axis_name=axis_name, **kw)
+
+    return _make_step(
+        fwd,
         NamedSharding(mesh, P()),  # stage 0 consumes the batch
         optimizer,
+        hidden_fn=partial(fwd, return_hidden=True) if chunked_ce else None,
+        ce_chunk=ce_chunk,
     )
