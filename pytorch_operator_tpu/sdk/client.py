@@ -17,6 +17,7 @@ Backends:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Union
 
@@ -102,6 +103,7 @@ class _KubeBackend:
             config.load_incluster_config()
         self.custom_api = client.CustomObjectsApi()
         self.core_api = client.CoreV1Api()
+        self._watch_store = None
 
     def create_job(self, namespace, obj):
         return self.custom_api.create_namespaced_custom_object(
@@ -152,10 +154,110 @@ class _KubeBackend:
         return self.core_api.read_namespaced_pod_log(name, namespace)
 
     def job_store(self):
-        """CustomObjectsApi hides its watch machinery — no stream
-        interface; sdk.watch falls back to polling (the reference's
-        own watch helper polls the list endpoint too)."""
-        return None
+        """Watchable adapter over kubernetes.watch (the stream the
+        reference's py_torch_job_watch.py:29-60 rides); falls back to
+        None — and so to sdk.watch's poll loop — only when the package
+        ships without the watch module."""
+        if self._watch_store is not None and self._watch_store.stopped:
+            self._watch_store = None  # a stopped store can't serve events
+        if self._watch_store is None:
+            try:
+                from kubernetes import watch as k8s_watch
+            except ImportError:  # pragma: no cover - partial installs
+                return None
+            self._watch_store = _KubeJobWatch(self.custom_api, k8s_watch)
+        return self._watch_store
+
+
+class _KubeJobWatch:
+    """add_listener/remove_listener over the kubernetes package's watch
+    stream — the same interface the first-party stores expose
+    (k8s/rest.py, k8s/fake.py), so sdk.watch rides server-side events
+    on every backend.  One daemon thread serves all listeners; stream
+    errors deliver a GAP event (lost-events semantics: the consumer
+    re-reads, matching the RestCluster watch loop)."""
+
+    def __init__(self, custom_api, watch_module):
+        self._api = custom_api
+        self._watch = watch_module
+        self._listeners: list = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def _notify(self, etype: str, obj: dict) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(etype, obj)
+            except Exception:  # a broken listener must not kill the loop
+                logger.exception("watch listener failed")
+
+    def _loop(self) -> None:
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                if not rv:
+                    # LIST-then-WATCH: snapshot a resourceVersion, tell
+                    # consumers to re-read (GAP), then stream from the
+                    # snapshot — events between a consumer's own GET and
+                    # the stream opening cannot be lost (the re-read
+                    # covers up to the snapshot; the stream covers after)
+                    listing = self._api.list_cluster_custom_object(
+                        constants.GROUP_NAME, constants.VERSION,
+                        constants.PLURAL)
+                    rv = ((listing.get("metadata") or {})
+                          .get("resourceVersion")) or ""
+                    self._notify("GAP", {})
+                w = self._watch.Watch()
+                got_events = False
+                # cluster-wide stream; listeners filter by name/namespace
+                # (same contract as the first-party stores)
+                for event in w.stream(
+                        self._api.list_cluster_custom_object,
+                        constants.GROUP_NAME, constants.VERSION,
+                        constants.PLURAL,
+                        resource_version=rv or None,
+                        timeout_seconds=30):
+                    got_events = True
+                    obj = event.get("object") or {}
+                    meta = obj.get("metadata") or {}
+                    rv = meta.get("resourceVersion") or rv
+                    self._notify(event.get("type", ""), obj)
+                    if self._stop.is_set():
+                        break
+                # clean stream end (server-side timeout): resume from rv;
+                # pace empty streams so an instant-closing proxy can't
+                # turn this into a zero-delay reconnect storm
+                if not got_events:
+                    self._stop.wait(1.0)
+            except Exception as e:
+                # events (DELETEDs especially) may be gone for good —
+                # tell consumers so they re-read instead of waiting.
+                # Logged: a persistent failure (e.g. 403 on the
+                # cluster-wide watch under namespaced RBAC) must not be
+                # an invisible retry loop.
+                logger.warning("PyTorchJob watch stream failed "
+                               "(retrying in 1s): %s", e)
+                rv = ""
+                if not self._stop.is_set():
+                    self._notify("GAP", {})
+                self._stop.wait(1.0)
 
 
 class PyTorchJobClient:

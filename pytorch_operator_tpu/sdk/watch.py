@@ -8,12 +8,13 @@ subscribes to the backend job store's watch interface (``job_store()``
 on the backend adapter) — for RestCluster that is the real chunked-HTTP
 watch stream (k8s/rest.py add_listener, the same machinery the
 informers consume, native C++ ws_next or the Python fallback), for
-FakeCluster the in-memory listener bus.  A GAP event (stream error +
-relist semantics) re-reads the job so no terminal transition can be
-missed — including a deletion that happened during the outage, which
-reports as Deleted.  Polling survives only as the fallback for
-backends that expose no watch interface (the `kubernetes`-package
-adapter hides its streams behind CustomObjectsApi).
+FakeCluster the in-memory listener bus, and for the
+`kubernetes`-package backend a kubernetes.watch.Watch stream adapter
+(sdk/client.py _KubeJobWatch).  A GAP event (stream error + relist
+semantics) re-reads the job so no terminal transition can be missed —
+including a deletion that happened during the outage, which reports as
+Deleted.  Polling survives only as a last-resort fallback for backends
+that expose no watch interface at all.
 """
 
 from __future__ import annotations
@@ -49,7 +50,7 @@ def watch(client, name: str, namespace: str, timeout_seconds: int = 600,
           polling_interval: float = 2.0) -> None:
     job_store = getattr(client._backend, "job_store", lambda: None)
     store = job_store()
-    if store is None:  # kubernetes-package backend: no stream access
+    if store is None:  # no stream interface on this backend
         return _poll_watch(client, name, namespace, timeout_seconds,
                            polling_interval)
 
@@ -69,13 +70,15 @@ def watch(client, name: str, namespace: str, timeout_seconds: int = 600,
         print(_FMT.format(name, "Deleted", ""), flush=True)
 
     last = None
+    seen = False  # has the job ever been observed (get or event)?
     store.add_listener(on_event)
     try:
         deadline = time.monotonic() + timeout_seconds
         # initial state: the listener only sees events from now on
         try:
-            last, terminal = _emit_row(name, client.get(name, namespace),
-                                       last)
+            job = client.get(name, namespace)
+            seen = True
+            last, terminal = _emit_row(name, job, last)
             if terminal:
                 return
         except NotFoundError:
@@ -89,17 +92,21 @@ def watch(client, name: str, namespace: str, timeout_seconds: int = 600,
             except queue.Empty:
                 continue
             if etype == "GAP":
-                # stream error: events may have been lost — re-read;
-                # a job gone after the outage means the DELETED event
-                # was among the lost ones
+                # stream (re)established or errored: events may have
+                # been missed — re-read.  A job that was seen before
+                # and is gone now lost its DELETED in the gap; one
+                # never seen simply hasn't been created yet.
                 try:
                     obj = client.get(name, namespace)
                 except NotFoundError:
-                    deleted()
-                    return
+                    if seen:
+                        deleted()
+                        return
+                    continue
             elif etype == "DELETED":
                 deleted()
                 return
+            seen = True
             last, terminal = _emit_row(name, obj, last)
             if terminal:
                 return

@@ -120,14 +120,15 @@ class TestSdkUtils:
         assert sdk_utils.get_default_target_namespace() == "default"
 
 
-def test_watch_gap_with_deleted_job_reports_deleted(world, capsys):
+def test_watch_gap_with_deleted_job_reports_deleted(capsys):
     """A job deleted during a watch-stream outage must surface as
-    Deleted when the GAP re-read finds it gone — not hang to timeout
-    (round-4 review finding on sdk/watch.py)."""
-    client = PyTorchJobClient(cluster=world)
-    # the job is never created: to the GAP re-read this is exactly the
-    # deleted-during-outage state, without racing the fake kubelet
-    # driving a real job to Succeeded before the injected deletion
+    Deleted when the GAP re-read finds a previously-seen job gone — not
+    hang to timeout (round-4 review finding on sdk/watch.py).  A bare
+    FakeCluster (no controller/kubelet) keeps the job's state fully
+    under the test's control."""
+    cluster = FakeCluster()
+    client = PyTorchJobClient(cluster=cluster)
+    client.create(new_job(workers=0, name="gap-job").to_dict())
 
     done = {}
 
@@ -138,26 +139,64 @@ def test_watch_gap_with_deleted_job_reports_deleted(world, capsys):
         except Exception as e:  # pragma: no cover - surfaced below
             done["error"] = e
 
-    base_listeners = len(world.jobs._listeners)  # controller's informer
     t = threading.Thread(target=run, daemon=True)
     t.start()
     pause = threading.Event()
-    # wait for the WATCHER's listener (beyond the controller's), then
-    # delete + inject a GAP the way a stream error would deliver it
-    for _ in range(200):
-        if len(world.jobs._listeners) > base_listeners:
+    for _ in range(200):  # wait for the watcher to subscribe
+        if cluster.jobs._listeners:
             break
         pause.wait(0.05)
     else:
         pytest.fail("watcher never subscribed")
-    # deliver a GAP (stream error; any DELETED was lost in the outage)
-    for fn in list(world.jobs._listeners):
+    # delete bypassing events, then deliver only the GAP (the DELETED
+    # event was lost in the outage window)
+    with cluster.lock:
+        cluster.jobs._objects.pop(("default", "gap-job"), None)
+    for fn in list(cluster.jobs._listeners):
         fn("GAP", {})
     t.join(timeout=10)
     assert not t.is_alive(), "watch hung after GAP + deletion"
     assert done.get("ok"), done.get("error")
     out = capsys.readouterr().out
     assert "Deleted" in out
+
+
+def test_watch_gap_before_create_keeps_waiting(capsys):
+    """A GAP before the job has ever been observed (LIST-then-WATCH
+    emits one when the stream opens) must NOT report Deleted — the job
+    simply doesn't exist yet; creation events still complete the
+    watch."""
+    cluster = FakeCluster()
+    client = PyTorchJobClient(cluster=cluster)
+    done = {}
+
+    def run():
+        try:
+            client.get("late-job", watch=True, timeout_seconds=20)
+            done["ok"] = True
+        except Exception as e:  # pragma: no cover - surfaced below
+            done["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    pause = threading.Event()
+    for _ in range(200):
+        if cluster.jobs._listeners:
+            break
+        pause.wait(0.05)
+    for fn in list(cluster.jobs._listeners):
+        fn("GAP", {})  # stream (re)opened before the job exists
+    pause.wait(0.2)
+    assert t.is_alive(), "GAP before create must not end the watch"
+    job = new_job(workers=0, name="late-job")
+    created = client.create(job.to_dict())
+    created["status"] = {"conditions": [
+        {"type": "Succeeded", "status": "True", "lastTransitionTime": "t"}]}
+    cluster.jobs.update(created, subresource="status")
+    t.join(timeout=10)
+    assert not t.is_alive() and done.get("ok"), done.get("error")
+    out = capsys.readouterr().out
+    assert "Succeeded" in out and "Deleted" not in out
 
 
 def test_watch_table_output(world, capsys):

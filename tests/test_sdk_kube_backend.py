@@ -74,7 +74,8 @@ def _make_fake_kubernetes(cluster: FakeCluster, calls: list):
 
         def list_cluster_custom_object(self, group, version, plural):
             calls.append(("list_cluster", group, version, plural))
-            return {"items": cluster.resource(plural).list()}
+            return {"items": cluster.resource(plural).list(),
+                    "metadata": {"resourceVersion": "1"}}
 
         def patch_namespaced_custom_object(self, group, version, namespace,
                                            plural, name, body):
@@ -105,10 +106,30 @@ def _make_fake_kubernetes(cluster: FakeCluster, calls: list):
                 "annotations") or {}
             return annotations.get("fake.kubelet/logs", "")
 
+    class Watch:
+        """Fake kubernetes.watch.Watch: streams scripted events from
+        the module-level queue (one batch per stream() call; a None
+        batch raises to simulate a broken stream — the adapter must
+        emit GAP and reconnect)."""
+
+        def stream(self, list_fn, group, version, plural,
+                   resource_version=None, timeout_seconds=None):
+            calls.append(("watch_stream", group, version, plural,
+                          resource_version))
+            if not watch_batches:
+                # nothing scripted: behave like a server-side timeout
+                return iter(())
+            batch = watch_batches.pop(0)
+            if batch is None:
+                raise _ApiException(500, "stream broke")
+            return iter(batch)
+
+    watch_batches: list = []
     kubernetes = types.ModuleType("kubernetes")
     client_mod = types.ModuleType("kubernetes.client")
     rest_mod = types.ModuleType("kubernetes.client.rest")
     config_mod = types.ModuleType("kubernetes.config")
+    watch_mod = types.ModuleType("kubernetes.watch")
     client_mod.CustomObjectsApi = CustomObjectsApi
     client_mod.CoreV1Api = CoreV1Api
     rest_mod.ApiException = _ApiException
@@ -117,19 +138,24 @@ def _make_fake_kubernetes(cluster: FakeCluster, calls: list):
         ("load_kube_config", kw))
     config_mod.load_incluster_config = lambda: calls.append(
         ("load_incluster_config",))
+    watch_mod.Watch = Watch
     kubernetes.client = client_mod
     kubernetes.config = config_mod
-    return {"kubernetes": kubernetes,
+    kubernetes.watch = watch_mod
+    mods = {"kubernetes": kubernetes,
             "kubernetes.client": client_mod,
             "kubernetes.client.rest": rest_mod,
-            "kubernetes.config": config_mod}
+            "kubernetes.config": config_mod,
+            "kubernetes.watch": watch_mod}
+    return mods, watch_batches
 
 
 @pytest.fixture
 def kube_world(monkeypatch):
     cluster = FakeCluster()
     calls: list = []
-    for name, mod in _make_fake_kubernetes(cluster, calls).items():
+    mods, _batches = _make_fake_kubernetes(cluster, calls)
+    for name, mod in mods.items():
         monkeypatch.setitem(sys.modules, name, mod)
     from pytorch_operator_tpu.sdk.client import PyTorchJobClient
 
@@ -138,6 +164,22 @@ def kube_world(monkeypatch):
 
     assert isinstance(client._backend, _KubeBackend)
     return cluster, calls, client
+
+
+@pytest.fixture
+def kube_watch_world(monkeypatch):
+    cluster = FakeCluster()
+    calls: list = []
+    mods, batches = _make_fake_kubernetes(cluster, calls)
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    from pytorch_operator_tpu.sdk.client import PyTorchJobClient
+
+    client = PyTorchJobClient()
+    yield cluster, calls, client, batches
+    store = client._backend.job_store()
+    if store is not None:
+        store.stop()
 
 
 class TestKubeBackendRequestShaping:
@@ -213,3 +255,45 @@ class TestKubeBackendRequestShaping:
         job = client.wait_for_job("w", namespace="default",
                                   timeout_seconds=5, polling_interval=1)
         assert job["metadata"]["name"] == "w"
+
+
+class TestKubeBackendWatchStream:
+    """The kubernetes-package backend's watch adapter: sdk.watch rides
+    kubernetes.watch.Watch streams (the reference's
+    py_torch_job_watch.py:29-60 transport), with GAP + re-read on
+    stream errors, instead of the poll fallback."""
+
+    def _succeeded_event(self, name, rv="5"):
+        return {"type": "MODIFIED", "object": {
+            "metadata": {"name": name, "namespace": "default",
+                         "resourceVersion": rv},
+            "status": {"conditions": [
+                {"type": "Succeeded", "status": "True",
+                 "lastTransitionTime": "t1"}]}}}
+
+    def test_watch_completes_from_stream_events(self, kube_watch_world,
+                                                capsys):
+        cluster, calls, client, batches = kube_watch_world
+        cluster.jobs.create("default",
+                            new_job(workers=0, name="wk").to_dict())
+        batches.append([self._succeeded_event("wk")])
+        client.get("wk", namespace="default", watch=True,
+                   timeout_seconds=10)
+        out = capsys.readouterr().out
+        assert "wk" in out and "Succeeded" in out
+        assert any(c[0] == "watch_stream" for c in calls)
+
+    def test_stream_error_gap_rereads(self, kube_watch_world, capsys):
+        cluster, _calls, client, batches = kube_watch_world
+        cluster.jobs.create("default",
+                            new_job(workers=0, name="wg").to_dict())
+        # terminal transition happens while the stream is broken: the
+        # GAP re-read must observe it
+        cluster.jobs.set_status("default", "wg", {
+            "conditions": [{"type": "Succeeded", "status": "True",
+                            "lastTransitionTime": "t2"}]})
+        batches.append(None)  # first stream attempt raises
+        client.get("wg", namespace="default", watch=True,
+                   timeout_seconds=10)
+        out = capsys.readouterr().out
+        assert "Succeeded" in out
