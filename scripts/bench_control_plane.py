@@ -153,42 +153,19 @@ def run_sim(jobs: int, workers: int, variant: str = "native") -> dict:
         kubelet.stop()
 
 
-def run_http(jobs: int, workers: int, variant: str = "native") -> dict:
-    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+def run_http(jobs: int, workers: int, variant: str = "native",
+             n_streams: int = 0) -> dict:
+    """Reaction latency over real HTTP; optionally with N watch streams
+    PARKED on the same server.
 
-    _set_variant(variant)
-    srv = StubApiServer().start()
-    kubelet = FakeKubelet(srv.cluster)
-    kubelet.start()
-    rest = RestCluster(KubeConfig.from_url(f"http://127.0.0.1:{srv.port}"),
-                       namespace="default")
-    ctl = PyTorchController(rest, config=JobControllerConfig(),
-                            registry=Registry())
-    stop = threading.Event()
-    ctl.run(threadiness=4, stop_event=stop)
-    try:
-        # create and observe through the REST client: latencies include
-        # the same HTTP path the deployed operator uses
-        return bench_tier(rest, rest, jobs, workers)
-    finally:
-        stop.set()
-        ctl.work_queue.shutdown()
-        kubelet.stop()
-        srv.stop()
-
-
-def run_http_parked(jobs: int, workers: int, n_streams: int,
-                    variant: str = "native") -> dict:
-    """Reaction latency with N watch streams PARKED on the same server.
-
-    Round-3 verdict item 5: the native core's stated value is that a
-    blocked watch read holds no GIL (ws_next blocks in C++), so parked
-    streams shouldn't tax sync workers; the Python fallback's streams
-    block in http.client reads with periodic GIL re-entry.  This tier
-    measures that claim instead of asserting it: same bench as `http`,
-    but with ``n_streams`` extra watch streams held open on quiet
+    The parked tier is round-3 verdict item 5: the native core's stated
+    value is that a blocked watch read holds no GIL (ws_next blocks in
+    C++), so parked streams shouldn't tax sync workers; the Python
+    fallback's streams block in http.client reads with periodic GIL
+    re-entry.  ``n_streams`` extra watch streams sit open on quiet
     namespaces (each its own connection + reader thread, receiving no
-    events) for the entire measurement.
+    events) for the entire measurement, so the claim is measured
+    instead of asserted.
     """
     from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
 
@@ -213,6 +190,8 @@ def run_http_parked(jobs: int, workers: int, n_streams: int,
     stop = threading.Event()
     ctl.run(threadiness=4, stop_event=stop)
     try:
+        # create and observe through the REST client: latencies include
+        # the same HTTP path the deployed operator uses
         return bench_tier(rest, rest, jobs, workers)
     finally:
         stop.set()
@@ -445,8 +424,8 @@ def main() -> None:
                 print(f"[bench_cp] parked{n_streams}/{variant} "
                       f"({args.jobs} jobs)...", file=sys.stderr)
                 key = f"parked{n_streams}_{variant}"
-                results[key] = run_http_parked(
-                    args.jobs, args.workers, n_streams, variant)
+                results[key] = run_http(args.jobs, args.workers, variant,
+                                        n_streams=n_streams)
                 print(json.dumps({"tier": key, **results[key]}))
             print(f"[bench_cp] churn/{variant} ({args.churn_jobs} jobs)...",
                   file=sys.stderr)

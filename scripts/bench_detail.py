@@ -543,47 +543,13 @@ def bench_long_context(smoke: bool) -> list[dict]:
         B, D = 1, 128 if not smoke else 8
         block = _auto_block(T, D)
         scale = D ** -0.5
-        ks = jax.random.split(jax.random.key(0), 3)
-        q, k, v = (jax.random.normal(kk, (B * H, T, D), jnp.bfloat16)
-                   for kk in ks)
 
-        def _normed(x):
-            return (x / jnp.sqrt(jnp.mean(x.astype(jnp.float32) ** 2,
-                                          keepdims=True) + 1e-6)).astype(x.dtype)
-
-        def body(c, block=block):
-            qc, kc, vc = c
-            out, vjp = jax.vjp(
-                lambda a, b, cc: _flash(a, b, cc, scale, True, block, block,
-                                        jax.default_backend() != "tpu"),
-                qc, kc, vc)
-            dq, dk, dv = vjp(out)
-            return (_normed(dq), _normed(dk), _normed(dv))
-
-        # single-run timing with launch-cost subtraction (no two-point
-        # second compile — this is a feasibility headline, not an A/B):
-        # region is >=1s so the ~tens-of-ms launch cost is a few percent
-        # even before subtraction
-        import jax as _jax
-        from jax import lax as _lax
+        def attn(a, b, c, block=block, scale=scale):
+            return _flash(a, b, c, scale, True, block, block,
+                          jax.default_backend() != "tpu")
 
         iters = 2 if smoke else max(12, (32768 // T) * 12)
-
-        @_jax.jit
-        def _run(c):
-            out = _lax.scan(lambda cc, _: (body(cc), None), c, None,
-                            length=iters)[0]
-            return sum(jnp.sum(x.astype(jnp.float32))
-                       for x in _jax.tree_util.tree_leaves(out))
-
-        float(_run((q, k, v)))  # compile + warmup
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(_run((q, k, v)))
-            best = min(best, time.perf_counter() - t0)
-        t = max((best - (_launch_overhead() if not smoke else 0.0))
-                / iters, 1e-9)
+        t = _time_attn_fwdbwd(attn, (B * H, T, D), iters, smoke)
         rows.append({
             "shape": f"B{B} T{T} H{H} D{D} bf16 causal",
             "fwdbwd_flash_ms": round(t * 1e3, 1),
@@ -592,6 +558,49 @@ def bench_long_context(smoke: bool) -> list[dict]:
         })
     rows += _bench_tail_lengths(smoke)
     return rows
+
+
+def _time_attn_fwdbwd(attn_fn, shape, iters: int, smoke: bool) -> float:
+    """Seconds/iter for fwd+bwd of ``attn_fn`` over a scan-chained vjp.
+
+    Single-run timing with launch-cost subtraction (no two-point second
+    compile — these are feasibility headlines, not A/Bs): the region is
+    >=1s so the launch cost is a few percent even before subtraction.
+    Shared by the long-context and padded-tail rows so the two cannot
+    drift onto different methodologies.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+
+    def _normed(x):
+        return (x / jnp.sqrt(jnp.mean(x.astype(jnp.float32) ** 2,
+                                      keepdims=True) + 1e-6)).astype(x.dtype)
+
+    def body(c):
+        qc, kc, vc = c
+        out, vjp = jax.vjp(attn_fn, qc, kc, vc)
+        dq, dk, dv = vjp(out)
+        return (_normed(dq), _normed(dk), _normed(dv))
+
+    @jax.jit
+    def _run(c):
+        out = lax.scan(lambda cc, _: (body(cc), None), c, None,
+                       length=iters)[0]
+        return sum(jnp.sum(x.astype(jnp.float32))
+                   for x in jax.tree_util.tree_leaves(out))
+
+    float(_run((q, k, v)))  # compile + warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(_run((q, k, v)))
+        best = min(best, time.perf_counter() - t0)
+    return max((best - (_launch_overhead() if not smoke else 0.0))
+               / iters, 1e-9)
 
 
 def _bench_tail_lengths(smoke: bool) -> list[dict]:
@@ -603,51 +612,18 @@ def _bench_tail_lengths(smoke: bool) -> list[dict]:
     mask in-kernel, so e.g. T=16411 costs about the same as T=17408
     (the padded length) — flash speed, not dense impossibility.
     """
-    import jax
-    import jax.numpy as jnp
-
     from pytorch_operator_tpu.ops import flash_attention
 
     shapes = [(100, 2)] if smoke else [(16411, 8)]
     rows = []
     for T, H in shapes:
         B, D = 1, 128 if not smoke else 8
-        ks = jax.random.split(jax.random.key(0), 3)
-        q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
-                   for kk in ks)
 
-        def _normed(x):
-            return (x / jnp.sqrt(jnp.mean(x.astype(jnp.float32) ** 2,
-                                          keepdims=True) + 1e-6)).astype(x.dtype)
-
-        def body(c):
-            qc, kc, vc = c
-            out, vjp = jax.vjp(
-                lambda a, b, cc: flash_attention(a, b, cc, causal=True),
-                qc, kc, vc)
-            dq, dk, dv = vjp(out)
-            return (_normed(dq), _normed(dk), _normed(dv))
-
-        import jax as _jax
-        from jax import lax as _lax
+        def attn(a, b, c):
+            return flash_attention(a, b, c, causal=True)
 
         iters = 2 if smoke else 24
-
-        @_jax.jit
-        def _run(c):
-            out = _lax.scan(lambda cc, _: (body(cc), None), c, None,
-                            length=iters)[0]
-            return sum(jnp.sum(x.astype(jnp.float32))
-                       for x in _jax.tree_util.tree_leaves(out))
-
-        float(_run((q, k, v)))
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(_run((q, k, v)))
-            best = min(best, time.perf_counter() - t0)
-        t = max((best - (_launch_overhead() if not smoke else 0.0))
-                / iters, 1e-9)
+        t = _time_attn_fwdbwd(attn, (B, T, H, D), iters, smoke)
         rows.append({
             "shape": f"B{B} T{T} H{H} D{D} bf16 causal (non-multiple tail)",
             "fwdbwd_flash_ms": round(t * 1e3, 1),

@@ -120,34 +120,38 @@ class TestSdkUtils:
         assert sdk_utils.get_default_target_namespace() == "default"
 
 
-def test_watch_gap_with_deleted_job_reports_deleted(capsys):
-    """A job deleted during a watch-stream outage must surface as
-    Deleted when the GAP re-read finds a previously-seen job gone — not
-    hang to timeout (round-4 review finding on sdk/watch.py).  A bare
-    FakeCluster (no controller/kubelet) keeps the job's state fully
-    under the test's control."""
-    cluster = FakeCluster()
-    client = PyTorchJobClient(cluster=cluster)
-    client.create(new_job(workers=0, name="gap-job").to_dict())
-
-    done = {}
+def _start_watch(client, cluster, name, timeout_seconds=20):
+    """Run client.get(watch=True) on a thread; return (thread, result)
+    once the watcher's listener is subscribed.  A bare FakeCluster (no
+    controller/kubelet) keeps the job's state under the test's
+    control."""
+    done: dict = {}
 
     def run():
         try:
-            client.get("gap-job", watch=True, timeout_seconds=20)
+            client.get(name, watch=True, timeout_seconds=timeout_seconds)
             done["ok"] = True
-        except Exception as e:  # pragma: no cover - surfaced below
+        except Exception as e:  # pragma: no cover - surfaced by callers
             done["error"] = e
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     pause = threading.Event()
-    for _ in range(200):  # wait for the watcher to subscribe
+    for _ in range(200):
         if cluster.jobs._listeners:
-            break
+            return t, done
         pause.wait(0.05)
-    else:
-        pytest.fail("watcher never subscribed")
+    pytest.fail("watcher never subscribed")
+
+
+def test_watch_gap_with_deleted_job_reports_deleted(capsys):
+    """A job deleted during a watch-stream outage must surface as
+    Deleted when the GAP re-read finds a previously-seen job gone — not
+    hang to timeout (round-4 review finding on sdk/watch.py)."""
+    cluster = FakeCluster()
+    client = PyTorchJobClient(cluster=cluster)
+    client.create(new_job(workers=0, name="gap-job").to_dict())
+    t, done = _start_watch(client, cluster, "gap-job")
     # delete bypassing events, then deliver only the GAP (the DELETED
     # event was lost in the outage window)
     with cluster.lock:
@@ -168,28 +172,12 @@ def test_watch_gap_before_create_keeps_waiting(capsys):
     watch."""
     cluster = FakeCluster()
     client = PyTorchJobClient(cluster=cluster)
-    done = {}
-
-    def run():
-        try:
-            client.get("late-job", watch=True, timeout_seconds=20)
-            done["ok"] = True
-        except Exception as e:  # pragma: no cover - surfaced below
-            done["error"] = e
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    pause = threading.Event()
-    for _ in range(200):
-        if cluster.jobs._listeners:
-            break
-        pause.wait(0.05)
+    t, done = _start_watch(client, cluster, "late-job")
     for fn in list(cluster.jobs._listeners):
         fn("GAP", {})  # stream (re)opened before the job exists
-    pause.wait(0.2)
+    threading.Event().wait(0.2)
     assert t.is_alive(), "GAP before create must not end the watch"
-    job = new_job(workers=0, name="late-job")
-    created = client.create(job.to_dict())
+    created = client.create(new_job(workers=0, name="late-job").to_dict())
     created["status"] = {"conditions": [
         {"type": "Succeeded", "status": "True", "lastTransitionTime": "t"}]}
     cluster.jobs.update(created, subresource="status")
