@@ -41,12 +41,14 @@ def world():
     ctl = PyTorchController(ctl_cluster, config=JobControllerConfig(),
                             registry=Registry())
     stop = threading.Event()
-    ctl.run(threadiness=2, stop_event=stop)
+    workers = ctl.run(threadiness=2, stop_event=stop)
     try:
         yield stub
     finally:
         stop.set()
         ctl.work_queue.shutdown()
+        for w in workers:  # drain in-flight reconciles before the stub
+            w.join(timeout=5)  # dies, so teardown can't log bogus I/O
         kubelet.stop()
         ctl_cluster.close()
         stub.stop()
