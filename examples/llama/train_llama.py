@@ -47,8 +47,10 @@ def main() -> int:
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches when --pp is set")
     parser.add_argument("--sp", type=int, default=0,
-                        help="sequence-parallel degree for long contexts "
-                             "(must equal the device count)")
+                        help="sequence-parallel degree for long contexts; "
+                             "composes with --dp/--fsdp (dp*fsdp*sp must "
+                             "equal the device count; --fsdp adds ZeRO-3 "
+                             "param sharding — the 7B v5p-128 layout)")
     parser.add_argument("--sp-impl", choices=["ulysses", "ring"],
                         default="ulysses",
                         help="attention strategy under --sp: all-to-all "
@@ -153,24 +155,38 @@ def main() -> int:
     if args.pp and args.sp:
         parser.error("--pp and --sp are mutually exclusive layouts")
     if args.sp:
-        if args.dp or args.fsdp or args.tp:
-            parser.error("--sp is a pure sequence-parallel layout; it "
-                         "cannot be combined with --dp/--fsdp/--tp")
-        if args.sp != n:
-            parser.error(f"--sp {args.sp} != {n} devices")
+        # SP composes with --dp and --fsdp (round 5): params + optimizer
+        # state ZeRO-3-shard over fsdp, sequence over sp, batch over
+        # dp×fsdp — the Llama-2-7B v5p-128 layout (BASELINE.md config 5,
+        # e.g. --fsdp 16 --sp 8).  tp stays exclusive of sp.
+        if args.tp:
+            parser.error("--sp cannot be combined with --tp")
+        sp_dp, sp_fsdp = args.dp or 1, args.fsdp or 1
+        if sp_dp * sp_fsdp * args.sp != n:
+            parser.error(f"--dp*--fsdp*--sp = {sp_dp * sp_fsdp * args.sp} "
+                         f"!= {n} devices")
         if args.seq_len % args.sp:
             parser.error(f"--seq-len {args.seq_len} not divisible by --sp")
+        if args.batch_size % (sp_dp * sp_fsdp):
+            # mesh.data_axes would silently drop the batch sharding (every
+            # chip pays full-batch activations, dp replicas duplicate
+            # work) — reject up front like every other layout mismatch
+            parser.error(f"--batch-size {args.batch_size} not divisible "
+                         f"by --dp*--fsdp = {sp_dp * sp_fsdp}")
         if args.sp_impl == "ulysses" and cfg.n_heads % args.sp:
             parser.error(f"n_heads {cfg.n_heads} not divisible by --sp "
                          f"(use --sp-impl ring)")
         from pytorch_operator_tpu.parallel import make_sp_train_step
         from pytorch_operator_tpu.parallel.mesh import make_sp_mesh
 
-        mesh = make_sp_mesh(dp=1, sp=args.sp)
-        print(f"[worker {pid}/{nprocs}] sequence-parallel mesh sp={args.sp} "
-              f"({args.sp_impl}) over {n} devices", flush=True)
-        state = sharded_init(cfg, mesh, optimizer,
-                             specs=llama.sp_param_specs(cfg))
+        mesh = make_sp_mesh(dp=sp_dp, sp=args.sp, fsdp=sp_fsdp)
+        specs = (llama.sp_fsdp_param_specs(cfg) if sp_fsdp > 1
+                 else llama.sp_param_specs(cfg))
+        print(f"[worker {pid}/{nprocs}] sequence-parallel mesh "
+              f"dp={sp_dp} fsdp={sp_fsdp} sp={args.sp} "
+              f"({args.sp_impl}{', zero-3 params' if sp_fsdp > 1 else ''}) "
+              f"over {n} devices", flush=True)
+        state = sharded_init(cfg, mesh, optimizer, specs=specs)
         step_fn = make_sp_train_step(cfg, mesh, optimizer,
                                      impl=args.sp_impl,
                                      chunked_ce=args.chunked_ce,

@@ -368,6 +368,16 @@ def forward_sp(
       impl="ring"     K/V rotation with online softmax
                       (parallel/ring_attention.py; any head count)
 
+    Composes with FSDP and pure DP: when the mesh also carries dp/fsdp
+    axes (parallel.mesh.make_sp_mesh(..., fsdp=n)), the batch dim of
+    every activation shards over them (parallel.mesh.data_axes decides
+    which divide B) and the attention shard_maps carry the same batch
+    sharding through their in/out specs.  Pair with
+    ``sp_fsdp_param_specs`` to additionally shard params + optimizer
+    state over fsdp — the Llama-2-7B v5p-128 north-star layout
+    (BASELINE.md config 5): weights ZeRO-3-sharded over fsdp, sequence
+    over sp, batch over dp×fsdp.
+
     GQA-native: the ring always rotates UNREPEATED K/V chunks (ICI
     traffic / group), and ulysses shards the kv heads through its
     all-to-all when n_kv_heads divides the sp axis; when it doesn't,
@@ -378,11 +388,14 @@ def forward_sp(
     weights.  Reference scope: the reference scales only DP replica
     count (SURVEY §2.4); long-context is a TPU-build extension (§5).
     """
+    from pytorch_operator_tpu.parallel.mesh import data_axes
     from pytorch_operator_tpu.parallel.ring_attention import ring_attention
     from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
 
     if impl not in ("ulysses", "ring"):
         raise ValueError(f"unknown sp impl {impl!r}")
+
+    batch_axes = data_axes(mesh, tokens.shape[0])
 
     def attn(q, k, v, cfg):
         # Both SP strategies are GQA-native: the ring rotates unrepeated
@@ -404,16 +417,19 @@ def forward_sp(
             v = jnp.repeat(v, r, axis=2)
         if impl == "ulysses":
             return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
-                                     use_flash=cfg.use_flash)
-        return ring_attention(q, k, v, mesh, axis_name=axis_name).astype(q.dtype)
+                                     use_flash=cfg.use_flash,
+                                     batch_axes=batch_axes)
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              batch_axes=batch_axes).astype(q.dtype)
 
     def apply_stack(layers, h, body):
-        # pin the (B, T, D) activations to the sequence-sharded layout;
-        # GSPMD propagates it through every pointwise/matmul op, so the
-        # memory-heavy tensors live T/n per device (the token ints stay
-        # replicated — they're negligible and T+1 is ragged)
+        # pin the (B, T, D) activations to the sequence-sharded layout
+        # (batch over the dp/fsdp data axes, sequence over sp); GSPMD
+        # propagates it through every pointwise/matmul op, so the
+        # memory-heavy tensors live B/(dp·fsdp) × T/sp per device (the
+        # token ints stay replicated — negligible and T+1 is ragged)
         h = lax.with_sharding_constraint(
-            h, NamedSharding(mesh, P(None, axis_name, None)))
+            h, NamedSharding(mesh, P(batch_axes or None, axis_name, None)))
         return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
 
     return _forward_with(params, tokens, cfg, apply_stack, attn=attn,
@@ -422,9 +438,44 @@ def forward_sp(
 
 def sp_param_specs(cfg: LlamaConfig) -> Params:
     """Fully replicated parameter specs for the sequence-parallel layout
-    (SP shards activations over the sp axis, never the weights)."""
+    (SP shards activations over the sp axis, never the weights).  For
+    configs whose params + optimizer state exceed one chip's HBM, use
+    ``sp_fsdp_param_specs`` on a (dp, fsdp, sp) mesh instead."""
     return jax.tree.map(lambda _: P(), param_specs(cfg),
                         is_leaf=lambda x: isinstance(x, P))
+
+
+def sp_fsdp_param_specs(cfg: LlamaConfig) -> Params:
+    """ZeRO-3 parameter specs for the SP×FSDP composition: every weight
+    shards its model-dim axis over fsdp; norms replicate (negligible).
+
+    This is the layout that makes BASELINE.md config 5 (Llama-2-7B on a
+    v5p-128 slice) expressible: 7B params × ~14 bytes of param+AdamW
+    state (~98 GB) do not fit one chip, so the weights and optimizer
+    state live 1/fsdp per chip (XLA all-gathers each layer's weights on
+    use, reduce-scatters its grads) while the long sequence shards over
+    sp (llama.forward_sp) and the batch over dp×fsdp.  Pair with
+    parallel.mesh.make_sp_mesh(dp, sp, fsdp=n) and
+    parallel.train.make_sp_train_step; init via
+    sharded_init(..., specs=llama.sp_fsdp_param_specs(cfg)).
+    """
+    del cfg
+    fsdp = AXIS_FSDP
+    return {
+        "embed": P(None, fsdp),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fsdp, None),
+            "wk": P(None, fsdp, None),
+            "wv": P(None, fsdp, None),
+            "wo": P(None, None, fsdp),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fsdp, None),
+            "w_up": P(None, fsdp, None),
+            "w_down": P(None, None, fsdp),
+        },
+        "final_norm": P(None),
+    }
 
 
 def pp_param_specs(cfg: LlamaConfig, axis_name: str = "pp") -> Params:

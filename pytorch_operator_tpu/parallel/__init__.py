@@ -17,6 +17,7 @@ from pytorch_operator_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
     batch_spec,
+    data_axes,
     factor_devices,
     make_mesh,
     make_named_mesh,
@@ -41,6 +42,7 @@ __all__ = [
     "AXIS_SP",
     "AXIS_TP",
     "batch_spec",
+    "data_axes",
     "factor_devices",
     "make_mesh",
     "make_named_mesh",

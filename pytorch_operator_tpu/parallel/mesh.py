@@ -14,6 +14,8 @@ mesh gives for free via GSPMD — see SURVEY.md §2.4.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 import jax
@@ -66,15 +68,42 @@ def make_mesh(
     return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_TP))
 
 
-def make_sp_mesh(dp: int = 1, sp: int = 1, *, devices=None) -> Mesh:
-    """Build a (dp, sp) mesh for ring-attention sequence parallelism."""
+def make_sp_mesh(dp: int = 1, sp: int = 1, *, fsdp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, fsdp, sp) mesh for sequence-parallel training.
+
+    ``fsdp`` composes ZeRO-3 weight sharding with sequence parallelism —
+    the layout the Llama-2-7B v5p-128 flagship config needs (BASELINE.md
+    config 5): parameters + optimizer state sharded over fsdp
+    (llama.sp_fsdp_param_specs), activations sharded over sp, batch over
+    dp×fsdp.  The sp axis is innermost so ring ppermutes / Ulysses
+    all-to-alls ride ICI neighbours.
+    """
     if devices is None:
         devices = jax.devices()
-    n = dp * sp
+    n = dp * fsdp * sp
     if len(devices) < n:
-        raise ValueError(f"mesh ({dp},{sp}) needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, sp)
-    return Mesh(arr, (AXIS_DP, AXIS_SP))
+        raise ValueError(
+            f"mesh ({dp},{fsdp},{sp}) needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp)
+    return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_SP))
+
+
+def data_axes(mesh: Mesh, batch_size: int | None = None) -> tuple[str, ...]:
+    """Mesh axes a (B, ...) batch shards over: the data-parallel subset
+    of (dp, fsdp) present in ``mesh``.
+
+    With ``batch_size`` given, trailing axes are dropped until the axis
+    product divides B — shard_map and jit in_shardings need exact
+    tiling, and a batch too small for dp×fsdp still shards over dp
+    (params stay fsdp-sharded either way; the batch just replicates
+    over fsdp, plain ZeRO semantics).
+    """
+    axes = [a for a in (AXIS_DP, AXIS_FSDP) if a in mesh.axis_names]
+    if batch_size is not None:
+        while axes and batch_size % math.prod(
+                mesh.shape[a] for a in axes):
+            axes.pop()
+    return tuple(axes)
 
 
 def make_named_mesh(axes: dict, *, devices=None) -> Mesh:

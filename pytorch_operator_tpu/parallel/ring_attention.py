@@ -148,6 +148,7 @@ def ring_attention(
     *,
     axis_name: str = "sp",
     causal: bool = True,
+    batch_axes: tuple[str, ...] = (),
 ) -> jax.Array:
     """Exact causal attention with sequence sharded over ``axis_name``.
 
@@ -177,7 +178,11 @@ def ring_attention(
     t_local = T // sp
     block = _exact_block(t_local, Dh)
     interpret = jax.default_backend() != "tpu"
-    spec = P(None, axis_name, None, None)
+    # batch_axes: data-parallel mesh axes (dp/fsdp) the batch dim is
+    # sharded over — the SP×FSDP composition (llama.forward_sp passes
+    # parallel.mesh.data_axes) — the ring itself only ever rotates over
+    # ``axis_name``; batch stays embarrassingly parallel.
+    spec = P(batch_axes or None, axis_name, None, None)
     fn = jax.shard_map(
         partial(
             _ring_body, axis_name=axis_name, causal=causal,

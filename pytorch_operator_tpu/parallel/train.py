@@ -199,11 +199,18 @@ def make_sp_train_step(
 
     Batch is (B, T+1) tokens, replicated — T+1 is ragged against the sp
     axis and token ints are negligible; llama.forward_sp pins the (B, T,
-    D) activations to the sequence-sharded layout, which is where the
-    memory lives.  Attention runs the chosen strategy (ulysses | ring);
-    params replicate (pair with ``sharded_init(..., specs=
-    llama.sp_param_specs(cfg))``); gradients of the replicated params
-    are reduced by the collectives GSPMD inserts, like the dp path.
+    D) activations to the sequence-sharded layout (batch over the
+    mesh's dp/fsdp axes, sequence over sp), which is where the memory
+    lives.  Attention runs the chosen strategy (ulysses | ring).
+
+    Parameter layout is the init's choice, not this function's: pair
+    with ``sharded_init(..., specs=llama.sp_param_specs(cfg))`` for
+    replicated weights, or — the Llama-7B v5p-128 north-star layout —
+    ``specs=llama.sp_fsdp_param_specs(cfg)`` on a
+    ``make_sp_mesh(dp, sp, fsdp=n)`` mesh for ZeRO-3 weights + SP
+    activations + dp×fsdp batch.  Either way gradients come back in the
+    params' own sharding via the collectives GSPMD inserts (all-reduce
+    for replicated, reduce-scatter for fsdp-sharded).
     ``chunked_ce`` applies the tied head per T-chunk on the (already
     T/n-per-device) hidden states — SP shrinks the resident logits by
     the axis degree, chunking bounds the transient too.

@@ -78,6 +78,7 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     use_flash: bool = False,
+    batch_axes: tuple[str, ...] = (),
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
@@ -107,7 +108,10 @@ def ulysses_attention(
         raise ValueError(f"{Hk} kv heads not divisible by {axis_name}={n} "
                          f"(broadcast KV heads to a multiple of the axis, "
                          f"or use ring_attention)")
-    spec = P(None, axis_name, None, None)
+    # batch_axes: data-parallel mesh axes (dp/fsdp) the batch dim is
+    # sharded over (the SP×FSDP composition); the all-to-alls move only
+    # the ``axis_name`` shards, batch stays embarrassingly parallel
+    spec = P(batch_axes or None, axis_name, None, None)
     fn = jax.shard_map(
         partial(_ulysses_body, axis_name=axis_name, causal=causal,
                 scale=Dh ** -0.5, use_flash=use_flash),

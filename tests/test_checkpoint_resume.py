@@ -47,6 +47,18 @@ def test_profile_trace_written(tmp_path):
     assert traces, "profile dir is empty"
 
 
+def test_sp_fsdp_cli_layout(tmp_path):
+    """--sp composes with --fsdp from the CLI (round 5): the ZeRO-3 +
+    sequence-parallel layout boots, trains and checkpoints."""
+    out = _run(steps=2, extra_args=[
+        "--sp", "2", "--fsdp", "2", "--sp-impl", "ulysses",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--checkpoint-every", "2"])
+    assert "fsdp=2 sp=2" in out and "zero-3 params" in out
+    assert "checkpointed step 2" in out
+    assert "training complete" in out
+
+
 def test_checkpoint_then_resume(tmp_path):
     ckpt = ["--checkpoint-dir", str(tmp_path / "ckpt"),
             "--checkpoint-every", "2"]

@@ -1,14 +1,22 @@
 """Perf regression guard for the fused-RMSNorm model-step claim.
 
-BENCH_DETAIL.md documents that use_fused_norm=True makes the Llama
-train step ~10% faster at d2048 on TPU.  This test enforces the claim's
-floor — a fused step must not be slower than the unfused one beyond a
-noise band — so a kernel or dispatch regression fails the suite instead
-of silently surviving until someone re-runs the bench by hand.
+BENCH_DETAIL.md §3 documents that use_fused_norm=True makes the Llama
+train step ~10% faster at d2048 on TPU.  Round 5 (verdict item 7): the
+guard asserts the WIN, not a tolerance band — the fused median must be
+<= 1.0x the unfused median, so the claim failing to materialise fails
+the suite instead of silently surviving inside a 15% allowance.
 
-The suite's conftest pins JAX to a virtual CPU mesh, so the timing runs
-in a subprocess with the CPU override stripped; the test skips when
-that subprocess finds no TPU (CI without hardware).
+Measurement follows test_perf_flash.py exactly:
+  * two-point scan-chained timing ((t(2N) - t(N)) / N) so the
+    launch-overhead of the device tunnel cancels instead of
+    compressing the A/B ratio;
+  * fused and unfused run in INTERLEAVED windows (ABAB...) so a load
+    spike on the shared chip hits both variants; verdict = median;
+  * a failing ratio WITH high window dispersion (the contention
+    signature) triggers one full re-measure before the failure stands;
+  * both raw series are printed on failure.
+
+Subprocess escapes the suite's CPU pin; skips without hardware.
 """
 
 import json
@@ -19,7 +27,7 @@ import sys
 import pytest
 
 _PAYLOAD = r"""
-import json, time
+import json, statistics, time
 import jax
 import jax.numpy as jnp
 
@@ -33,7 +41,7 @@ from pytorch_operator_tpu.models import llama
 from pytorch_operator_tpu.parallel.train import cross_entropy_loss
 from functools import partial
 
-def make_step(use_fused_norm):
+def make_runner(use_fused_norm, iters):
     cfg = llama.LlamaConfig(
         vocab_size=32000, dim=2048, n_layers=4, n_heads=16,
         n_kv_heads=16, ffn_dim=5632, max_seq_len=1024,
@@ -45,41 +53,79 @@ def make_step(use_fused_norm):
     tokens = jax.random.randint(jax.random.key(1), (1, 1025), 0,
                                 cfg.vocab_size)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens):
+    def step(carry, _):
+        params, opt_state = carry
         def loss(p):
             logits = llama.forward(p, tokens[:, :-1], cfg)
             return cross_entropy_loss(logits, tokens[:, 1:])
         l, grads = jax.value_and_grad(loss)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, l
+        return (optax.apply_updates(params, updates), opt_state), l
 
-    state = [params, opt_state]
+    def make_run(length):
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(carry):
+            carry, losses = jax.lax.scan(step, carry, None, length=length)
+            return carry, losses[-1]
+        return run
 
-    def run(n):
-        for _ in range(n):
-            state[0], state[1], l = step(state[0], state[1], tokens)
-        float(l)
+    run1, run2 = make_run(iters), make_run(2 * iters)
+    state = (params, opt_state)
 
-    run(2)  # compile + warmup
-    return run
-
-# Alternate fused/unfused measurement windows (ABAB...) so a transient
-# load spike on the shared chip hits both variants, not just one.
-runners = {"fused": make_step(True), "unfused": make_step(False)}
-best = {"fused": float("inf"), "unfused": float("inf")}
-for _round in range(3):
-    for name, run in runners.items():
+    def timed():
+        # two-point: the fixed per-launch tunnel cost cancels in the
+        # subtraction (scripts/bench_detail.py's _time_scanned method)
+        nonlocal state
         t0 = time.perf_counter()
-        run(30)
-        best[name] = min(best[name], (time.perf_counter() - t0) / 30)
-print(json.dumps({"fused_ms": best["fused"] * 1e3,
-                  "unfused_ms": best["unfused"] * 1e3}))
+        state, l = run1(state)
+        float(l)
+        t1 = time.perf_counter()
+        state, l = run2(state)
+        float(l)
+        t2 = time.perf_counter()
+        two_pt = ((t2 - t1) - (t1 - t0)) / iters
+        if two_pt > 0:
+            return two_pt
+        # a contention spike in the run1 window can push the subtraction
+        # non-positive; a non-positive sample would corrupt the medians
+        # (a negative fused median "passes" any ratio check).  Fall back
+        # to the launch-inclusive average for this window — always
+        # positive, slightly pessimistic, damped by the median.
+        return (t2 - t0) / (3 * iters)
+
+    timed()  # compile both lengths + warmup
+    return timed
+
+runners = {"fused": make_runner(True, 8),
+           "unfused": make_runner(False, 8)}
+
+def measure(rounds=5):
+    series = {"fused": [], "unfused": []}
+    for _ in range(rounds):
+        for name, timed in runners.items():  # interleaved ABAB windows
+            series[name].append(timed())
+    med = {n: statistics.median(s) for n, s in series.items()}
+    disp = {n: (max(s) - min(s)) / med[n] for n, s in series.items()}
+    return {"ratio": med["fused"] / med["unfused"],
+            "fused_ms": med["fused"] * 1e3,
+            "unfused_ms": med["unfused"] * 1e3,
+            "dispersion": disp,
+            "series_ms": {n: [round(t * 1e3, 3) for t in s]
+                          for n, s in series.items()}}
+
+result = measure()
+if result["ratio"] > 1.0 and max(result["dispersion"].values()) > 0.4:
+    # contention signature: noisy windows AND a failing ratio — one
+    # full re-measure before letting the failure stand
+    retry = measure()
+    retry["retried_after"] = result
+    result = retry
+print(json.dumps(result))
 """
 
 
 @pytest.mark.perf
-def test_fused_norm_model_step_not_slower():
+def test_fused_norm_model_step_is_faster():
     env = dict(os.environ)
     # undo the conftest's CPU pin so the child sees the real chip —
     # strip only the conftest-appended flag, preserving any flags the
@@ -100,9 +146,16 @@ def test_fused_norm_model_step_not_slower():
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     if "skip" in result:
         pytest.skip(result["skip"])
-    fused, unfused = result["fused_ms"], result["unfused_ms"]
-    # the claim is "fused is faster"; the enforced floor is "fused is
-    # not slower beyond shared-chip noise" (15% band)
-    assert fused <= unfused * 1.15, (
-        f"fused-norm model step regressed: {fused:.2f}ms fused vs "
-        f"{unfused:.2f}ms unfused")
+    # the claim is "fused is faster"; the guard asserts exactly that:
+    # fused median <= unfused median (contention already handled by the
+    # interleave + re-measure above)
+    assert result["ratio"] <= 1.0, (
+        f"use_fused_norm=True stopped being faster: fused "
+        f"{result['fused_ms']:.2f}ms vs unfused "
+        f"{result['unfused_ms']:.2f}ms (ratio {result['ratio']:.3f}; "
+        f"BENCH_DETAIL §3 claims ~10% win).  Raw interleaved series "
+        f"(ms): {json.dumps(result['series_ms'])}; dispersion "
+        f"{result['dispersion']}"
+        + (f"; first attempt (re-measured due to contention): "
+           f"{json.dumps(result['retried_after']['series_ms'])}"
+           if "retried_after" in result else ""))
