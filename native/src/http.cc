@@ -315,8 +315,21 @@ bool read_body(Conn& conn, Response* resp, const std::string& leftover) {
     // indistinguishable from a mid-body truncation by an on-path
     // attacker, so it fails the request rather than silently
     // forfeiting TLS truncation protection.  Length-checked framings
-    // above detect truncation on their own.
-    if (n < 0) return false;
+    // above detect truncation on their own.  Known cost (advisor r4):
+    // peers that close unframed responses with a bare FIN — some
+    // proxies do — are rejected; every supported peer (kube-apiserver,
+    // the stub server) length-frames its responses, so the strict
+    // reading wins.  The reason is recorded so a failing request says
+    // why instead of a bare protocol error.
+    if (n < 0) {
+      if (n == tpuop::kTlsRecvRaggedEof) {
+        g_last_error =
+            "ragged TLS EOF in read-to-EOF body: peer sent FIN without "
+            "close_notify, indistinguishable from truncation, response "
+            "rejected";
+      }
+      return false;
+    }
     if (n == 0) return true;
     resp->body.append(tmp, static_cast<size_t>(n));
   }

@@ -89,12 +89,16 @@ class FakeKubelet:
             ],
         }
         try:
-            self.cluster.pods.set_status(ns, name, status)
+            # logs BEFORE the terminal status: a process writes its
+            # output and then exits, and follow-mode log streams close
+            # on the terminal phase — writing the text first guarantees
+            # a tailer sees the final lines before the stream ends
             log_text = self.logs(pod, phase, exit_code)
             if log_text:
                 self.cluster.pods.patch(ns, name, {
                     "metadata": {"annotations": {"fake.kubelet/logs": log_text}}
                 })
+            self.cluster.pods.set_status(ns, name, status)
         except NotFoundError:
             pass
 

@@ -245,6 +245,47 @@ class RestClient:
         finally:
             conn.close()
 
+    def stream_text_lines(self, method: str, path: str):
+        """Stream a plain-text response line by line (generator).
+
+        Serves the pod-log follow endpoint: the server holds the
+        connection open (chunked transfer) and appends text as the
+        workload writes it; each complete ``\\n``-terminated line is
+        yielded as it arrives, an unterminated tail is flushed at EOF.
+
+        Always rides http.client, even when the native C++ transport is
+        available: the native line-stream implements WATCH framing
+        (blank keep-alive lines are deliberately skipped), which would
+        silently drop empty log lines — and log tailing is byte-rate
+        bound by the workload, not the transport, so there is nothing
+        for the native path to win here.
+        """
+        from pytorch_operator_tpu.utils.util import iter_log_lines
+
+        conn = self._connect(timeout=300.0)
+        try:
+            conn.request(method, path, headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                self._raise_for(resp.status, resp.read())
+
+            def chunks():
+                while True:
+                    try:
+                        chunk = resp.read1(65536)
+                    except TimeoutError:
+                        # a quiet pod (no output for >300s) is normal
+                        # mid-tail, not an error: the socket timed out
+                        # with no data, the stream itself is fine
+                        continue
+                    if not chunk:
+                        return
+                    yield chunk
+
+            yield from iter_log_lines(chunks())
+        finally:
+            conn.close()
+
     @staticmethod
     def _raise_for(status: int, data: bytes):
         try:
@@ -528,6 +569,14 @@ class RestCluster:
         """GET .../pods/{name}/log (plain text)."""
         return self.client.request_text(
             "GET", f"/api/v1/namespaces/{namespace}/pods/{name}/log")
+
+    def read_pod_log_stream(self, namespace: str, name: str):
+        """GET .../pods/{name}/log?follow=true — yields log lines live
+        until the pod terminates and the server ends the stream (the
+        reference SDK's follow path, py_torch_job_client.py:359-386)."""
+        return self.client.stream_text_lines(
+            "GET",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/log?follow=true")
 
     def check_crd_exists(self) -> bool:
         """server.go:201-213 — verify the PyTorchJob CRD is served.

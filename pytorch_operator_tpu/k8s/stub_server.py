@@ -16,6 +16,7 @@ import queue
 import re
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -93,6 +94,9 @@ class StubApiServer:
                 store, ns, name, sub, q = r
                 try:
                     if name and sub == "log":
+                        if q.get("follow", ["false"])[0] == "true":
+                            self._follow_log(store, ns, name)
+                            return
                         obj = store.get(ns, name)
                         annotations = (obj.get("metadata") or {}).get(
                             "annotations") or {}
@@ -122,6 +126,89 @@ class StubApiServer:
                     self._send(200, {"kind": "List", "items": items})
                 except ApiError as e:
                     self._error(e)
+
+            def _follow_log(self, store, ns, name):
+                """GET .../pods/{name}/log?follow=true — chunked text
+                stream of the pod's log annotation as it grows, ending
+                (0-chunk) when the pod reaches a terminal phase or is
+                deleted.  The kube-apiserver behaviour the SDK's
+                get_logs(follow=True) tails (reference:
+                py_torch_job_client.py:359-386 passes follow through to
+                read_namespaced_pod_log)."""
+                events: "queue.Queue" = queue.Queue()
+                listener = lambda et, obj: events.put((et, obj))
+                # subscribe BEFORE the initial read: growth between the
+                # read and the stream start is re-delivered as events and
+                # deduplicated by byte offset
+                store.add_listener(listener)
+                try:
+                    try:
+                        pod = store.get(ns, name)
+                    except ApiError as e:
+                        self._error(e)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    sent = 0
+
+                    def push(p):
+                        nonlocal sent
+                        text = ((p.get("metadata") or {}).get(
+                            "annotations") or {}).get("fake.kubelet/logs", "")
+                        if len(text) > sent:
+                            data = text[sent:].encode()
+                            sent = len(text)
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                            self.wfile.flush()
+
+                    def terminal(p):
+                        return ((p.get("status") or {}).get("phase")) in (
+                            "Succeeded", "Failed")
+
+                    push(pod)
+                    done = terminal(pod)
+                    while not done and not (outer._stopping.is_set()
+                                            or outer._drop_watch.is_set()):
+                        try:
+                            et, obj = events.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        meta = obj.get("metadata") or {}
+                        if (meta.get("namespace"), meta.get("name")) != \
+                                (ns, name):
+                            continue
+                        if et == "DELETED":
+                            break
+                        push(obj)
+                        done = terminal(obj)
+                    # grace drain: a writer patching logs concurrently
+                    # with (or just after) the terminal status still gets
+                    # its final lines delivered before the stream closes
+                    deadline = time.monotonic() + 0.4
+                    while time.monotonic() < deadline:
+                        try:
+                            et, obj = events.get(timeout=0.1)
+                        except queue.Empty:
+                            continue
+                        meta = obj.get("metadata") or {}
+                        if et != "DELETED" and \
+                                (meta.get("namespace"), meta.get("name")) == \
+                                (ns, name):
+                            push(obj)
+                    self.wfile.write(b"0\r\n\r\n")  # clean chunked EOF
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    store.remove_listener(listener)
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
 
             def _watch(self, store):
                 events: "queue.Queue" = queue.Queue()

@@ -672,7 +672,16 @@ def _auto_block(T: int, D: int) -> int:
             return b
     if T < cap:
         return _round_up(T, 128)
-    return cap
+    # Non-multiple T above the cap: the caller pads to the block
+    # multiple, and live tail tiles compute at full block size — with
+    # the cap block, T just past a multiple (e.g. 1030) would pad to
+    # 2048 and run ~2x the useful tokens.  Take the largest preferred
+    # block whose pad stays <= T/8; 128 bounds the absolute waste at
+    # <128 rows, so relative pad overhead shrinks as T grows.
+    for b in (cap, 512, 256, 128):
+        if (_round_up(T, b) - T) * 8 <= T:
+            return b
+    return 128
 
 
 def _exact_block(T: int, D: int) -> int | None:

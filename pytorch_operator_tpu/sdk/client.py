@@ -73,6 +73,74 @@ class _FakeBackend:
         annotations = (pod.get("metadata") or {}).get("annotations") or {}
         return annotations.get("fake.kubelet/logs", "")
 
+    def read_pod_log_stream(self, namespace: str, name: str):
+        """Yield log lines live until the pod terminates (follow mode).
+
+        RestCluster tails the server's chunked ?follow=true stream;
+        the in-memory FakeCluster is tailed event-driven off its pod
+        store (log annotation growth), ending on a terminal phase or
+        deletion — the same contract the real kubelet stream has.
+        Line framing is the shared utils.util.iter_log_lines rule on
+        every backend.
+        """
+        from pytorch_operator_tpu.utils.util import iter_log_lines
+
+        if hasattr(self.cluster, "read_pod_log_stream"):  # RestCluster
+            yield from self.cluster.read_pod_log_stream(namespace, name)
+            return
+        yield from iter_log_lines(self._fake_log_chunks(namespace, name))
+
+    def _fake_log_chunks(self, namespace: str, name: str):
+        """Text chunks of the fake pod's growing log annotation, ending
+        on a terminal phase or deletion (the kubelet-stream contract)."""
+        import queue as _queue
+
+        store = self.cluster.pods
+        events: "_queue.Queue" = _queue.Queue()
+        listener = lambda et, obj: events.put((et, obj))
+        # subscribe BEFORE the initial read so growth in between is
+        # re-delivered as events (deduplicated by byte offset below)
+        store.add_listener(listener)
+        try:
+            pod = store.get(namespace, name)
+            sent = 0
+
+            def text_of(p):
+                return (((p.get("metadata") or {}).get("annotations"))
+                        or {}).get("fake.kubelet/logs", "")
+
+            def terminal(p):
+                return ((p.get("status") or {}).get("phase")) in (
+                    "Succeeded", "Failed")
+
+            while True:
+                text = text_of(pod)
+                if len(text) > sent:
+                    yield text[sent:]
+                    sent = len(text)
+                if terminal(pod):
+                    return
+                # wait for this pod's next event; the periodic re-get is
+                # belt-and-braces against a dropped listener callback
+                while True:
+                    try:
+                        et, obj = events.get(timeout=5.0)
+                    except _queue.Empty:
+                        pod = store.get(namespace, name)
+                        break
+                    meta = obj.get("metadata") or {}
+                    if (meta.get("namespace"), meta.get("name")) != \
+                            (namespace, name):
+                        continue
+                    if et == "DELETED":
+                        return
+                    pod = obj
+                    break
+        except NotFoundError:
+            return
+        finally:
+            store.remove_listener(listener)
+
     def job_store(self):
         """The watchable job store (add_listener interface) — both
         FakeCluster and RestCluster stores expose it; sdk.watch rides
@@ -153,6 +221,26 @@ class _KubeBackend:
     def read_pod_log(self, namespace, name):
         return self.core_api.read_namespaced_pod_log(name, namespace)
 
+    def read_pod_log_stream(self, namespace, name):
+        """Yield log lines live: read_namespaced_pod_log(follow=True,
+        _preload_content=False) and iterate the raw urllib3 response.
+
+        Deliberately NOT Watch.stream: on kubernetes==10.0.1 — the
+        version the reference SDK pins (requirements.txt:6) — Watch
+        always injects ``watch=True``, which read_namespaced_pod_log
+        rejects; the 'follow' docstring detection only arrived in v12.
+        The raw-response tail works on every version (pinned in
+        tests/kube_package_contract.py)."""
+        from pytorch_operator_tpu.utils.util import iter_log_lines
+
+        resp = self.core_api.read_namespaced_pod_log(
+            name, namespace, follow=True, _preload_content=False)
+        try:
+            yield from iter_log_lines(
+                resp.stream(amt=16384, decode_content=True))
+        finally:
+            resp.close()
+
     def job_store(self):
         """Watchable adapter over kubernetes.watch (the stream the
         reference's py_torch_job_watch.py:29-60 rides); falls back to
@@ -183,16 +271,27 @@ class _KubeJobWatch:
         self._listeners: list = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # guards listener list + thread start/exit handoff: without it,
+        # two concurrent watch() calls could start two loop threads
+        # (double delivery), and the loop could not safely park itself
+        # when the last listener leaves
+        self._lock = threading.Lock()
 
     def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._loop, daemon=True)
-            self._thread.start()
+        with self._lock:
+            self._listeners.append(fn)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
 
     def remove_listener(self, fn) -> None:
-        if fn in self._listeners:
-            self._listeners.remove(fn)
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+            # the loop notices the empty list at its next cycle edge and
+            # parks (no listeners -> no reason to hold a cluster-wide
+            # LIST+WATCH open for the life of the process)
 
     def stop(self) -> None:
         self._stop.set()
@@ -211,6 +310,16 @@ class _KubeJobWatch:
     def _loop(self) -> None:
         rv = ""
         while not self._stop.is_set():
+            with self._lock:
+                if not self._listeners:
+                    # park: the next add_listener starts a fresh loop
+                    # (fresh rv -> GAP -> relist, so nothing is missed).
+                    # The exit decision and add_listener's thread-start
+                    # share the lock, so a listener added concurrently
+                    # either sees this thread still alive (loop
+                    # continues) or _thread None (starts a new one).
+                    self._thread = None
+                    return
             try:
                 if not rv:
                     # LIST-then-WATCH: snapshot a resourceVersion, tell
@@ -239,8 +348,8 @@ class _KubeJobWatch:
                     meta = obj.get("metadata") or {}
                     rv = meta.get("resourceVersion") or rv
                     self._notify(event.get("type", ""), obj)
-                    if self._stop.is_set():
-                        break
+                    if self._stop.is_set() or not self._listeners:
+                        break  # stopped, or last listener left mid-stream
                 # clean stream end (server-side timeout): resume from rv;
                 # pace empty streams so an instant-closing proxy can't
                 # turn this into a zero-delay reconnect storm
@@ -414,13 +523,18 @@ class PyTorchJobClient:
                  master: bool = True,
                  replica_type: Optional[str] = None,
                  replica_index: Optional[str] = None,
-                 follow: bool = False) -> Dict[str, str]:
+                 follow: bool = False):
         """Fetch pod logs, master-only by default (reference: :357-393).
 
-        Returns {pod_name: log_text} and also prints each log like the
-        reference does.
+        With ``follow=False`` returns {pod_name: log_text} and logs each
+        like the reference does.  With ``follow=True`` returns an
+        iterator of ``(pod_name, line)`` tuples streamed live — lines
+        arrive while the pod is still running, and the iterator ends
+        when every selected pod's stream closes.  (The reference passes
+        ``follow`` through to read_namespaced_pod_log, which blocks
+        until the stream ends and returns the accumulated text; this
+        client exposes the same server-side stream incrementally.)
         """
-        del follow  # parity placeholder; the reference ignores it too
         namespace = namespace or utils.get_default_target_namespace()
         pod_names = self.get_pod_names(
             name, namespace=namespace, master=master,
@@ -428,9 +542,55 @@ class PyTorchJobClient:
         if not pod_names:
             raise RuntimeError(
                 f"no pods found for PyTorchJob {namespace}/{name}")
+        if follow:
+            return self._follow_logs(pod_names, namespace)
         logs = {}
         for pod in pod_names:
             text = self._backend.read_pod_log(namespace, pod)
             logs[pod] = text
             logger.info("the logs of Pod %s:\n%s", pod, text)
         return logs
+
+    def _follow_logs(self, pod_names: List[str], namespace: str):
+        """Generator behind get_logs(follow=True): tail every selected
+        pod CONCURRENTLY, yielding (pod_name, line) as lines land.
+
+        Concurrency matters for multi-pod selections (master=False): a
+        sequential tail would hold back every worker's lines until the
+        master terminated — and never show them if it doesn't.  One
+        daemon thread per pod feeds a queue; the iterator ends when all
+        streams have closed.  If the consumer abandons the iterator
+        early, the daemon threads drain quietly until their pods
+        terminate.
+        """
+        if len(pod_names) == 1:  # common case (master-only): no threads
+            pod = pod_names[0]
+            for line in self._backend.read_pod_log_stream(namespace, pod):
+                logger.info("%s: %s", pod, line)
+                yield pod, line
+            return
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        done = object()
+
+        def tail(pod: str) -> None:
+            try:
+                for line in self._backend.read_pod_log_stream(namespace,
+                                                              pod):
+                    q.put((pod, line))
+            except Exception:
+                logger.exception("log stream for pod %s failed", pod)
+            finally:
+                q.put((pod, done))
+
+        for pod in pod_names:
+            threading.Thread(target=tail, args=(pod,), daemon=True).start()
+        live = len(pod_names)
+        while live:
+            pod, item = q.get()
+            if item is done:
+                live -= 1
+                continue
+            logger.info("%s: %s", pod, item)
+            yield pod, item

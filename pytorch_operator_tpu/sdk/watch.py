@@ -35,15 +35,25 @@ def _emit_row(name: str, job: dict, last):
     the newest condition is terminal.  Shared by the event-driven path
     and the poll fallback so the table format, dedup rule and terminal
     set cannot diverge between the two modes.
+
+    Stale-replay guard: an event enqueued between add_listener and the
+    initial get carries state OLDER than the get's snapshot; printing
+    it would emit an out-of-order row and reset the dedup state (a
+    duplicate row when the newer state is re-delivered).  Transition
+    times are RFC3339 UTC, so lexical comparison orders them — a row
+    whose time is older than the one already printed is skipped and the
+    newer dedup state kept.  Terminal detection is unaffected: terminal
+    conditions are final, so even a stale terminal row means done.
     """
     conditions = ((job.get("status") or {}).get("conditions")) or []
     if not conditions:
         return last, False
     cond = conditions[-1]
     row = (cond.get("type", ""), cond.get("lastTransitionTime", ""))
-    if row != last:
+    if row != last and (last is None or row[1] >= last[1]):
         print(_FMT.format(name, row[0], row[1]), flush=True)
-    return row, row[0] in _TERMINAL
+        last = row
+    return last, row[0] in _TERMINAL
 
 
 def watch(client, name: str, namespace: str, timeout_seconds: int = 600,

@@ -2,9 +2,36 @@
 
 from __future__ import annotations
 
+import codecs
 import json
 import random
 import string
+
+
+def iter_log_lines(chunks):
+    """Split an iterable of text/bytes chunks into complete lines.
+
+    The one line-framing rule for every log-follow transport (the REST
+    client's socket chunks, the kubernetes package's urllib3 stream,
+    the in-memory fake's annotation growth — sdk/client.py, k8s/rest.py)
+    so the transports cannot drift: yields each ``\\n``-terminated line
+    without its newline (a ``\\r\\n`` keeps its ``\\r`` — kubelets emit
+    ``\\n``), preserves blank lines, flushes an unterminated tail at
+    EOF, and decodes bytes incrementally so a UTF-8 sequence split
+    across chunk boundaries survives intact.
+    """
+    decoder = codecs.getincrementaldecoder("utf-8")("replace")
+    buf = ""
+    for chunk in chunks:
+        if isinstance(chunk, bytes):
+            chunk = decoder.decode(chunk)
+        buf += chunk
+        while "\n" in buf:
+            line, buf = buf.split("\n", 1)
+            yield line
+    buf += decoder.decode(b"", final=True)
+    if buf:
+        yield buf
 
 
 def pformat(obj) -> str:

@@ -1,0 +1,117 @@
+"""Recorded surface of the `kubernetes` package the SDK backend calls.
+
+Round-5 verdict item 6: the kubernetes package is not in this image, so
+_KubeBackend (sdk/client.py) is exercised only against hand-rolled fakes
+(test_sdk_kube_backend.py).  This module pins the REAL package surface
+those fakes imitate, captured from the published sources of
+
+    kubernetes==10.0.1
+
+— the exact version the reference SDK pins
+(/root/reference/sdk/python/requirements.txt:6) — with notes where later
+majors differ.  test_sdk_kube_backend.py::TestPackageContract asserts
+every fake signature matches this record, so a stub silently drifting
+from the genuine client fails the suite instead of shipping an
+interface mismatch.
+
+Capture provenance: the generated swagger clients
+(kubernetes/client/apis/custom_objects_api.py, core_v1_api.py) take the
+required path/body parameters positionally in the order recorded below
+and validate optional parameters against an explicit allowlist — an
+unexpected keyword raises TypeError("Got an unexpected keyword argument
+...").  Request options (_preload_content, _request_timeout, async_req)
+are accepted by every generated method via api_client.call_api.
+"""
+
+from __future__ import annotations
+
+CAPTURED_FROM = "kubernetes==10.0.1"
+
+# Options every generated API method accepts (api_client.call_api).
+REQUEST_OPTIONS = frozenset({
+    "async_req", "_return_http_data_only", "_preload_content",
+    "_request_timeout",
+})
+
+_CUSTOM_LIST_KWARGS = frozenset({
+    "pretty", "field_selector", "label_selector", "limit",
+    "resource_version", "timeout_seconds", "watch",
+    # the server-side continuation token; a Python keyword, so the
+    # generated client exposes it as **kwargs["continue"] — fakes must
+    # not claim it as a named parameter either
+})
+
+# CustomObjectsApi: method -> (required positional params in order,
+# optional keyword params the method validates).
+CUSTOM_OBJECTS_API = {
+    "create_namespaced_custom_object": (
+        ("group", "version", "namespace", "plural", "body"),
+        frozenset({"pretty"})),
+    "get_namespaced_custom_object": (
+        ("group", "version", "namespace", "plural", "name"),
+        frozenset()),
+    "list_namespaced_custom_object": (
+        ("group", "version", "namespace", "plural"),
+        _CUSTOM_LIST_KWARGS),
+    "list_cluster_custom_object": (
+        ("group", "version", "plural"),
+        _CUSTOM_LIST_KWARGS),
+    "patch_namespaced_custom_object": (
+        ("group", "version", "namespace", "plural", "name", "body"),
+        frozenset()),
+    # NOTE: in 10.0.1 `body` is REQUIRED (a V1DeleteOptions); from v12 it
+    # became optional.  The backend passes body=None by keyword, which
+    # satisfies both eras.
+    "delete_namespaced_custom_object": (
+        ("group", "version", "namespace", "plural", "name", "body"),
+        frozenset({"grace_period_seconds", "orphan_dependents",
+                   "propagation_policy"})),
+}
+
+# CoreV1Api subset the backend touches.
+CORE_V1_API = {
+    "list_namespaced_pod": (
+        ("namespace",),
+        frozenset({"pretty", "allow_watch_bookmarks", "field_selector",
+                   "label_selector", "limit", "resource_version",
+                   "timeout_seconds", "watch"})),
+    # follow=True + _preload_content=False returns the raw
+    # urllib3.HTTPResponse, which exposes .stream(amt, decode_content)
+    # and .close() — the version-proof log tail (see WATCH_STREAM notes).
+    "read_namespaced_pod_log": (
+        ("name", "namespace"),
+        frozenset({"container", "follow", "limit_bytes", "pretty",
+                   "previous", "since_seconds", "tail_lines",
+                   "timestamps"})),
+}
+
+# Shape of the raw streaming response read_namespaced_pod_log returns
+# under _preload_content=False (urllib3.response.HTTPResponse).
+RAW_RESPONSE_METHODS = ("stream", "close")
+
+# kubernetes.watch.Watch — the CRD event stream transport.
+WATCH_STREAM = {
+    # stream(func, *args, **kwargs): args/kwargs forwarded to func with
+    # kwargs['watch']=True and _preload_content=False injected.
+    "stream_params": ("func",),
+    # each yielded event is a dict with these keys; 'object' is the
+    # deserialized resource (a plain dict for custom objects, whose
+    # deserialization target is object), 'raw_object' the undecoded one
+    "event_keys": ("type", "object", "raw_object"),
+    "event_types": ("ADDED", "MODIFIED", "DELETED", "BOOKMARK", "ERROR"),
+    "notes": (
+        "10.0.1's Watch.stream ALWAYS injects watch=True, so it can only "
+        "drive methods accepting a `watch` parameter (the custom-object "
+        "lists do).  Pod-log tailing via Watch (the ':param bool follow:' "
+        "docstring detection) arrived in v12 — which is why "
+        "_KubeBackend.read_pod_log_stream tails via "
+        "read_namespaced_pod_log(follow=True, _preload_content=False) "
+        "instead of Watch."),
+}
+
+# config loaders the backend calls (kubernetes/config/__init__.py).
+CONFIG_LOADERS = {
+    "load_kube_config": ("config_file", "context", "client_configuration",
+                         "persist_config"),
+    "load_incluster_config": (),
+}

@@ -106,6 +106,187 @@ class TestSdkLifecycle:
         assert client.get("p-job")["metadata"]["labels"]["team"] == "ml"
 
 
+class TestFollowLogs:
+    """get_logs(follow=True) — live tail (round-5 verdict item 3; the
+    reference passes follow through to read_namespaced_pod_log,
+    py_torch_job_client.py:359-386)."""
+
+    def _mk_running_pod(self, cluster, job, pod_name):
+        import time
+
+        cluster.pods.create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": "default",
+                         "labels": sdk_utils.get_labels(job, master=True)},
+        })
+        # the world fixture's kubelet immediately walks fresh pods
+        # Pending->Running->Succeeded+logs; wait for it to finish so this
+        # test fully controls the subsequent log/phase writes
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            phase = (cluster.pods.get("default", pod_name)
+                     .get("status") or {}).get("phase")
+            if phase == "Succeeded":
+                break
+            time.sleep(0.01)
+        cluster.pods.set_status("default", pod_name, {"phase": "Running"})
+        cluster.pods.patch("default", pod_name, {
+            "metadata": {"annotations": {"fake.kubelet/logs": ""}}})
+
+    def test_follow_yields_lines_before_completion(self, world, client):
+        import time
+
+        self._mk_running_pod(world, "tail-job", "tail-job-master-0")
+        text = {"v": ""}
+        terminal_at = [None]
+
+        def writer():
+            for i in range(3):
+                time.sleep(0.1)
+                text["v"] += f"line-{i}\n"
+                world.pods.patch("default", "tail-job-master-0", {
+                    "metadata": {"annotations":
+                                 {"fake.kubelet/logs": text["v"]}}})
+            text["v"] += "done\n"
+            world.pods.patch("default", "tail-job-master-0", {
+                "metadata": {"annotations":
+                             {"fake.kubelet/logs": text["v"]}}})
+            world.pods.set_status("default", "tail-job-master-0",
+                                  {"phase": "Succeeded"})
+            terminal_at[0] = time.monotonic()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        got = []
+        for pod_name, line in client.get_logs("tail-job", follow=True):
+            got.append((time.monotonic(), pod_name, line))
+        t.join(timeout=5)
+        lines = [l for _, _, l in got]
+        assert lines == ["line-0", "line-1", "line-2", "done"]
+        assert all(p == "tail-job-master-0" for _, p, _ in got)
+        # the point of follow: the first line arrived while the pod was
+        # still Running, not after completion
+        assert got[0][0] < terminal_at[0], (got[0][0], terminal_at[0])
+
+    def test_follow_multi_pod_is_concurrent(self, world, client):
+        """master=False tails every pod at once: a worker's lines must
+        arrive while the master is still running and silent (a
+        sequential tail would block on the master forever)."""
+        import time
+
+        self._mk_running_pod(world, "cc-job", "cc-job-master-0")
+        self._mk_running_pod(world, "cc-job", "cc-job-worker-0")
+        world.pods.patch("default", "cc-job-worker-0", {
+            "metadata": {"labels": sdk_utils.get_labels("cc-job")}})
+
+        def writer():
+            time.sleep(0.1)
+            world.pods.patch("default", "cc-job-worker-0", {
+                "metadata": {"annotations":
+                             {"fake.kubelet/logs": "worker says hi\n"}}})
+            world.pods.set_status("default", "cc-job-worker-0",
+                                  {"phase": "Succeeded"})
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        it = client.get_logs("cc-job", master=False, follow=True)
+        pod, line = next(it)
+        # the worker's line arrives even though the master is still
+        # Running with no output
+        assert (pod, line) == ("cc-job-worker-0", "worker says hi")
+        master = world.pods.get("default", "cc-job-master-0")
+        assert master["status"]["phase"] == "Running"
+        # finish the master so the iterator ends
+        world.pods.patch("default", "cc-job-master-0", {
+            "metadata": {"annotations":
+                         {"fake.kubelet/logs": "master done\n"}}})
+        world.pods.set_status("default", "cc-job-master-0",
+                              {"phase": "Succeeded"})
+        rest = list(it)
+        t.join(timeout=5)
+        assert ("cc-job-master-0", "master done") in rest
+
+    def test_follow_preserves_blank_lines(self, world, client):
+        import time
+
+        self._mk_running_pod(world, "blank-job", "blank-job-master-0")
+
+        def writer():
+            time.sleep(0.05)
+            world.pods.patch("default", "blank-job-master-0", {
+                "metadata": {"annotations":
+                             {"fake.kubelet/logs": "a\n\nb\n"}}})
+            world.pods.set_status("default", "blank-job-master-0",
+                                  {"phase": "Succeeded"})
+
+        threading.Thread(target=writer, daemon=True).start()
+        lines = [l for _, l in client.get_logs("blank-job", follow=True)]
+        assert lines == ["a", "", "b"]
+
+    def test_follow_on_terminal_pod_returns_all_and_ends(self, world,
+                                                         client):
+        job = new_job(workers=0, name="tail-done-job")
+        client.create(job.to_dict())
+        client.wait_for_job("tail-done-job", timeout_seconds=15,
+                            polling_interval=0.05)
+        got = list(client.get_logs("tail-done-job", follow=True))
+        assert got, "no lines from a completed pod's follow stream"
+        assert any("accuracy=" in line for _, line in got)
+
+
+class TestEmitRowStaleReplay:
+    """sdk.watch._emit_row must not print (or reset dedup on) a row
+    whose transition time is older than the one already shown — the
+    add_listener/initial-get race delivers exactly such stale replays
+    (advisor r4)."""
+
+    def _job(self, ctype, t):
+        return {"status": {"conditions": [
+            {"type": ctype, "status": "True", "lastTransitionTime": t}]}}
+
+    def test_stale_older_row_skipped(self, capsys):
+        from pytorch_operator_tpu.sdk.watch import _emit_row
+
+        last, term = _emit_row("j", self._job(
+            "Running", "2026-07-31T00:00:02Z"), None)
+        assert term is False
+        capsys.readouterr()
+        # stale replay: Created from before the initial get
+        last2, term2 = _emit_row("j", self._job(
+            "Created", "2026-07-31T00:00:01Z"), last)
+        assert capsys.readouterr().out == ""  # nothing printed
+        assert last2 == last  # dedup state not reset
+        assert term2 is False
+        # the newer state re-delivered: deduped, no duplicate row
+        last3, _ = _emit_row("j", self._job(
+            "Running", "2026-07-31T00:00:02Z"), last2)
+        assert capsys.readouterr().out == ""
+        assert last3 == last
+
+    def test_newer_row_prints_and_advances(self, capsys):
+        from pytorch_operator_tpu.sdk.watch import _emit_row
+
+        last, _ = _emit_row("j", self._job(
+            "Running", "2026-07-31T00:00:02Z"), None)
+        capsys.readouterr()
+        last2, term = _emit_row("j", self._job(
+            "Succeeded", "2026-07-31T00:00:03Z"), last)
+        out = capsys.readouterr().out
+        assert "Succeeded" in out and term is True
+        assert last2[0] == "Succeeded"
+
+    def test_stale_terminal_still_terminates(self, capsys):
+        from pytorch_operator_tpu.sdk.watch import _emit_row
+
+        last, _ = _emit_row("j", self._job(
+            "Running", "2026-07-31T00:00:05Z"), None)
+        capsys.readouterr()
+        # terminal conditions are final: even a stale one means done
+        _, term = _emit_row("j", self._job(
+            "Succeeded", "2026-07-31T00:00:04Z"), last)
+        assert term is True
+
+
 class TestSdkUtils:
     def test_labels_master(self):
         labels = sdk_utils.get_labels("j", master=True)

