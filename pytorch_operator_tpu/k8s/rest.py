@@ -259,10 +259,17 @@ class RestClient:
         silently drop empty log lines — and log tailing is byte-rate
         bound by the workload, not the transport, so there is nothing
         for the native path to win here.
+
+        Idle bound: a stream silent for >15 min is declared dead
+        (ApiError) rather than retried — a half-open TCP connection is
+        indistinguishable from a quiet pod, retrying a timed-out
+        buffered reader leaves http.client's chunk framing in an
+        undefined state, and the same idle-means-dead rule already
+        governs the watch path.  Re-call to resume the tail.
         """
         from pytorch_operator_tpu.utils.util import iter_log_lines
 
-        conn = self._connect(timeout=300.0)
+        conn = self._connect(timeout=900.0)
         try:
             conn.request(method, path, headers=self._headers())
             resp = conn.getresponse()
@@ -273,11 +280,11 @@ class RestClient:
                 while True:
                     try:
                         chunk = resp.read1(65536)
-                    except TimeoutError:
-                        # a quiet pod (no output for >300s) is normal
-                        # mid-tail, not an error: the socket timed out
-                        # with no data, the stream itself is fine
-                        continue
+                    except TimeoutError as e:
+                        raise ApiError(
+                            "log stream idle >900s; treating the "
+                            "connection as dead (re-call to resume "
+                            "the tail)") from e
                     if not chunk:
                         return
                     yield chunk

@@ -170,6 +170,7 @@ class StubApiServer:
 
                     push(pod)
                     done = terminal(pod)
+                    already_terminal = done
                     while not done and not (outer._stopping.is_set()
                                             or outer._drop_watch.is_set()):
                         try:
@@ -186,8 +187,13 @@ class StubApiServer:
                         done = terminal(obj)
                     # grace drain: a writer patching logs concurrently
                     # with (or just after) the terminal status still gets
-                    # its final lines delivered before the stream closes
-                    deadline = time.monotonic() + 0.4
+                    # its final lines delivered before the stream closes.
+                    # Skipped when the pod was already terminal at the
+                    # initial read — no transition was racing then, and
+                    # an unconditional drain would tax every completed-
+                    # pod follow with 0.4s of pure latency.
+                    deadline = time.monotonic() + (
+                        0.0 if already_terminal else 0.4)
                     while time.monotonic() < deadline:
                         try:
                             et, obj = events.get(timeout=0.1)

@@ -558,10 +558,13 @@ class PyTorchJobClient:
         Concurrency matters for multi-pod selections (master=False): a
         sequential tail would hold back every worker's lines until the
         master terminated — and never show them if it doesn't.  One
-        daemon thread per pod feeds a queue; the iterator ends when all
-        streams have closed.  If the consumer abandons the iterator
-        early, the daemon threads drain quietly until their pods
-        terminate.
+        daemon thread per pod feeds a bounded queue; the iterator ends
+        when all streams have closed.  A failed stream does not hide:
+        its error is re-raised after the surviving pods' streams drain
+        (the single-pod path raises the same error immediately).
+        Abandoning the iterator early signals the tail threads to stop
+        at their next line (closing their streams) instead of buffering
+        the pods' remaining output forever.
         """
         if len(pod_names) == 1:  # common case (master-only): no threads
             pod = pod_names[0]
@@ -571,26 +574,46 @@ class PyTorchJobClient:
             return
         import queue as _queue
 
-        q: "_queue.Queue" = _queue.Queue()
+        q: "_queue.Queue" = _queue.Queue(maxsize=1024)
         done = object()
+        stop = threading.Event()
+        errors: list = []
 
         def tail(pod: str) -> None:
             try:
                 for line in self._backend.read_pod_log_stream(namespace,
                                                               pod):
-                    q.put((pod, line))
-            except Exception:
+                    while not stop.is_set():
+                        try:
+                            q.put((pod, line), timeout=0.5)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        break
+            except Exception as e:
                 logger.exception("log stream for pod %s failed", pod)
+                errors.append(e)
             finally:
-                q.put((pod, done))
+                while not stop.is_set():
+                    try:
+                        q.put((pod, done), timeout=0.5)
+                        break
+                    except _queue.Full:
+                        continue
 
         for pod in pod_names:
             threading.Thread(target=tail, args=(pod,), daemon=True).start()
         live = len(pod_names)
-        while live:
-            pod, item = q.get()
-            if item is done:
-                live -= 1
-                continue
-            logger.info("%s: %s", pod, item)
-            yield pod, item
+        try:
+            while live:
+                pod, item = q.get()
+                if item is done:
+                    live -= 1
+                    continue
+                logger.info("%s: %s", pod, item)
+                yield pod, item
+            if errors:
+                raise errors[0]
+        finally:
+            stop.set()

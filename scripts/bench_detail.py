@@ -721,11 +721,13 @@ def render_md(mfu: dict, flash: list[dict], norm: list[dict],
         "T<=1024, else 1024 at D<=128 — fused-backward sweep "
         "2026-07-30; the tuning objective is fwd+bwd, i.e. training).  "
         "At seq 1024 the (T,T) buffer fits XLA's fused softmax pipeline "
-        "and dense wins the FORWARD outright (see the table's fwd "
-        "column) while flash keeps the training (fwd+bwd) edge — "
-        "callers doing short-sequence inference can force the dense "
-        "path with block_q=0.  The flash win grows with T^2 alongside "
-        "the O(T)-memory advantage.",
+        "and raw dense wins the pure forward; round 5 made the public "
+        "entry route that case automatically (_route_small_t, a "
+        "jax.custom_vjp whose primal is dense and whose differentiated "
+        "path is flash — T<=1024, default blocks, no caller knobs), so "
+        "the T=1024 fwd row — measured THROUGH the public entry — reads "
+        ">=1.0x while fwd+bwd keeps the flash kernels.  The flash win "
+        "grows with T^2 alongside the O(T)-memory advantage.",
         "",
         "### 2b. GQA-native streaming vs repeat-KV (same kernel)",
         "",
