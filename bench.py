@@ -21,10 +21,15 @@ parametrized over dtype), and it lifts measured throughput +15% over
 the best recorded f32 run (1.82M vs 1.58M img/s; the same-session
 f32 A/B read 1.42M, a +28% gap — shared-chip conditions vary run to
 run, so the conservative +15% vs the f32 record is the honest claim).
-Synthetic
-MNIST-shaped data keeps the bench hermetic (this environment has no
-dataset egress); the real-data path in examples/mnist/train_mnist.py
-reaches the >=98% accuracy target the e2e flow asserts.
+
+The timed batch is the repo's synthetic digit dataset
+(data/mnist.synthetic — the same generator the accuracy test trains
+to >=98% on), NOT random noise, so the timed loop demonstrably LEARNS:
+the reported final loss falls well under 0.5 at identical per-step
+cost (same shapes/dtype).  Synthetic data keeps the bench hermetic
+(this environment has no dataset egress); the real-data path in
+examples/mnist/train_mnist.py reaches the >=98% accuracy target the
+e2e flow asserts.
 """
 
 from __future__ import annotations
@@ -67,12 +72,16 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"[bench] device: {dev.device_kind}", file=sys.stderr)
 
-    key = jax.random.key(0)
-    k_img, k_lbl, k_param = jax.random.split(key, 3)
-    images = jax.random.normal(k_img, (batch_size, 28, 28, 1), jnp.bfloat16)
-    labels = jax.random.randint(k_lbl, (batch_size,), 0, 10)
+    from pytorch_operator_tpu.data import mnist as mnist_data
 
-    params = mnist_cnn.init_params(k_param, dtype=jnp.bfloat16)
+    # learnable synthetic digits (the accuracy test's generator), so the
+    # timed loss visibly falls — same shapes/dtype as the old noise
+    # batch, so per-step cost is identical
+    imgs_np, lbls_np = mnist_data.synthetic(batch_size, seed=0)
+    images = jnp.asarray(imgs_np, jnp.bfloat16)
+    labels = jnp.asarray(lbls_np)
+
+    params = mnist_cnn.init_params(jax.random.key(2), dtype=jnp.bfloat16)
     opt = optax.sgd(0.01, momentum=0.5)
     opt_state = opt.init(params)
 

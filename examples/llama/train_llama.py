@@ -45,7 +45,13 @@ def main() -> int:
                         help="pipeline-parallel stages (uses the GPipe "
                              "path; must equal the device count)")
     parser.add_argument("--microbatches", type=int, default=4,
-                        help="GPipe microbatches when --pp is set")
+                        help="pipeline microbatches when --pp is set")
+    parser.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+                        default="gpipe",
+                        help="pipeline schedule under --pp: GPipe "
+                             "(autodiff through the ring) or 1F1B "
+                             "(interleaved fwd/bwd, O(stages) in-flight "
+                             "activations instead of O(microbatches))")
     parser.add_argument("--sp", type=int, default=0,
                         help="sequence-parallel degree for long contexts; "
                              "composes with --dp/--fsdp (dp*fsdp*sp must "
@@ -199,16 +205,20 @@ def main() -> int:
             parser.error(f"--pp {args.pp} != {n} devices")
         if cfg.n_layers % args.pp:
             parser.error(f"n_layers {cfg.n_layers} not divisible by --pp")
+        if args.batch_size % args.microbatches:
+            parser.error(f"--batch-size {args.batch_size} not divisible "
+                         f"by --microbatches {args.microbatches}")
         mesh = make_named_mesh({"pp": args.pp})
-        print(f"[worker {pid}/{nprocs}] GPipe mesh pp={args.pp} "
-              f"microbatches={args.microbatches} over {n} devices",
-              flush=True)
+        print(f"[worker {pid}/{nprocs}] {args.pp_schedule} pipeline mesh "
+              f"pp={args.pp} microbatches={args.microbatches} over "
+              f"{n} devices", flush=True)
         state = sharded_init(cfg, mesh, optimizer,
                              specs=llama.pp_param_specs(cfg))
         step_fn = make_pp_train_step(cfg, mesh, optimizer,
                                      n_microbatches=args.microbatches,
                                      chunked_ce=args.chunked_ce,
-                                     ce_chunk=args.ce_chunk)
+                                     ce_chunk=args.ce_chunk,
+                                     schedule=args.pp_schedule)
     else:
         flags = (args.dp, args.fsdp, args.tp)
         if all(flags):

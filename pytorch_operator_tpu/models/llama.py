@@ -232,22 +232,12 @@ def _layer(h, lp, cfg: LlamaConfig, cos, sin, attn=None):
     return h
 
 
-def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-                  apply_stack, attn=None, return_hidden: bool = False
-                  ) -> jax.Array:
-    """Shared prologue/epilogue around the decoder stack: embed + RoPE
-    tables in, final norm + weight-tied head out.  ``apply_stack(layers,
-    h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
-    ring); ``attn`` overrides the per-layer attention (the SP forward
-    routes it through ring/all-to-all shard_map strategies).
-    ``return_hidden`` skips the output head and returns the final-normed
-    (B, T, D) hidden states — long-context losses apply the tied head
-    per sequence chunk instead (parallel.train.chunked_tied_ce), so the
-    (T, vocab) f32 logits never exist as one buffer."""
-    T = tokens.shape[1]
-    h = jnp.take(params["embed"], tokens, axis=0)
-    cos, sin = rope_table(cfg, T)
-
+def make_layer_body(cfg: LlamaConfig, cos, sin, attn=None):
+    """The per-layer function (h, layer_params) -> h, wrapped in the
+    config's rematerialisation policy.  Shared by every stack driver:
+    the lax.scan forwards, the GPipe ring, and the 1F1B stages — so the
+    remat semantics (incl. save_attn's flash-residual names) cannot
+    diverge between the parallel strategies."""
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin, attn=attn)
     if cfg.remat:
         if cfg.remat_policy == "save_attn":
@@ -264,10 +254,30 @@ def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
                     *FLASH_SAVE_NAMES))
         elif cfg.remat_policy:
             body = jax.checkpoint(
-                body, policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
+                body, policy=getattr(jax.checkpoint_policies,
+                                     cfg.remat_policy))
         else:
             body = jax.checkpoint(body)
+    return body
 
+
+def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                  apply_stack, attn=None, return_hidden: bool = False
+                  ) -> jax.Array:
+    """Shared prologue/epilogue around the decoder stack: embed + RoPE
+    tables in, final norm + weight-tied head out.  ``apply_stack(layers,
+    h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
+    ring); ``attn`` overrides the per-layer attention (the SP forward
+    routes it through ring/all-to-all shard_map strategies).
+    ``return_hidden`` skips the output head and returns the final-normed
+    (B, T, D) hidden states — long-context losses apply the tied head
+    per sequence chunk instead (parallel.train.chunked_tied_ce), so the
+    (T, vocab) f32 logits never exist as one buffer."""
+    T = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_table(cfg, T)
+
+    body = make_layer_body(cfg, cos, sin, attn=attn)
     h = apply_stack(params["layers"], h, body)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
     if return_hidden:

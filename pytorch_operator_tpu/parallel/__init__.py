@@ -23,7 +23,10 @@ from pytorch_operator_tpu.parallel.mesh import (
     make_named_mesh,
     make_sp_mesh,
 )
-from pytorch_operator_tpu.parallel.pipeline import pipeline_apply
+from pytorch_operator_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_value_and_grad,
+)
 from pytorch_operator_tpu.parallel.ring_attention import ring_attention
 from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
 from pytorch_operator_tpu.parallel.train import (
@@ -48,6 +51,7 @@ __all__ = [
     "make_named_mesh",
     "make_sp_mesh",
     "pipeline_apply",
+    "pipeline_value_and_grad",
     "ring_attention",
     "ulysses_attention",
     "cross_entropy_loss",

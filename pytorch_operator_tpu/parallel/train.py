@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_operator_tpu.models import llama
 from pytorch_operator_tpu.parallel.mesh import batch_spec
+from pytorch_operator_tpu.parallel.pipeline import pipeline_value_and_grad
 
 
 class TrainState(NamedTuple):
@@ -237,15 +238,32 @@ def make_pp_train_step(
     axis_name: str = "pp",
     chunked_ce: bool = False,
     ce_chunk: int = 1024,
+    schedule: str = "gpipe",
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
-    """Jitted training step through the GPipe pipeline.
+    """Jitted training step through the microbatch pipeline.
 
-    The forward runs llama.forward_pipelined (decoder stack sharded over
-    the pp axis, microbatches through the ppermute ring); reverse mode
-    differentiates through the ppermutes so gradients flow stage-to-stage
-    the way the activations came.  Pair with
-    ``sharded_init(..., specs=llama.pp_param_specs(cfg))``.
+    ``schedule="gpipe"``: the forward runs llama.forward_pipelined
+    (decoder stack sharded over the pp axis, microbatches through the
+    ppermute ring); reverse mode differentiates through the ppermutes
+    so gradients flow stage-to-stage the way the activations came.
+
+    ``schedule="1f1b"``: the step runs
+    parallel.pipeline.pipeline_value_and_grad — forwards and backwards
+    interleaved, loss computed inside the last stage, per-stage vjp
+    with at most S saved stage inputs (GPipe saves M) — same losses,
+    O(S) in-flight activation memory.  See _1f1b_body.
+
+    Either way pair with ``sharded_init(..., specs=
+    llama.pp_param_specs(cfg))``.
     """
+    if schedule == "1f1b":
+        return _make_1f1b_step(cfg, mesh, optimizer,
+                               n_microbatches=n_microbatches,
+                               axis_name=axis_name,
+                               chunked_ce=chunked_ce, ce_chunk=ce_chunk)
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
     def fwd(params, inputs, **kw):
         return llama.forward_pipelined(
             params, inputs, cfg, mesh,
@@ -257,4 +275,66 @@ def make_pp_train_step(
         optimizer,
         hidden_fn=partial(fwd, return_hidden=True) if chunked_ce else None,
         ce_chunk=ce_chunk,
+    )
+
+
+def _make_1f1b_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    chunked_ce: bool = False,
+    ce_chunk: int = 1024,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """1F1B training step: stage fns wrap the SAME llama layer body the
+    other drivers use (llama.make_layer_body — remat policies included),
+    the loss (optionally chunked tied-head CE) runs inside the last
+    stage, and grads come back in the params' own layout."""
+    M = n_microbatches
+
+    def first_fn(extra, tokens_mb):
+        return jnp.take(extra["embed"], tokens_mb, axis=0)
+
+    def stage_fn(layers_local, x):
+        cos, sin = llama.rope_table(cfg, x.shape[1])
+        body = llama.make_layer_body(cfg, cos, sin)
+        return jax.lax.scan(lambda h, lp: (body(h, lp), None),
+                            x, layers_local)[0]
+
+    def last_fn(extra, y, targets_mb):
+        h = llama.rms_norm(y, extra["final_norm"], cfg.norm_eps,
+                           cfg.use_fused_norm)
+        if chunked_ce:
+            loss = chunked_tied_ce(h, extra["embed"], targets_mb, ce_chunk)
+        else:
+            logits = jnp.einsum(
+                "btd,vd->btv", h, extra["embed"]).astype(jnp.float32)
+            loss = cross_entropy_loss(logits, targets_mb)
+        # microbatch losses SUM across the schedule; pre-scaling by 1/M
+        # makes that sum the global mean CE (equal microbatch sizes)
+        return loss / M
+
+    def step(state: TrainState, batch: jax.Array):
+        inputs, targets = batch[:, :-1], batch[:, 1:]
+        extra = {"embed": state.params["embed"],
+                 "final_norm": state.params["final_norm"]}
+        loss, g_layers, g_extra = pipeline_value_and_grad(
+            state.params["layers"], extra, inputs, targets,
+            first_fn=first_fn, stage_fn=stage_fn, last_fn=last_fn,
+            mesh=mesh, n_microbatches=M, axis_name=axis_name)
+        grads = {"embed": g_extra["embed"], "layers": g_layers,
+                 "final_norm": g_extra["final_norm"]}
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(params, opt_state, state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(
+        step,
+        in_shardings=(None, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
     )

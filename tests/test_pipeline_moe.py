@@ -137,6 +137,123 @@ class TestLlamaPipeline:
         np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
 
 
+class Test1F1B:
+    """1F1B schedule (round-5 verdict item 9): interleaved fwd/bwd with
+    per-stage vjp and O(S) saved activations must train identically to
+    GPipe (which is math-identical to the sequential model)."""
+
+    def _run(self, cfg, mesh, schedule, tokens, n_mb=4, steps=3, **kw):
+        opt = optax.sgd(0.1)
+        state = sharded_init(cfg, mesh, opt,
+                             specs=llama.pp_param_specs(cfg))
+        step = make_pp_train_step(cfg, mesh, opt, n_microbatches=n_mb,
+                                  schedule=schedule, **kw)
+        out = []
+        for _ in range(steps):
+            state, m = step(state, tokens)
+            out.append((float(m["loss"]), float(m["grad_norm"])))
+        return out
+
+    @pytest.mark.parametrize("pp,n_mb", [(4, 4), (2, 6), (8, 8)])
+    def test_matches_gpipe_multi_step(self, pp, n_mb):
+        mesh = make_named_mesh({"pp": pp})
+        cfg = llama.tiny(dim=64, n_layers=pp, n_heads=4, n_kv_heads=4,
+                         ffn_dim=128, vocab_size=256, max_seq_len=16)
+        tokens = jax.random.randint(jax.random.key(5), (n_mb * 2, 17), 0,
+                                    cfg.vocab_size)
+        a = self._run(cfg, mesh, "gpipe", tokens, n_mb=n_mb)
+        b = self._run(cfg, mesh, "1f1b", tokens, n_mb=n_mb)
+        # three steps: step N's loss depends on step N-1's grads, so a
+        # wrong hand-scheduled backward diverges the sequences
+        np.testing.assert_allclose(b, a, rtol=1e-4)
+
+    def test_gqa_and_chunked_ce(self):
+        mesh = make_named_mesh({"pp": 4})
+        cfg = llama.tiny(dim=64, n_layers=4, n_heads=8, n_kv_heads=2,
+                         ffn_dim=128, vocab_size=256, max_seq_len=16)
+        tokens = jax.random.randint(jax.random.key(7), (8, 17), 0,
+                                    cfg.vocab_size)
+        a = self._run(cfg, mesh, "1f1b", tokens)
+        b = self._run(cfg, mesh, "1f1b", tokens, chunked_ce=True,
+                      ce_chunk=8)
+        c = self._run(cfg, mesh, "gpipe", tokens)
+        np.testing.assert_allclose(a, c, rtol=1e-4)
+        np.testing.assert_allclose(b, c, rtol=1e-4)
+
+    def test_remat_stage_body(self):
+        """The 1F1B stages reuse llama.make_layer_body, so cfg.remat
+        applies inside the hand-scheduled vjp too."""
+        mesh = make_named_mesh({"pp": 2})
+        cfg = llama.tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+                         ffn_dim=64, vocab_size=128, max_seq_len=16,
+                         remat=True)
+        tokens = jax.random.randint(jax.random.key(9), (4, 17), 0,
+                                    cfg.vocab_size)
+        a = self._run(cfg, mesh, "1f1b", tokens, n_mb=2)
+        cfg2 = llama.tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+                          ffn_dim=64, vocab_size=128, max_seq_len=16)
+        b = self._run(cfg2, mesh, "1f1b", tokens, n_mb=2)
+        np.testing.assert_allclose(a, b, rtol=1e-4)
+
+    def test_unknown_schedule_rejected(self):
+        mesh = make_named_mesh({"pp": 2})
+        cfg = llama.tiny(n_layers=2, max_seq_len=16)
+        with pytest.raises(ValueError, match="unknown pipeline schedule"):
+            make_pp_train_step(cfg, mesh, optax.sgd(0.1),
+                               n_microbatches=2, schedule="2f2b")
+
+    def test_saved_ring_is_stage_bounded(self):
+        """The memory property: the per-stage save ring holds S slots,
+        not M — visible in the jaxpr's buffer shapes."""
+        from pytorch_operator_tpu.parallel import pipeline_value_and_grad
+
+        mesh = make_named_mesh({"pp": 2})
+        # activation width D_act differs from the token/target width so
+        # an M-deep ACTIVATION buffer is distinguishable from the
+        # (M, mb, D_in) microbatched inputs, which legitimately exist
+        S, M, mb, D_in, D_act = 2, 8, 2, 4, 16
+
+        def first_fn(extra, t):
+            return t @ extra["w_in"]
+
+        def stage_fn(p, x):
+            return jax.lax.scan(
+                lambda h, w: (jnp.tanh(h @ w), None), x, p)[0]
+
+        def last_fn(extra, y, t):
+            return jnp.sum((y @ extra["w_in"].T - t) ** 2) / M
+
+        params = jax.random.normal(jax.random.key(0),
+                                   (2, D_act, D_act)) * 0.3
+        extra = {"w_in": jax.random.normal(jax.random.key(2),
+                                           (D_in, D_act)) * 0.3}
+        x = jax.random.normal(jax.random.key(1), (M * mb, D_in))
+        jaxpr = jax.make_jaxpr(
+            lambda p, e, a, b: pipeline_value_and_grad(
+                p, e, a, b, first_fn=first_fn, stage_fn=stage_fn,
+                last_fn=last_fn, mesh=mesh, n_microbatches=M))(
+            params, extra, x, x)
+
+        def all_shapes(jxp):
+            for eqn in jxp.eqns:
+                for v in eqn.outvars:
+                    yield getattr(v.aval, "shape", ())
+                for param in eqn.params.values():
+                    inner = param
+                    if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+                        inner = inner.jaxpr
+                    if hasattr(inner, "eqns"):  # raw Jaxpr (shard_map)
+                        yield from all_shapes(inner)
+
+        shapes = list(all_shapes(jaxpr.jaxpr))
+        # the save ring exists at S slots...
+        assert any(s == (S, mb, D_act) for s in shapes), shapes[:20]
+        # ...and no M-deep activation buffer does (GPipe would save M)
+        assert not any(s[:1] == (M,) and s[1:] == (mb, D_act)
+                       for s in shapes), (
+            "found an M-deep activation buffer; 1F1B must save only S")
+
+
 class TestMoE:
     def test_forward_shapes_and_aux(self):
         cfg = moe.tiny()
