@@ -142,20 +142,40 @@ def main() -> int:
         parser.error("--remat-policy requires remat (drop --no-remat)")
     if args.ce_chunk < 1:
         parser.error(f"--ce-chunk must be >= 1, got {args.ce_chunk}")
-    if args.remat_policy == "save_attn" and not kernel_kw["use_flash"]:
-        parser.error("--remat-policy save_attn saves the flash kernel's "
-                     "(out, lse) residuals and requires --flash")
-    if args.remat_policy and args.remat_policy != "save_attn" and \
+    if args.remat_policy and not kernel_kw["use_flash"] and (
+            args.remat_policy == "auto"
+            or args.remat_policy.startswith("save_attn")):
+        parser.error(f"--remat-policy {args.remat_policy} resolves to the "
+                     f"save_attn family, which saves the flash kernel's "
+                     f"(out, lse) residuals and requires --flash")
+    if args.remat_policy and args.remat_policy not in ("auto",) and \
+            not (args.remat_policy == "save_attn"
+                 or args.remat_policy.startswith("save_attn+")) and \
             not hasattr(jax.checkpoint_policies, args.remat_policy):
         parser.error(f"unknown --remat-policy {args.remat_policy!r}; see "
-                     f"jax.checkpoint_policies for valid names, or "
-                     f"'save_attn' (models/llama.py)")
+                     f"jax.checkpoint_policies for valid names, "
+                     f"'save_attn[+qkv][+gateup][+normed]', or 'auto' "
+                     f"(models/llama.py)")
     if remat and args.remat_policy:
         kernel_kw["remat_policy"] = args.remat_policy
     if args.model == "7b":
         cfg = llama.llama2_7b(max_seq_len=args.seq_len, **kernel_kw)
     else:
         cfg = llama.tiny(max_seq_len=args.seq_len, **kernel_kw)
+    if cfg.remat and cfg.remat_policy == "auto":
+        # batch-adaptive tier from HBM-headroom math: fsdp shards the
+        # params+optimizer state; dp x fsdp (batch) x sp (sequence)
+        # shard the activations
+        import dataclasses as _dc
+
+        picked = llama.auto_remat_policy(
+            cfg, args.batch_size, args.seq_len,
+            state_shards=max(1, args.fsdp or 1),
+            token_shards=max(1, (args.dp or 1) * (args.fsdp or 1)
+                             * (args.sp or 1)))
+        print(f"[worker {pid}/{nprocs}] --remat-policy auto -> {picked}",
+              flush=True)
+        cfg = _dc.replace(cfg, remat_policy=picked)
 
     optimizer = optax.adamw(args.lr, weight_decay=0.1)
     if args.pp and args.sp:

@@ -60,6 +60,13 @@ class LlamaConfig:
     # the O(T^2) attention forward — the right trade at 16k/32k where
     # dots policies blow the compile-memory ceiling and full remat pays
     # a ~2x attention tax (BENCH_DETAIL §1b).  Requires use_flash.
+    #
+    # Round 5: "save_attn+<group>[+<group>...]" additionally saves named
+    # per-layer intermediates so the remat backward skips their
+    # recompute — groups from LAYER_SAVE_GROUPS ("qkv": post-RoPE
+    # projections, "gateup": the SwiGLU branches, "normed": the RMSNorm
+    # outputs).  Each group trades HBM for recompute FLOPs;
+    # auto_remat_policy picks the richest tier that fits the chip.
     remat_policy: Any = None
     use_flash: bool = False       # pallas flash-attention kernel (ops/)
     use_fused_norm: bool = False  # pallas fused RMSNorm kernel (ops/)
@@ -212,22 +219,47 @@ def _attention(q, k, v, cfg: LlamaConfig):
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+# Named per-layer intermediates the composite "save_attn+..." remat
+# policies may keep (checkpoint_name tags in _layer).  Saving a group
+# removes its recompute from the remat backward:
+#   qkv    post-RoPE q/k/v — the flash backward's inputs; saving them
+#          skips re-running attn-norm -> 3 projections -> RoPE
+#   gateup the SwiGLU branches (post-silu gate, up) — skips re-running
+#          mlp-norm -> 2 D x ffn_dim matmuls
+#   normed the two RMSNorm outputs — skips only the (bandwidth-bound)
+#          norm recompute; they remain the d/dW inputs of the
+#          projections either way
+LAYER_SAVE_GROUPS = {
+    "qkv": ("llama_proj_q", "llama_proj_k", "llama_proj_v"),
+    "gateup": ("llama_mlp_gate", "llama_mlp_up"),
+    "normed": ("llama_norm_attn", "llama_norm_mlp"),
+}
+
+
 def _layer(h, lp, cfg: LlamaConfig, cos, sin, attn=None):
+    from jax.ad_checkpoint import checkpoint_name
+
     B, T, D = h.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
 
     x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    x = checkpoint_name(x, "llama_norm_attn")
     q = jnp.einsum("btd,dk->btk", x, lp["wq"]).reshape(B, T, nh, hd)
     k = jnp.einsum("btd,dk->btk", x, lp["wk"]).reshape(B, T, nkv, hd)
     v = jnp.einsum("btd,dk->btk", x, lp["wv"]).reshape(B, T, nkv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    q = checkpoint_name(apply_rope(q, cos, sin), "llama_proj_q")
+    k = checkpoint_name(apply_rope(k, cos, sin), "llama_proj_k")
+    v = checkpoint_name(v, "llama_proj_v")
     attn = (attn or _attention)(q, k, v, cfg).reshape(B, T, nh * hd)
     h = h + jnp.einsum("btk,kd->btd", attn, lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.use_fused_norm)
-    gate = jax.nn.silu(jnp.einsum("btd,df->btf", x, lp["w_gate"]))
-    up = jnp.einsum("btd,df->btf", x, lp["w_up"])
+    x = checkpoint_name(x, "llama_norm_mlp")
+    gate = checkpoint_name(
+        jax.nn.silu(jnp.einsum("btd,df->btf", x, lp["w_gate"])),
+        "llama_mlp_gate")
+    up = checkpoint_name(jnp.einsum("btd,df->btf", x, lp["w_up"]),
+                         "llama_mlp_up")
     h = h + jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
     return h
 
@@ -240,22 +272,31 @@ def make_layer_body(cfg: LlamaConfig, cos, sin, attn=None):
     diverge between the parallel strategies."""
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin, attn=attn)
     if cfg.remat:
-        if cfg.remat_policy == "save_attn":
+        policy = cfg.remat_policy
+        if isinstance(policy, str) and (policy == "save_attn"
+                                        or policy.startswith("save_attn+")):
             from pytorch_operator_tpu.ops.flash_attention import (
                 FLASH_SAVE_NAMES,
             )
 
             if not cfg.use_flash:
                 raise ValueError(
-                    "remat_policy='save_attn' saves the flash kernel's "
+                    "remat_policy='save_attn...' saves the flash kernel's "
                     "(out, lse) residuals and requires use_flash=True")
+            names = list(FLASH_SAVE_NAMES)
+            for group in policy.split("+")[1:]:
+                if group not in LAYER_SAVE_GROUPS:
+                    raise ValueError(
+                        f"unknown save group {group!r} in remat_policy "
+                        f"{policy!r}; known: "
+                        f"{sorted(LAYER_SAVE_GROUPS)}")
+                names.extend(LAYER_SAVE_GROUPS[group])
             body = jax.checkpoint(
                 body, policy=jax.checkpoint_policies.save_only_these_names(
-                    *FLASH_SAVE_NAMES))
-        elif cfg.remat_policy:
+                    *names))
+        elif policy:
             body = jax.checkpoint(
-                body, policy=getattr(jax.checkpoint_policies,
-                                     cfg.remat_policy))
+                body, policy=getattr(jax.checkpoint_policies, policy))
         else:
             body = jax.checkpoint(body)
     return body
@@ -486,6 +527,67 @@ def sp_fsdp_param_specs(cfg: LlamaConfig) -> Params:
         },
         "final_norm": P(None),
     }
+
+
+def n_params(cfg: LlamaConfig) -> int:
+    """Parameter count (embed + stacked layers + final norm)."""
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    D, F, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    per_layer = (D * nh * hd + 2 * D * nkv * hd + nh * hd * D
+                 + 3 * D * F + 2 * D)
+    return cfg.vocab_size * D + L * per_layer + D
+
+
+def auto_remat_policy(
+    cfg: LlamaConfig,
+    batch: int,
+    seq_len: int,
+    *,
+    hbm_gb: float = 16.0,
+    reserve_gb: float = 2.5,
+    state_shards: int = 1,
+    token_shards: int = 1,
+) -> str:
+    """Pick the richest save_attn tier whose residuals fit the chip.
+
+    Batch-adaptive HBM-headroom math (round-5 verdict item 2): the
+    budget is ``hbm_gb`` minus params + optimizer state (AdamW mu/nu in
+    the param dtype) minus a transient ``reserve_gb`` (grad buffers,
+    chunked-CE scratch, XLA workspace); each candidate tier's per-layer
+    saved residuals are priced per token and the richest fitting tier
+    wins.
+
+    Sharding divides the two budgets DIFFERENTLY: ``state_shards`` is
+    the weight-sharding degree (fsdp only — sp/dp never shard params or
+    optimizer state, see sp_param_specs), while ``token_shards`` is the
+    activation-sharding degree (dp × fsdp over batch, × sp over
+    sequence).  Tiers are ordered by recompute FLOPs removed per saved
+    byte — the SwiGLU branches and the post-RoPE q/k/v carry ~equal
+    FLOPs/byte, the norm outputs only skip a bandwidth-bound recompute,
+    so they come last.
+    """
+    dsize = jnp.dtype(cfg.dtype).itemsize
+    state_bytes = n_params(cfg) * (dsize + 2 * dsize)  # params + mu + nu
+    budget = (hbm_gb - reserve_gb) * 2 ** 30 - state_bytes / state_shards
+    tokens = batch * seq_len / token_shards
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    # bytes/token of saved residuals per layer, by component
+    base = dsize * cfg.dim          # the layer-input residual h
+    flash = dsize * nh * hd + 4 * nh   # flash out (dtype) + lse (f32)
+    per_group = {
+        "qkv": dsize * hd * (nh + 2 * nkv),
+        "gateup": dsize * 2 * cfg.ffn_dim,
+        "normed": dsize * 2 * cfg.dim,
+    }
+    for tier in ("save_attn+qkv+gateup+normed", "save_attn+qkv+gateup",
+                 "save_attn+gateup", "save_attn+qkv",
+                 "save_attn+normed", "save_attn"):
+        per_token = base + flash + sum(
+            per_group[g] for g in tier.split("+")[1:])
+        if cfg.n_layers * tokens * per_token <= budget:
+            return tier
+    return "save_attn"
 
 
 def pp_param_specs(cfg: LlamaConfig, axis_name: str = "pp") -> Params:

@@ -162,7 +162,6 @@ def _1f1b_body(params_local, extra, x_mb, y_mb, *, first_fn, stage_fn,
     stage = lax.axis_index(axis_name)
     is_last = stage == S - 1
     M = x_mb.shape[0]
-    mb_shape = None  # filled below from a probe eval
 
     perm_f = [(i, (i + 1) % S) for i in range(S)]
     perm_b = [(i, (i - 1) % S) for i in range(S)]

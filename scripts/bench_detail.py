@@ -292,21 +292,27 @@ def bench_llama_long_seq(smoke: bool) -> list[dict]:
                          dtype=jnp.bfloat16)
         return [_measure_llama_step(cfg, 1, 128, 2)]
     rows = []
-    # Per-length measured-best batch + remat policy (2026-07-30 sweeps):
-    # dots_with_no_batch_dims_saveable (save matmul outputs) is fastest
-    # while its saved activations fit — B2 beats B1 at T=4096 (58.8% vs
-    # 55.2% MFU) and beats save_attn B4 (57.0%).  At 16k/32k the dots
-    # policy's compile blows the tunnel compile-helper's memory (HTTP
-    # 500, reproducible); round 3 fell back to FULL remat there
-    # (46.9%/42.7%).  Round 4's save_attn + chunked CE replaces that:
-    # 16k B2 52.2% (B2 only fits because the policy saves ~2 tensors
-    # per layer), 32k B1 47.6% (without chunked CE the config OOMs on
-    # two 3.9 GB logits-sized scatter-add buffers; with it the step
-    # fits with 4 MB to spare at ce chunk 1024).
+    # Per-length measured-best batch + remat policy (2026-07-30/31
+    # sweeps): dots_with_no_batch_dims_saveable (save matmul outputs)
+    # is fastest while its saved activations fit — B2 beats B1 at
+    # T=4096 (58.8% vs 55.2% MFU) and beats save_attn B4 (57.0%).  At
+    # 16k/32k the dots policy's compile blows the tunnel
+    # compile-helper's memory (HTTP 500, reproducible); round 3 fell
+    # back to FULL remat there (46.9%/42.7%).  Round 4's save_attn +
+    # chunked CE replaced that (16k B2 52.3%, 32k B1 47.8%).  Round 5
+    # added the composite save tiers (llama.LAYER_SAVE_GROUPS +
+    # auto_remat_policy): at 16k the measured-best that COMPILES on
+    # this tunnel is B1 save_attn+qkv (53.1%, also slightly more
+    # tokens/s than B2 save_attn); every richer tier (+gateup, +normed
+    # at B2, qkv+normed) hits the same compile-helper ceiling as dots,
+    # and host-offload of the SwiGLU branches compiles but runs 34.5%
+    # (tunnel host bandwidth).  At 32k nothing beyond save_attn
+    # compiles here.  On hardware with a local compiler the auto
+    # policy picks the richer tiers that this tunnel cannot build.
     for batch, seq, iters, policy, chunked in (
             (2, 4096, 6, "dots_with_no_batch_dims_saveable", False),
             (1, 8192, 5, "dots_with_no_batch_dims_saveable", False),
-            (2, 16384, 3, "save_attn", True),
+            (1, 16384, 3, "save_attn+qkv", True),
             (1, 32768, 2, "save_attn", True)):
         cfg = llama.LlamaConfig(
             vocab_size=32000, dim=2048, n_layers=16, n_heads=16,

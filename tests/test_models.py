@@ -120,6 +120,102 @@ class TestLlama:
         with pytest.raises(ValueError, match="use_flash"):
             llama.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
 
+    def test_composite_save_tiers_exact_and_fewer_recomputes(self):
+        """round-5 item 2: 'save_attn+qkv+gateup+normed' must (a) keep
+        grads exactly equal to plain save_attn and (b) strictly shrink
+        the backward's recompute (fewer dot_generals in the grad jaxpr
+        — the saved projections/SwiGLU matmuls are no longer re-run)."""
+        import dataclasses
+
+        cfg0 = llama.tiny(max_seq_len=128, n_heads=4, n_kv_heads=2,
+                          dim=64, use_flash=True)
+        params = llama.init_params(jax.random.key(3), cfg0)
+        tokens = jax.random.randint(jax.random.key(4), (1, 128), 0,
+                                    cfg0.vocab_size)
+
+        def grads_and_dots(cfg):
+            def loss(p):
+                return jnp.mean(llama.forward(p, tokens, cfg) ** 2)
+
+            jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+            n_dots = 0
+            seen: set = set()
+
+            def walk(jx):
+                nonlocal n_dots
+                if id(jx) in seen:
+                    return
+                seen.add(id(jx))
+                for eqn in jx.eqns:
+                    if eqn.primitive.name == "dot_general":
+                        n_dots += 1
+                    for v in eqn.params.values():
+                        stack = [v]
+                        while stack:
+                            x = stack.pop()
+                            if hasattr(x, "eqns"):
+                                walk(x)
+                            elif hasattr(x, "jaxpr"):
+                                walk(x.jaxpr)
+                            elif isinstance(x, (list, tuple)):
+                                stack.extend(x)
+
+            walk(jaxpr.jaxpr)
+            return jax.grad(loss)(params), n_dots
+
+        base = dataclasses.replace(cfg0, remat=True,
+                                   remat_policy="save_attn")
+        rich = dataclasses.replace(
+            cfg0, remat=True,
+            remat_policy="save_attn+qkv+gateup+normed")
+        g_base, dots_base = grads_and_dots(base)
+        g_rich, dots_rich = grads_and_dots(rich)
+        assert dots_rich < dots_base, (dots_rich, dots_base)
+        for a, b in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_rich)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+
+    def test_composite_save_tier_unknown_group_rejected(self):
+        cfg = llama.tiny(use_flash=True, remat=True,
+                         remat_policy="save_attn+bogus")
+        params = llama.init_params(jax.random.key(0), cfg)
+        with pytest.raises(ValueError, match="unknown save group"):
+            llama.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+
+    def test_auto_remat_policy_headroom_math(self):
+        """The batch-adaptive selector: richest tier at short T, leaner
+        tiers as saved bytes grow, never an invalid policy; fsdp/sp
+        sharding restores headroom."""
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, ffn_dim=5632, max_seq_len=32768,
+            dtype=jnp.bfloat16, use_flash=True, remat=True)
+        assert llama.n_params(cfg) == pytest.approx(888e6, rel=0.01)
+        rich = llama.auto_remat_policy(cfg, 2, 4096)
+        lean = llama.auto_remat_policy(cfg, 1, 32768)
+        assert rich == "save_attn+qkv+gateup+normed"
+        assert lean in ("save_attn", "save_attn+normed")
+        # monotone: more tokens never yields a richer tier
+        order = ["save_attn", "save_attn+normed", "save_attn+qkv",
+                 "save_attn+gateup", "save_attn+qkv+gateup",
+                 "save_attn+qkv+gateup+normed"]
+        prev = len(order)
+        for toks in (4096, 8192, 16384, 32768, 65536):
+            tier = llama.auto_remat_policy(cfg, 1, toks)
+            assert tier in order
+            assert order.index(tier) <= prev
+            prev = order.index(tier)
+        # fsdp sharding (state + activations) restores headroom
+        sharded = llama.auto_remat_policy(cfg, 8, 32768, state_shards=8,
+                                          token_shards=8)
+        assert order.index(sharded) >= order.index(lean)
+        # sp shards TOKENS but never the optimizer state: at sp=8 the
+        # replicated ~5.3 GB state must still be charged in full, so
+        # the tier is leaner than the fsdp=8 case with equal tokens
+        sp_only = llama.auto_remat_policy(cfg, 8, 32768, state_shards=1,
+                                          token_shards=8)
+        assert order.index(sp_only) <= order.index(sharded)
+
     @pytest.mark.parametrize("T,chunk", [(256, 128), (300, 128), (64, 2048)])
     def test_chunked_tied_ce_matches_full_head(self, T, chunk):
         """chunked_tied_ce == cross_entropy_loss(full logits) for exact,
