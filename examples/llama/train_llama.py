@@ -163,17 +163,33 @@ def main() -> int:
     else:
         cfg = llama.tiny(max_seq_len=args.seq_len, **kernel_kw)
     if cfg.remat and cfg.remat_policy == "auto":
-        # batch-adaptive tier from HBM-headroom math: fsdp shards the
+        # batch-adaptive tier from HBM-headroom math, charged with the
+        # SAME sharding the mesh branches below will build: fsdp shards
         # params+optimizer state; dp x fsdp (batch) x sp (sequence)
-        # shard the activations
+        # shard activations; a pp mesh shards the layer stack (state)
+        # per stage; the default layout resolves its dp/fsdp/tp with
+        # the same factor_devices call the mesh branch uses
         import dataclasses as _dc
 
+        if args.sp:
+            state_shards = max(1, args.fsdp or 1)
+            token_shards = max(1, (args.dp or 1) * (args.fsdp or 1)
+                               * args.sp)
+        elif args.pp:
+            state_shards = args.pp
+            token_shards = 1  # microbatching bounds activations instead
+        elif args.dp or args.fsdp or args.tp:
+            state_shards = max(1, args.fsdp or 1)
+            token_shards = max(1, (args.dp or 1) * (args.fsdp or 1))
+        else:
+            a_dp, a_fsdp, _a_tp = factor_devices(n, tp_max=4)
+            state_shards = a_fsdp
+            token_shards = a_dp * a_fsdp
         picked = llama.auto_remat_policy(
             cfg, args.batch_size, args.seq_len,
-            state_shards=max(1, args.fsdp or 1),
-            token_shards=max(1, (args.dp or 1) * (args.fsdp or 1)
-                             * (args.sp or 1)))
-        print(f"[worker {pid}/{nprocs}] --remat-policy auto -> {picked}",
+            state_shards=state_shards, token_shards=token_shards)
+        print(f"[worker {pid}/{nprocs}] --remat-policy auto -> {picked} "
+              f"(state/{state_shards}, tokens/{token_shards})",
               flush=True)
         cfg = _dc.replace(cfg, remat_policy=picked)
 

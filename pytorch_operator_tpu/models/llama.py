@@ -84,10 +84,12 @@ class LlamaConfig:
 
 def llama2_7b(**kw) -> LlamaConfig:
     """The BASELINE.json config-5 model (Llama-2-7B)."""
-    return LlamaConfig(
+    defaults = dict(
         vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
-        n_kv_heads=32, ffn_dim=11008, max_seq_len=4096, **kw,
+        n_kv_heads=32, ffn_dim=11008, max_seq_len=4096,
     )
+    defaults.update(kw)  # callers may override any default (max_seq_len!)
+    return LlamaConfig(**defaults)
 
 
 def tiny(**kw) -> LlamaConfig:
@@ -273,6 +275,13 @@ def make_layer_body(cfg: LlamaConfig, cos, sin, attn=None):
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin, attn=attn)
     if cfg.remat:
         policy = cfg.remat_policy
+        if policy == "auto":
+            raise ValueError(
+                "remat_policy='auto' is a selection request, not a "
+                "policy: resolve it with llama.auto_remat_policy(cfg, "
+                "batch, seq_len, ...) and set the returned tier on the "
+                "config (the example CLI does this for --remat-policy "
+                "auto)")
         if isinstance(policy, str) and (policy == "save_attn"
                                         or policy.startswith("save_attn+")):
             from pytorch_operator_tpu.ops.flash_attention import (

@@ -203,6 +203,148 @@ def run_http(jobs: int, workers: int, variant: str = "native",
         srv.stop()
 
 
+def run_storm(jobs: int, workers: int, variant: str = "native",
+              n_streams: int = 64, event_hz: int = 50,
+              threadiness: int = 8) -> dict:
+    """Event-storm tier (round-5 verdict item 5): N ACTIVE watch streams
+    each RECEIVING a steady event flow while the controller syncs jobs
+    through ``threadiness`` workers — the regime the native transport's
+    per-event cost (C++ dechunking + line framing vs http.client
+    buffered reads) could plausibly win, as opposed to the parked tier
+    where streams are idle.
+
+    A generator thread patches a rotating set of Services in a dedicated
+    namespace at ``event_hz``; every MODIFIED fans out to all
+    ``n_streams`` watch connections (total deliveries/s ≈ n_streams ×
+    event_hz), each delivery crossing the transport into a Python
+    listener.  Reaction latency of real jobs is then measured under
+    that standing load.  The delivered-event rate is recorded so the
+    achieved load is part of the artifact.
+    """
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+    _set_variant(variant)
+    srv = StubApiServer().start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    url = f"http://127.0.0.1:{srv.port}"
+
+    delivered = [0]
+    lock = threading.Lock()
+
+    def _count(_etype, _obj):
+        with lock:
+            delivered[0] += 1
+
+    watchers = []
+    for _ in range(n_streams):
+        c = RestCluster(KubeConfig.from_url(url), namespace="storm")
+        c.services.add_listener(_count)
+        watchers.append(c)
+
+    svc_names = [f"storm-svc-{i}" for i in range(16)]
+    for nm in svc_names:
+        srv.cluster.services.create("storm", {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": nm, "namespace": "storm"},
+            "spec": {"clusterIP": "None"}})
+
+    stop_gen = threading.Event()
+
+    def generate():
+        i = 0
+        # burst pacing: 10ms granularity is reliable where 1/hz sleeps
+        # are not
+        per_burst = max(1, event_hz // 100)
+        while not stop_gen.is_set():
+            for _ in range(per_burst):
+                nm = svc_names[i % len(svc_names)]
+                try:
+                    srv.cluster.services.patch("storm", nm, {
+                        "metadata": {"labels": {"tick": str(i)}}})
+                except NotFoundError:
+                    pass
+                i += 1
+            stop_gen.wait(per_burst / event_hz)
+
+    gen = threading.Thread(target=generate, daemon=True)
+    gen.start()
+
+    rest = RestCluster(KubeConfig.from_url(url), namespace="default")
+    ctl = PyTorchController(rest, config=JobControllerConfig(),
+                            registry=Registry())
+    stop = threading.Event()
+    ctl.run(threadiness=threadiness, stop_event=stop)
+    # measure deliveries over exactly the bench window: reset the
+    # counter at t0 and snapshot it before teardown, so setup fan-out
+    # (64 x 16 ADDED events) and pre/post-window generator traffic
+    # can't inflate the reported rate past the generator's theoretical
+    # streams x hz maximum
+    with lock:
+        delivered[0] = 0
+    t0 = time.perf_counter()
+    try:
+        res = bench_tier(rest, rest, jobs, workers)
+    finally:
+        wall = time.perf_counter() - t0
+        with lock:
+            window_delivered = delivered[0]
+        stop_gen.set()
+        stop.set()
+        ctl.work_queue.shutdown()
+        for c in watchers:
+            c.close()
+        kubelet.stop()
+        rest.close()
+        srv.stop()
+    res["storm_streams"] = n_streams
+    res["storm_target_hz"] = event_hz
+    res["storm_delivered"] = window_delivered
+    res["storm_delivered_per_s"] = round(window_delivered / wall, 1)
+    res["threadiness"] = threadiness
+    return res
+
+
+def run_storm_rounds(jobs: int, workers: int, *, rounds: int = 5,
+                     n_streams: int = 64, event_hz: int = 50,
+                     threadiness: int = 8) -> dict:
+    """Interleaved A/B storm rounds (ABAB...), medians across rounds.
+
+    A single storm round on a shared 1-core box is noisy enough to
+    produce a spurious 1.6x either way (measured 2026-07-31: six
+    single rounds ranged native 32.6-53.5 ms p95 vs python 31.7-57.9);
+    the verdict therefore uses the per-variant MEDIAN across
+    interleaved rounds, with every round's raw p95 kept in the
+    artifact.
+    """
+    series: dict = {"native": [], "python": []}
+    for _ in range(rounds):
+        for variant in ("native", "python"):
+            series[variant].append(run_storm(
+                jobs, workers, variant, n_streams=n_streams,
+                event_hz=event_hz, threadiness=threadiness))
+    out = {}
+    for variant, runs in series.items():
+        agg = dict(runs[0])
+        for key in ("first_pod", "all_pods", "running", "succeeded"):
+            med = [r[key]["median_ms"] for r in runs if r[key]["n"]]
+            p95 = [r[key]["p95_ms"] for r in runs if r[key]["n"]]
+            agg[key] = {
+                "median_ms": round(statistics.median(med), 1) if med else 0,
+                "p95_ms": round(statistics.median(p95), 1) if p95 else 0,
+                "n": sum(r[key]["n"] for r in runs),
+            }
+        agg["storm_delivered_per_s"] = round(statistics.median(
+            [r["storm_delivered_per_s"] for r in runs]), 1)
+        # one round's raw count next to 5-round n's would mislead; the
+        # medianed rate above is the comparable number
+        agg.pop("storm_delivered", None)
+        agg["rounds_p95_first_pod"] = [r["first_pod"]["p95_ms"]
+                                       for r in runs]
+        out[f"storm_{variant}"] = agg
+    return out
+
+
 def run_churn(jobs: int, workers: int, threadiness: int = 4,
               variant: str = "native", timeout: float = 300.0) -> dict:
     """Convergence under load: `jobs` jobs with interleaved
@@ -299,6 +441,60 @@ def _parked_reading(results: dict) -> str:
         "and the TLS transport (native/__init__.py).")
 
 
+def _storm_reading(results: dict) -> str:
+    """Verdict for the event-storm tier, computed from THIS run: either
+    the native core demonstrably wins the active-stream regime (>=1.3x
+    on a p95) or the positioning is demoted to 'TLS transport +
+    equivalence-tested alternates' — the round-5 verdict's either/or."""
+    if "storm_native" not in results or "storm_python" not in results:
+        return ""
+    sn, sp = results["storm_native"], results["storm_python"]
+    cores = os.cpu_count() or 1
+    rate = (f"{sn['storm_streams']} active streams at ~"
+            f"{sn['storm_delivered_per_s']}/"
+            f"{sp['storm_delivered_per_s']} delivered events/s "
+            f"(native/python), threadiness {sn['threadiness']}, "
+            f"{cores} core(s)")
+    ratios = []
+    for key in ("first_pod", "all_pods"):
+        nb, pb = sn[key]["p95_ms"], sp[key]["p95_ms"]
+        if nb and pb:
+            ratios.append((key, nb, pb, pb / nb))
+    if not ratios:
+        return ("  **Event-storm tier produced no comparable p95s** — "
+                "no conclusion drawn.")
+    rounds = (f"  Raw interleaved first-pod p95 rounds (ms): native "
+              f"{sn.get('rounds_p95_first_pod')}, python "
+              f"{sp.get('rounds_p95_first_pod')} — the verdict uses "
+              f"medians across rounds because a single round on a "
+              f"shared box can show a spurious 1.6x either way.")
+    best = max(ratios, key=lambda r: r[3])
+    key, nb, pb, ratio = best
+    if ratio >= 1.3:
+        txt = (f"  **Event-storm verdict ({rate}): the native core wins "
+               f"the active-stream regime on this run** — {key} p95 "
+               f"{nb} ms native vs {pb} ms python ({ratio:.2f}x median "
+               f"across interleaved rounds).  Per-event transport cost "
+               f"(C++ dechunk + line framing vs http.client buffered "
+               f"reads) is the difference; the C++ workqueue/"
+               f"expectations/store ride along." + rounds)
+    else:
+        txt = (f"  **Event-storm verdict ({rate}): no native win "
+               f"(best p95 edge {ratio:.2f}x on {key}; the bar was "
+               f"1.3x).**  Accordingly the native core's honest "
+               f"positioning is: the TLS transport is the load-bearing "
+               f"piece (OpenSSL via dlopen, hostname verification, "
+               f"truncation-safe framing — capabilities the Python "
+               f"fallback lacks entirely), while the C++ workqueue/"
+               f"expectations/store are equivalence-tested ALTERNATES "
+               f"with no demonstrated perf regime on this hardware"
+               + (f" — note this box has {cores} core(s), so GIL-free "
+                  f"blocking cannot buy parallelism here; a multi-core "
+                  f"deployment is where the claim could be re-tested"
+                  if cores < 2 else "") + "." + rounds)
+    return txt
+
+
 def render_md(results: dict, jobs: int, workers: int,
               churn_jobs: int, churn_workers: int) -> str:
     now = datetime.datetime.now(datetime.timezone.utc).strftime(
@@ -344,6 +540,15 @@ def render_md(results: dict, jobs: int, workers: int,
                          if k.startswith("parked")})
         for variant in ("native", "python")
     ] + [
+        row(f"storm ({results[f'storm_{variant}']['storm_streams']} "
+            f"active streams, "
+            f"~{results[f'storm_{variant}']['storm_delivered_per_s']} "
+            f"ev/s, t{results[f'storm_{variant}']['threadiness']}) "
+            f"/ {variant}",
+            results[f"storm_{variant}"])
+        for variant in ("native", "python")
+        if f"storm_{variant}" in results
+    ] + [
         "",
         "The `parked` rows re-run the http tier while N extra watch "
         "streams sit open on quiet namespaces (one connection + reader "
@@ -378,6 +583,8 @@ def render_md(results: dict, jobs: int, workers: int,
         "",
         _ab_reading(results),
         "",
+        _storm_reading(results),
+        "",
         "Reference anchors (BASELINE.md): the operator-independent "
         "create->start sample on GKE is 5m34s (image pull + scheduling "
         "dominated) with a 10-minute create->Succeeded e2e envelope; "
@@ -401,6 +608,13 @@ def main() -> None:
     ap.add_argument("--churn-workers", type=int, default=4)
     ap.add_argument("--parked", type=int, nargs="*", default=[8, 64],
                     help="parked-watch-stream counts for the GIL tier")
+    ap.add_argument("--storm-streams", type=int, default=64,
+                    help="ACTIVE watch streams for the event-storm tier "
+                         "(0 disables)")
+    ap.add_argument("--storm-hz", type=int, default=50,
+                    help="event generation rate; deliveries/s = "
+                         "streams x hz")
+    ap.add_argument("--storm-threadiness", type=int, default=8)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -433,6 +647,17 @@ def main() -> None:
                 args.churn_jobs, args.churn_workers, variant=variant)
             print(json.dumps({"tier": f"churn_{variant}",
                               **results[f"churn_{variant}"]}))
+        if args.storm_streams:
+            print(f"[bench_cp] storm ({args.storm_streams} streams x "
+                  f"{args.storm_hz} Hz, 5 interleaved A/B rounds)...",
+                  file=sys.stderr)
+            results.update(run_storm_rounds(
+                args.jobs, args.workers,
+                n_streams=args.storm_streams, event_hz=args.storm_hz,
+                threadiness=args.storm_threadiness))
+            for variant in ("native", "python"):
+                print(json.dumps({"tier": f"storm_{variant}",
+                                  **results[f"storm_{variant}"]}))
     finally:
         if saved is None:
             os.environ.pop("PYTORCH_OPERATOR_NATIVE", None)
