@@ -176,3 +176,52 @@ def test_validate_master_required():
     spec = _spec_with([Container(name="pytorch", image="img")], rtype="Worker")
     with pytest.raises(ValidationError, match="Master ReplicaSpec must be present"):
         validate_spec(spec)
+
+
+def test_example_manifests_pass_framework_validation():
+    """Every shipped example PyTorchJob YAML must convert and validate
+    through the controller's own conversion path (serde.from_dict +
+    set_defaults + validate_spec) — a manifest that the controller
+    would mark Failed-on-arrival must not ship as an example."""
+    import os
+
+    import yaml
+
+    from pytorch_operator_tpu.api.v1.types import PyTorchJob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifests = []
+    for root, _dirs, files in os.walk(os.path.join(repo, "examples")):
+        manifests += [os.path.join(root, f) for f in files
+                      if f.endswith(".yaml")]
+    assert manifests, "no example manifests found"
+    n_jobs = 0
+    for path in sorted(manifests):
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+        # companion docs (Services, ConfigMaps, kustomizations) are
+        # allowed; only PyTorchJob docs go through the controller path
+        jobs = [d for d in docs
+                if isinstance(d, dict) and d.get("kind") == "PyTorchJob"]
+        n_jobs += len(jobs)
+        for wire in jobs:
+            job = serde.from_dict(PyTorchJob, wire)
+            set_defaults(job)
+            validate_spec(job.spec)  # ValidationError on a bad example
+
+            # TPU-first contract: no example REQUESTS nvidia.com/gpu
+            # (the string may appear in explanatory comments; check the
+            # parsed resource keys, not the raw text)
+            def resource_keys(node):
+                if isinstance(node, dict):
+                    for k, v in node.items():
+                        if k in ("limits", "requests") and \
+                                isinstance(v, dict):
+                            yield from v.keys()
+                        yield from resource_keys(v)
+                elif isinstance(node, list):
+                    for item in node:
+                        yield from resource_keys(item)
+
+            assert "nvidia.com/gpu" not in set(resource_keys(wire)), path
+    assert n_jobs >= 6, f"expected the shipped job examples, saw {n_jobs}"
