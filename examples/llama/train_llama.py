@@ -54,9 +54,11 @@ def main() -> int:
                              "activations instead of O(microbatches))")
     parser.add_argument("--sp", type=int, default=0,
                         help="sequence-parallel degree for long contexts; "
-                             "composes with --dp/--fsdp (dp*fsdp*sp must "
-                             "equal the device count; --fsdp adds ZeRO-3 "
-                             "param sharding — the 7B v5p-128 layout)")
+                             "composes with --dp/--fsdp/--tp "
+                             "(dp*fsdp*sp*tp must equal the device "
+                             "count; --fsdp adds ZeRO-3 param sharding — "
+                             "the 7B v5p-128 layout — and --tp "
+                             "head-shards the attention inside SP)")
     parser.add_argument("--sp-impl", choices=["ulysses", "ring"],
                         default="ulysses",
                         help="attention strategy under --sp: all-to-all "
@@ -172,18 +174,22 @@ def main() -> int:
         import dataclasses as _dc
 
         if args.sp:
-            state_shards = max(1, args.fsdp or 1)
+            # under SP×TP the weights carry the fsdp×tp layout
+            # (llama.param_specs), so state shards over BOTH axes;
+            # tokens are charged without the tp division (tp narrows
+            # only the head/ffn-width saved tensors) — conservative
+            state_shards = max(1, (args.fsdp or 1) * (args.tp or 1))
             token_shards = max(1, (args.dp or 1) * (args.fsdp or 1)
                                * args.sp)
         elif args.pp:
             state_shards = args.pp
             token_shards = 1  # microbatching bounds activations instead
         elif args.dp or args.fsdp or args.tp:
-            state_shards = max(1, args.fsdp or 1)
+            state_shards = max(1, (args.fsdp or 1) * (args.tp or 1))
             token_shards = max(1, (args.dp or 1) * (args.fsdp or 1))
         else:
-            a_dp, a_fsdp, _a_tp = factor_devices(n, tp_max=4)
-            state_shards = a_fsdp
+            a_dp, a_fsdp, a_tp = factor_devices(n, tp_max=4)
+            state_shards = a_fsdp * a_tp
             token_shards = a_dp * a_fsdp
         picked = llama.auto_remat_policy(
             cfg, args.batch_size, args.seq_len,
@@ -197,15 +203,14 @@ def main() -> int:
     if args.pp and args.sp:
         parser.error("--pp and --sp are mutually exclusive layouts")
     if args.sp:
-        # SP composes with --dp and --fsdp (round 5): params + optimizer
-        # state ZeRO-3-shard over fsdp, sequence over sp, batch over
-        # dp×fsdp — the Llama-2-7B v5p-128 layout (BASELINE.md config 5,
-        # e.g. --fsdp 16 --sp 8).  tp stays exclusive of sp.
-        if args.tp:
-            parser.error("--sp cannot be combined with --tp")
-        sp_dp, sp_fsdp = args.dp or 1, args.fsdp or 1
-        if sp_dp * sp_fsdp * args.sp != n:
-            parser.error(f"--dp*--fsdp*--sp = {sp_dp * sp_fsdp * args.sp} "
+        # SP composes with --dp, --fsdp and --tp (round 5): params +
+        # optimizer state ZeRO-3-shard over fsdp (and heads/ffn over
+        # tp), sequence over sp, batch over dp×fsdp — the Llama-2-7B
+        # v5p-128 layout (BASELINE.md config 5, e.g. --fsdp 16 --sp 8).
+        sp_dp, sp_fsdp, sp_tp = args.dp or 1, args.fsdp or 1, args.tp or 1
+        if sp_dp * sp_fsdp * args.sp * sp_tp != n:
+            parser.error(f"--dp*--fsdp*--sp*--tp = "
+                         f"{sp_dp * sp_fsdp * args.sp * sp_tp} "
                          f"!= {n} devices")
         if args.seq_len % args.sp:
             parser.error(f"--seq-len {args.seq_len} not divisible by --sp")
@@ -215,19 +220,32 @@ def main() -> int:
             # work) — reject up front like every other layout mismatch
             parser.error(f"--batch-size {args.batch_size} not divisible "
                          f"by --dp*--fsdp = {sp_dp * sp_fsdp}")
-        if args.sp_impl == "ulysses" and cfg.n_heads % args.sp:
-            parser.error(f"n_heads {cfg.n_heads} not divisible by --sp "
-                         f"(use --sp-impl ring)")
+        if sp_tp > 1 and (cfg.n_heads % sp_tp or cfg.n_kv_heads % sp_tp):
+            parser.error(f"n_heads {cfg.n_heads}/n_kv_heads "
+                         f"{cfg.n_kv_heads} not divisible by --tp {sp_tp}")
+        if args.sp_impl == "ulysses" and \
+                (cfg.n_heads // sp_tp) % args.sp:
+            parser.error(f"n_heads per tp shard "
+                         f"({cfg.n_heads // sp_tp}) not divisible by "
+                         f"--sp (use --sp-impl ring)")
         from pytorch_operator_tpu.parallel import make_sp_train_step
         from pytorch_operator_tpu.parallel.mesh import make_sp_mesh
 
-        mesh = make_sp_mesh(dp=sp_dp, sp=args.sp, fsdp=sp_fsdp)
-        specs = (llama.sp_fsdp_param_specs(cfg) if sp_fsdp > 1
-                 else llama.sp_param_specs(cfg))
+        mesh = make_sp_mesh(dp=sp_dp, sp=args.sp, fsdp=sp_fsdp, tp=sp_tp)
+        if sp_tp > 1:
+            specs = llama.param_specs(cfg)  # fsdp×tp weight layout
+        elif sp_fsdp > 1:
+            specs = llama.sp_fsdp_param_specs(cfg)
+        else:
+            specs = llama.sp_param_specs(cfg)
+        layout = args.sp_impl
+        if sp_fsdp > 1:
+            layout += ", zero-3 params"
+        if sp_tp > 1:
+            layout += ", tensor-parallel heads/ffn"
         print(f"[worker {pid}/{nprocs}] sequence-parallel mesh "
-              f"dp={sp_dp} fsdp={sp_fsdp} sp={args.sp} "
-              f"({args.sp_impl}{', zero-3 params' if sp_fsdp > 1 else ''}) "
-              f"over {n} devices", flush=True)
+              f"dp={sp_dp} fsdp={sp_fsdp} sp={args.sp} tp={sp_tp} "
+              f"({layout}) over {n} devices", flush=True)
         state = sharded_init(cfg, mesh, optimizer, specs=specs)
         step_fn = make_sp_train_step(cfg, mesh, optimizer,
                                      impl=args.sp_impl,

@@ -456,6 +456,15 @@ def forward_sp(
         raise ValueError(f"unknown sp impl {impl!r}")
 
     batch_axes = data_axes(mesh, tokens.shape[0])
+    # SP×TP: a tp axis on the mesh head-shards the attention (each tp
+    # shard runs the ring/all-to-all over its own head slice) — pair
+    # with llama.param_specs, whose fsdp×tp weight layout produces
+    # head-sharded q/k/v at the projections
+    from pytorch_operator_tpu.parallel.mesh import head_shard_degree
+
+    head_axes: tuple = (AXIS_TP,) if mesh.shape.get(AXIS_TP, 1) > 1 else ()
+    tp_deg = head_shard_degree(mesh, head_axes, cfg.n_heads,
+                               cfg.n_kv_heads)
 
     def attn(q, k, v, cfg):
         # Both SP strategies are GQA-native: the ring rotates unrepeated
@@ -468,19 +477,23 @@ def forward_sp(
         # repeat keeps the query-group -> kv-head mapping, since
         # (h // (H/kv_new)) // r == h // (H/kv).
         sp_deg = mesh.shape[axis_name]
-        if impl == "ulysses" and cfg.n_kv_heads % sp_deg:
+        kv_local = cfg.n_kv_heads // tp_deg  # per-tp-shard kv heads
+        if impl == "ulysses" and kv_local % sp_deg:
             # lcm(kv, sp) always divides H for configs ulysses accepts
             # (it requires sp | H, and kv | H by construction), so the
-            # minimal repeat is always valid
-            r = math.lcm(cfg.n_kv_heads, sp_deg) // cfg.n_kv_heads
+            # minimal repeat is always valid; under SP×TP the counts
+            # that must divide are the per-tp-shard ones
+            r = math.lcm(kv_local, sp_deg) // kv_local
             k = jnp.repeat(k, r, axis=2)
             v = jnp.repeat(v, r, axis=2)
         if impl == "ulysses":
             return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      use_flash=cfg.use_flash,
-                                     batch_axes=batch_axes)
+                                     batch_axes=batch_axes,
+                                     head_axes=head_axes)
         return ring_attention(q, k, v, mesh, axis_name=axis_name,
-                              batch_axes=batch_axes).astype(q.dtype)
+                              batch_axes=batch_axes,
+                              head_axes=head_axes).astype(q.dtype)
 
     def apply_stack(layers, h, body):
         # pin the (B, T, D) activations to the sequence-sharded layout

@@ -68,24 +68,54 @@ def make_mesh(
     return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_TP))
 
 
-def make_sp_mesh(dp: int = 1, sp: int = 1, *, fsdp: int = 1, devices=None) -> Mesh:
-    """Build a (dp, fsdp, sp) mesh for sequence-parallel training.
+def make_sp_mesh(dp: int = 1, sp: int = 1, *, fsdp: int = 1, tp: int = 1,
+                 devices=None) -> Mesh:
+    """Build a (dp, fsdp, sp[, tp]) mesh for sequence-parallel training.
 
     ``fsdp`` composes ZeRO-3 weight sharding with sequence parallelism —
     the layout the Llama-2-7B v5p-128 flagship config needs (BASELINE.md
     config 5): parameters + optimizer state sharded over fsdp
     (llama.sp_fsdp_param_specs), activations sharded over sp, batch over
-    dp×fsdp.  The sp axis is innermost so ring ppermutes / Ulysses
-    all-to-alls ride ICI neighbours.
+    dp×fsdp.  ``tp`` adds Megatron-style tensor parallelism on top
+    (heads/ffn sharded — pair with llama.param_specs, which already
+    carries the fsdp×tp weight layout): attention then runs
+    head-sharded INSIDE the sequence-parallel shard_maps.  tp is the
+    innermost axis (its per-layer collectives are the most frequent),
+    sp next (ring ppermutes / Ulysses all-to-alls still ride ICI).
+    A tp=1 mesh keeps the historical (dp, fsdp, sp) axis set.
     """
     if devices is None:
         devices = jax.devices()
-    n = dp * fsdp * sp
+    n = dp * fsdp * sp * tp
     if len(devices) < n:
         raise ValueError(
-            f"mesh ({dp},{fsdp},{sp}) needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp)
-    return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_SP))
+            f"mesh ({dp},{fsdp},{sp},{tp}) needs {n} devices, "
+            f"have {len(devices)}")
+    if tp == 1:
+        arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp)
+        return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_SP))
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP))
+
+
+def head_shard_degree(mesh: Mesh, head_axes: tuple[str, ...],
+                      n_heads: int, n_kv_heads: int) -> int:
+    """Product of the head-sharding (tensor-parallel) axes, validated.
+
+    The single source of the SP×TP head-divisibility rule, shared by
+    ring_attention, ulysses_attention and llama.forward_sp so the two
+    SP implementations cannot drift: every head-axis product must
+    divide BOTH head counts (each tp shard owns whole q and kv heads).
+    """
+    if not head_axes:
+        return 1
+    deg = math.prod(mesh.shape[a] for a in head_axes)
+    if n_heads % deg or n_kv_heads % deg:
+        raise ValueError(
+            f"the mesh's head axes {head_axes} (product {deg}) must "
+            f"divide both head counts for SP×TP; got n_heads={n_heads}, "
+            f"n_kv_heads={n_kv_heads}")
+    return deg
 
 
 def data_axes(mesh: Mesh, batch_size: int | None = None) -> tuple[str, ...]:

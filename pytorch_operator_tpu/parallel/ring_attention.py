@@ -149,6 +149,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     batch_axes: tuple[str, ...] = (),
+    head_axes: tuple[str, ...] = (),
 ) -> jax.Array:
     """Exact causal attention with sequence sharded over ``axis_name``.
 
@@ -180,9 +181,14 @@ def ring_attention(
     interpret = jax.default_backend() != "tpu"
     # batch_axes: data-parallel mesh axes (dp/fsdp) the batch dim is
     # sharded over — the SP×FSDP composition (llama.forward_sp passes
-    # parallel.mesh.data_axes) — the ring itself only ever rotates over
-    # ``axis_name``; batch stays embarrassingly parallel.
-    spec = P(batch_axes or None, axis_name, None, None)
+    # parallel.mesh.data_axes); head_axes: tensor-parallel axes the
+    # HEAD dim is sharded over (SP×TP — attention is embarrassingly
+    # parallel per head, so the ring only ever rotates over
+    # ``axis_name`` while each tp shard works its own head slice).
+    from pytorch_operator_tpu.parallel.mesh import head_shard_degree
+
+    head_shard_degree(mesh, head_axes, H, Hk)
+    spec = P(batch_axes or None, axis_name, head_axes or None, None)
     fn = jax.shard_map(
         partial(
             _ring_body, axis_name=axis_name, causal=causal,

@@ -79,6 +79,7 @@ def ulysses_attention(
     causal: bool = True,
     use_flash: bool = False,
     batch_axes: tuple[str, ...] = (),
+    head_axes: tuple[str, ...] = (),
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
@@ -93,25 +94,34 @@ def ulysses_attention(
     through the two all_to_alls.  Returns (B, T, H, Dh) sharded the
     same way as the inputs.
     """
+    from pytorch_operator_tpu.parallel.mesh import head_shard_degree
+
     n = mesh.shape[axis_name]
     B, T, H, Dh = q.shape
     Hk = k.shape[2]
+    # head_axes: tensor-parallel axes the head dim is ALSO sharded over
+    # (SP×TP): each tp shard runs its own ulysses over its local head
+    # slice, so the divisibility requirements apply to the per-shard
+    # head counts
+    tp_deg = head_shard_degree(mesh, head_axes, H, Hk)
+    H_l, Hk_l = H // tp_deg, Hk // tp_deg
     if T % n:
         raise ValueError(f"seq len {T} not divisible by {axis_name}={n}")
-    if H % n:
-        raise ValueError(f"{H} heads not divisible by {axis_name}={n} "
-                         f"(all-to-all SP shards heads; use ring_attention "
-                         f"for head counts below the mesh axis)")
+    if H_l % n:
+        raise ValueError(f"{H_l} heads/shard not divisible by "
+                         f"{axis_name}={n} (all-to-all SP shards heads; "
+                         f"use ring_attention for head counts below the "
+                         f"mesh axis)")
     if H % Hk:
         raise ValueError(f"kv heads ({Hk}) must divide q heads ({H})")
-    if Hk % n:
-        raise ValueError(f"{Hk} kv heads not divisible by {axis_name}={n} "
-                         f"(broadcast KV heads to a multiple of the axis, "
-                         f"or use ring_attention)")
+    if Hk_l % n:
+        raise ValueError(f"{Hk_l} kv heads/shard not divisible by "
+                         f"{axis_name}={n} (broadcast KV heads to a "
+                         f"multiple of the axis, or use ring_attention)")
     # batch_axes: data-parallel mesh axes (dp/fsdp) the batch dim is
     # sharded over (the SP×FSDP composition); the all-to-alls move only
     # the ``axis_name`` shards, batch stays embarrassingly parallel
-    spec = P(batch_axes or None, axis_name, None, None)
+    spec = P(batch_axes or None, axis_name, head_axes or None, None)
     fn = jax.shard_map(
         partial(_ulysses_body, axis_name=axis_name, causal=causal,
                 scale=Dh ** -0.5, use_flash=use_flash),

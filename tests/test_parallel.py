@@ -676,6 +676,67 @@ class TestSpFsdp:
         np.testing.assert_allclose(comp, dense, rtol=2e-3)
 
 
+class TestSpTp:
+    """SP×TP(×FSDP): attention runs head-sharded inside the
+    sequence-parallel shard_maps (round-5 extension past the verdict
+    list) — the complete (dp, fsdp, sp, tp) layout."""
+
+    @pytest.mark.parametrize("impl", ["ulysses", "ring"])
+    def test_full_4axis_matches_dense(self, impl):
+        from functools import partial
+
+        from pytorch_operator_tpu.models import llama
+        from pytorch_operator_tpu.parallel import (
+            make_sp_train_step,
+            make_train_step,
+        )
+
+        cfg = llama.tiny(n_heads=8, n_kv_heads=4, max_seq_len=32)
+        tokens = jax.random.randint(jax.random.key(61), (4, 33), 0,
+                                    cfg.vocab_size)
+        helper = TestSpFsdp()
+        dense_mesh = make_mesh(dp=1, fsdp=1, tp=1, devices=jax.devices()[:1])
+        _, dense = helper._run_steps(cfg, dense_mesh,
+                                     llama.param_specs(cfg),
+                                     make_train_step, tokens)
+        mesh = make_sp_mesh(dp=1, sp=2, fsdp=2, tp=2)
+        state, comp = helper._run_steps(
+            cfg, mesh, llama.param_specs(cfg),
+            partial(make_sp_train_step, impl=impl), tokens)
+        np.testing.assert_allclose(comp, dense, rtol=2e-3)
+        # weights live 1/(fsdp*tp) per chip
+        wq = state.params["layers"]["wq"]
+        assert wq.addressable_shards[0].data.size * 4 == wq.size
+
+    def test_gqa_minimal_repeat_is_per_shard(self):
+        """H=8/kv=2 with tp=2, sp=2: kv_local=1 does not divide sp, so
+        the ulysses path repeats K/V to lcm per SHARD — and still
+        matches the dense model."""
+        from pytorch_operator_tpu.models import llama
+
+        mesh = make_sp_mesh(dp=1, sp=2, fsdp=2, tp=2)
+        cfg = llama.tiny(n_heads=8, n_kv_heads=2, max_seq_len=32, dim=64)
+        params = llama.init_params(jax.random.key(63), cfg)
+        tokens = jax.random.randint(jax.random.key(64), (2, 32), 0,
+                                    cfg.vocab_size)
+        out = llama.forward_sp(params, tokens, cfg, mesh, impl="ulysses")
+        ref = llama.forward(params, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=1e-3)
+
+    def test_nondividing_heads_rejected(self):
+        from pytorch_operator_tpu.models import llama
+
+        mesh = make_sp_mesh(dp=1, sp=2, fsdp=2, tp=2)
+        cfg = llama.tiny(n_heads=6, n_kv_heads=3, max_seq_len=32, dim=96)
+        params = llama.init_params(jax.random.key(65), cfg)
+        tokens = jax.random.randint(jax.random.key(66), (2, 32), 0,
+                                    cfg.vocab_size)
+        with pytest.raises(ValueError,
+                           match="must divide both head counts"):
+            llama.forward_sp(params, tokens, cfg, mesh, impl="ring")
+
+
 class TestGraftEntry:
     def test_entry_compiles(self):
         import __graft_entry__
