@@ -421,7 +421,7 @@ class TestUlyssesAttention:
 
         mesh = make_sp_mesh(dp=1, sp=8)
         q = jnp.zeros((1, 16, 4, 8))  # 4 heads < sp=8
-        with pytest.raises(ValueError, match="heads not divisible"):
+        with pytest.raises(ValueError, match="heads/shard not divisible"):
             ulysses_attention(q, q, q, mesh, axis_name="sp")
 
 
