@@ -59,10 +59,14 @@ def main() -> int:
                              "count; --fsdp adds ZeRO-3 param sharding — "
                              "the 7B v5p-128 layout — and --tp "
                              "head-shards the attention inside SP)")
-    parser.add_argument("--sp-impl", choices=["ulysses", "ring"],
+    parser.add_argument("--sp-impl",
+                        choices=["ulysses", "ring", "ring_zigzag"],
                         default="ulysses",
                         help="attention strategy under --sp: all-to-all "
-                             "head re-shard (ulysses) or K/V ring rotation")
+                             "head re-shard (ulysses), K/V ring rotation "
+                             "(ring), or the ring with the zigzag chunk "
+                             "layout that balances causal load across "
+                             "ranks (ring_zigzag)")
     # The Pallas kernels ARE the shipped fast path on TPU; off-TPU the
     # unset default resolves to False (interpret-mode Pallas is a
     # debugging path that would make CPU smoke runs crawl).

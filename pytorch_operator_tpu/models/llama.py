@@ -423,10 +423,21 @@ def forward_sp(
     sharded tokens); only attention, the one op that mixes positions,
     runs a sequence-parallel strategy via shard_map:
 
-      impl="ulysses"  all-to-all re-shard to head parallelism
-                      (parallel/ulysses.py; needs n_heads % n == 0)
-      impl="ring"     K/V rotation with online softmax
-                      (parallel/ring_attention.py; any head count)
+      impl="ulysses"      all-to-all re-shard to head parallelism
+                          (parallel/ulysses.py; needs n_heads % n == 0)
+      impl="ring"         K/V rotation with online softmax
+                          (parallel/ring_attention.py; any head count)
+      impl="ring_zigzag"  the ring with the zigzag chunk layout —
+                          balanced causal load across ranks (each
+                          device holds global chunks (i, 2S-1-i)).
+                          NOTE: the permutation is currently internal
+                          to each attention call, costing 4 sequence-
+                          dim reshards per layer per step; the
+                          production form pre-permutes tokens once and
+                          trains entirely in zigzag order (only
+                          attention mixes positions) — use this impl
+                          as the validated algorithm, not yet as a
+                          throughput claim
 
     Composes with FSDP and pure DP: when the mesh also carries dp/fsdp
     axes (parallel.mesh.make_sp_mesh(..., fsdp=n)), the batch dim of
@@ -452,7 +463,7 @@ def forward_sp(
     from pytorch_operator_tpu.parallel.ring_attention import ring_attention
     from pytorch_operator_tpu.parallel.ulysses import ulysses_attention
 
-    if impl not in ("ulysses", "ring"):
+    if impl not in ("ulysses", "ring", "ring_zigzag"):
         raise ValueError(f"unknown sp impl {impl!r}")
 
     batch_axes = data_axes(mesh, tokens.shape[0])
@@ -491,9 +502,11 @@ def forward_sp(
                                      use_flash=cfg.use_flash,
                                      batch_axes=batch_axes,
                                      head_axes=head_axes)
-        return ring_attention(q, k, v, mesh, axis_name=axis_name,
-                              batch_axes=batch_axes,
-                              head_axes=head_axes).astype(q.dtype)
+        return ring_attention(
+            q, k, v, mesh, axis_name=axis_name, batch_axes=batch_axes,
+            head_axes=head_axes,
+            layout="zigzag" if impl == "ring_zigzag" else "contiguous",
+        ).astype(q.dtype)
 
     def apply_stack(layers, h, body):
         # pin the (B, T, D) activations to the sequence-sharded layout

@@ -91,23 +91,12 @@ def _ring_body(q, k, v, axis_name, causal, scale, block, interpret):
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # fresh constants are "unvarying" under shard_map's manual-axes
-    # tracking; mark them device-varying so the fori_loop carry types match
-    o0 = lax.pvary(jnp.zeros((B, H, Tl, Dh), jnp.float32), axis_name)
-    m0 = lax.pvary(jnp.full((B, H, Tl), NEG_INF, jnp.float32), axis_name)
-    l0 = lax.pvary(jnp.zeros((B, H, Tl), jnp.float32), axis_name)
-
     def body(s, carry):
         o, m, l, kc, vc = carry
         src = (rank - s) % n  # which global chunk kc currently holds
 
         def merge(parts):
-            o_p, m_p, l_p = parts
-            m_new = jnp.maximum(m, m_p)
-            a = jnp.exp(m - m_new)
-            b = jnp.exp(m_p - m_new)
-            return (o * a[..., None] + o_p * b[..., None], m_new,
-                    l * a + l_p * b)
+            return _acc_merge((o, m, l), parts)
 
         def chunk(causal_chunk):
             if block is not None:
@@ -134,10 +123,122 @@ def _ring_body(q, k, v, axis_name, causal, scale, block, interpret):
         vc = lax.ppermute(vc, axis_name, perm)
         return o2, m2, l2, kc, vc
 
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # (B,Tl,H,Dh)
+    o, m, l, _, _ = lax.fori_loop(
+        0, n, body, (*_acc_zero(B, H, Tl, Dh, axis_name), k, v))
+    out = _acc_finish((o, m, l))  # (B,Tl,H,Dh)
     return out.astype(q.dtype)
+
+
+def _acc_merge(acc, parts):
+    """Online-softmax combine of one chunk's (o, m, l) partials into the
+    running accumulator — the single numerically delicate merge shared
+    by the contiguous and zigzag ring bodies."""
+    o, m, l = acc
+    o_p, m_p, l_p = parts
+    m_new = jnp.maximum(m, m_p)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m_p - m_new)
+    return (o * a[..., None] + o_p * b[..., None], m_new, l * a + l_p * b)
+
+
+def _acc_zero(B, H, T, Dh, axis_name):
+    """Fresh (o, m, l) accumulator; pvary marks the constants
+    device-varying so shard_map fori_loop carry types match."""
+    o = lax.pvary(jnp.zeros((B, H, T, Dh), jnp.float32), axis_name)
+    m = lax.pvary(jnp.full((B, H, T), NEG_INF, jnp.float32), axis_name)
+    l = lax.pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    return o, m, l
+
+
+def _acc_finish(acc):
+    """Normalize and return (B, T, H, Dh); fully-masked rows (l == 0)
+    divide by 1 and stay zero."""
+    o, m, l = acc
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).transpose(0, 2, 1, 3)
+
+
+def _ring_body_zigzag(q, k, v, axis_name, scale, block, interpret):
+    """Causal ring body for the ZIGZAG layout: device i holds global
+    chunks (i, 2S-1-i) of 2S, so per-rotation causal work is balanced
+    instead of rank r doing r+1 chunks while rank 0 idles — the
+    standard fix for the contiguous causal ring's tail-heavy load.
+
+    Local arrays are (B, 2C, H, Dh); the two halves' global chunk ids
+    are (rank, 2S-1-rank) for q and (src, 2S-1-src) for the rotating
+    K/V.  Of the four (q-half, kv-half) pairs, two are statically
+    decided — the front q half (id < S) never attends the back kv half
+    (id >= S), and the back q half always fully attends the front kv
+    half — leaving exactly two data-dependent diagonals, resolved with
+    the same flash-or-dense chunk kernels and online-softmax merge as
+    the contiguous body (_acc_merge/_acc_zero/_acc_finish).
+    """
+    B, Tl, H, Dh = q.shape
+    C = Tl // 2
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    halves = lambda x: (x[:, :C], x[:, C:])  # noqa: E731
+    q_front, q_back = halves(q)
+
+    def chunk(qh, kh, vh, causal_chunk):
+        if block is not None:
+            return _chunk_attn_flash(qh, kh, vh, scale, causal_chunk,
+                                     block, interpret)
+        return _chunk_attn(qh, kh, vh, 0, 0, scale, causal_chunk)
+
+    def diagonal(acc, qh, kh, vh, kv_id, q_id):
+        # NOTE: both ids are traced (rank/src-derived) — only WHICH half
+        # (front/back) is static — so the three-way decision is conds
+        return lax.cond(
+            kv_id < q_id,
+            lambda a: _acc_merge(a, chunk(qh, kh, vh, False)),
+            lambda a: lax.cond(
+                kv_id == q_id,
+                lambda b: _acc_merge(b, chunk(qh, kh, vh, True)),
+                lambda b: b,  # future chunk: fully masked, skip
+                a),
+            acc)
+
+    def body(s, carry):
+        acc_f, acc_b, kc, vc = carry
+        src = (rank - s) % n
+        (k_f, k_b), (v_f, v_b) = halves(kc), halves(vc)
+        # front q (id rank < S) vs front kv (id src): data-dependent
+        acc_f = diagonal(acc_f, q_front, k_f, v_f, src, rank)
+        # front q vs back kv (id >= S): ALWAYS future — statically skipped
+        # back q (id 2S-1-rank >= S) vs front kv (id src < S): ALWAYS past
+        acc_b = _acc_merge(acc_b, chunk(q_back, k_f, v_f, False))
+        # back q vs back kv: kv_id < q_id iff src > rank — data-dependent
+        acc_b = diagonal(acc_b, q_back, k_b, v_b,
+                         2 * n - 1 - src, 2 * n - 1 - rank)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return acc_f, acc_b, kc, vc
+
+    acc_f, acc_b, _, _ = lax.fori_loop(
+        0, n, body,
+        (_acc_zero(B, H, C, Dh, axis_name),
+         _acc_zero(B, H, C, Dh, axis_name), k, v))
+    out = jnp.concatenate([_acc_finish(acc_f), _acc_finish(acc_b)], axis=1)
+    return out.astype(q.dtype)
+
+
+def _zigzag_perm(T: int, sp: int):
+    """Global row permutation for the zigzag layout: device i's slice
+    holds chunks (i, 2*sp-1-i) of 2*sp, so sharding the PERMUTED array
+    over sp lands each pair on its device.  Returns (perm, inverse)."""
+    import numpy as np
+
+    C = T // (2 * sp)
+    order = []
+    for i in range(sp):
+        order += [i, 2 * sp - 1 - i]
+    perm = np.concatenate([np.arange(c * C, (c + 1) * C) for c in order])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return perm, inv
 
 
 def ring_attention(
@@ -150,6 +251,7 @@ def ring_attention(
     causal: bool = True,
     batch_axes: tuple[str, ...] = (),
     head_axes: tuple[str, ...] = (),
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Exact causal attention with sequence sharded over ``axis_name``.
 
@@ -163,6 +265,15 @@ def ring_attention(
     Per-chunk compute routes through the Pallas flash kernel when the
     local chunk length tiles (ops.flash_attention._exact_block), dense
     XLA otherwise; fully-masked chunks are skipped either way.
+
+    ``layout="zigzag"`` (causal only) balances the causal ring's load:
+    the contiguous layout leaves rank 0 computing 1 chunk while rank
+    S-1 computes S, so the step critical path is the last rank; zigzag
+    gives device i global chunks (i, 2S-1-i), evening live work to
+    ~(S+1)/2 half-pairs per device per rotation.  Inputs/outputs keep
+    the natural sequence order — the permutation is internal (a
+    production pipeline would pre-permute once and train entirely in
+    zigzag order to avoid the per-call gather).
     """
     from pytorch_operator_tpu.ops.flash_attention import _exact_block
 
@@ -177,7 +288,6 @@ def ring_attention(
             f"{k.shape[2]}/{v.shape[2]}")
     sp = mesh.shape[axis_name]
     t_local = T // sp
-    block = _exact_block(t_local, Dh)
     interpret = jax.default_backend() != "tpu"
     # batch_axes: data-parallel mesh axes (dp/fsdp) the batch dim is
     # sharded over — the SP×FSDP composition (llama.forward_sp passes
@@ -189,11 +299,7 @@ def ring_attention(
 
     head_shard_degree(mesh, head_axes, H, Hk)
     spec = P(batch_axes or None, axis_name, head_axes or None, None)
-    fn = jax.shard_map(
-        partial(
-            _ring_body, axis_name=axis_name, causal=causal,
-            scale=Dh ** -0.5, block=block, interpret=interpret
-        ),
+    shard_kw = dict(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -202,4 +308,31 @@ def ring_attention(
         # bodies in models/llama.py)
         check_vma=False,
     )
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError("layout='zigzag' exists to balance CAUSAL "
+                             "ring load; use the default layout for "
+                             "non-causal attention")
+        if T % (2 * sp):
+            raise ValueError(f"seq len {T} not divisible by 2*{axis_name}"
+                             f"={2 * sp} (zigzag splits each device's "
+                             f"slice into front/back half-chunks)")
+        perm, inv = _zigzag_perm(T, sp)
+        fn = jax.shard_map(
+            partial(_ring_body_zigzag, axis_name=axis_name,
+                    scale=Dh ** -0.5,
+                    block=_exact_block(t_local // 2, Dh),
+                    interpret=interpret),
+            **shard_kw)
+        out = fn(q[:, perm], k[:, perm], v[:, perm])
+        return out[:, inv]
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}")
+    fn = jax.shard_map(
+        partial(
+            _ring_body, axis_name=axis_name, causal=causal,
+            scale=Dh ** -0.5, block=_exact_block(t_local, Dh),
+            interpret=interpret
+        ),
+        **shard_kw)
     return fn(q, k, v)

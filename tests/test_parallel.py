@@ -202,6 +202,78 @@ class TestRingAttention:
             assert float(jnp.abs(g).max()) > 0
 
 
+class TestZigzagRing:
+    """layout='zigzag': device i holds global chunks (i, 2S-1-i), so
+    causal ring work is balanced across ranks (round-5 extension; the
+    contiguous layout leaves rank 0 near-idle while rank S-1 computes
+    S chunks)."""
+
+    @pytest.mark.parametrize("sp,T,H,Hk", [(4, 32, 4, 4), (8, 64, 4, 2),
+                                           (2, 512, 2, 2)])
+    def test_matches_dense(self, sp, T, H, Hk):
+        # (2, 512, ...) makes the half-chunks tile the Pallas blocks
+        # (C=128), covering the flash path; the others the dense chunks
+        mesh = make_sp_mesh(dp=8 // sp, sp=sp)
+        ks = jax.random.split(jax.random.key(sp), 3)
+        q = jax.random.normal(ks[0], (2, T, H, 8))
+        k = jax.random.normal(ks[1], (2, T, Hk, 8))
+        v = jax.random.normal(ks[2], (2, T, Hk, 8))
+        out = ring_attention(q, k, v, mesh, axis_name="sp",
+                             layout="zigzag")
+        ref = dense_gqa_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_grads_through_flash_half_chunks(self):
+        mesh = make_sp_mesh(dp=4, sp=2)
+        ks = jax.random.split(jax.random.key(71), 3)
+        q, k, v = (jax.random.normal(kk, (1, 512, 2, 8)) for kk in ks)
+        g1 = jax.grad(lambda *a: jnp.sum(ring_attention(
+            *a, mesh, axis_name="sp", layout="zigzag") ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            dense_causal_attention(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-4)
+
+    def test_rejects_non_causal_and_ragged(self):
+        mesh = make_sp_mesh(dp=1, sp=4)
+        x = jnp.zeros((1, 32, 2, 8))
+        with pytest.raises(ValueError, match="CAUSAL"):
+            ring_attention(x, x, x, mesh, axis_name="sp",
+                           layout="zigzag", causal=False)
+        y = jnp.zeros((1, 36, 2, 8))  # 36 % (2*4) != 0
+        with pytest.raises(ValueError, match="not divisible by 2"):
+            ring_attention(y, y, y, mesh, axis_name="sp", layout="zigzag")
+        with pytest.raises(ValueError, match="unknown ring layout"):
+            ring_attention(x, x, x, mesh, axis_name="sp", layout="spiral")
+
+    def test_forward_sp_ring_zigzag_trains_like_dense(self):
+        from functools import partial
+
+        from pytorch_operator_tpu.models import llama
+        from pytorch_operator_tpu.parallel import (
+            make_sp_train_step,
+            make_train_step,
+        )
+
+        cfg = llama.tiny(n_heads=8, n_kv_heads=4, max_seq_len=32)
+        tokens = jax.random.randint(jax.random.key(73), (4, 33), 0,
+                                    cfg.vocab_size)
+        helper = TestSpFsdp()
+        dense_mesh = make_mesh(dp=1, fsdp=1, tp=1,
+                               devices=jax.devices()[:1])
+        _, dense = helper._run_steps(cfg, dense_mesh,
+                                     llama.param_specs(cfg),
+                                     make_train_step, tokens)
+        mesh = make_sp_mesh(dp=1, sp=4, fsdp=2)
+        _, zz = helper._run_steps(
+            cfg, mesh, llama.sp_fsdp_param_specs(cfg),
+            partial(make_sp_train_step, impl="ring_zigzag"), tokens)
+        np.testing.assert_allclose(zz, dense, rtol=2e-3)
+
+
 def dense_gqa_reference(q, k, v):
     groups = q.shape[2] // k.shape[2]
     return dense_causal_attention(q, jnp.repeat(k, groups, axis=2),
