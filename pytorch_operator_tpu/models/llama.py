@@ -312,8 +312,9 @@ def make_layer_body(cfg: LlamaConfig, cos, sin, attn=None):
 
 
 def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-                  apply_stack, attn=None, return_hidden: bool = False
-                  ) -> jax.Array:
+                  apply_stack, attn=None, return_hidden: bool = False,
+                  positions: jax.Array | None = None,
+                  inv_positions: jax.Array | None = None) -> jax.Array:
     """Shared prologue/epilogue around the decoder stack: embed + RoPE
     tables in, final norm + weight-tied head out.  ``apply_stack(layers,
     h, body)`` decides how the stacked blocks run (lax.scan vs the GPipe
@@ -326,10 +327,21 @@ def _forward_with(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     T = tokens.shape[1]
     h = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_table(cfg, T)
+    if positions is not None:
+        # rows arrive in a permuted order (e.g. the zigzag sequence-
+        # parallel layout): row j carries global position positions[j],
+        # so RoPE must rotate by the true positions, not the row index
+        cos, sin = cos[positions], sin[positions]
 
     body = make_layer_body(cfg, cos, sin, attn=attn)
     h = apply_stack(params["layers"], h, body)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.use_fused_norm)
+    if inv_positions is not None:
+        # restore natural row order on the D-wide hidden states BEFORE
+        # the vocab-wide head: un-permuting logits instead would gather
+        # vocab/dim times more data and materialise a second full
+        # logits buffer (the allocation class that OOMs 32k configs)
+        h = h[:, inv_positions]
     if return_hidden:
         return h
     # weight-tied output head
@@ -430,14 +442,16 @@ def forward_sp(
       impl="ring_zigzag"  the ring with the zigzag chunk layout —
                           balanced causal load across ranks (each
                           device holds global chunks (i, 2S-1-i)).
-                          NOTE: the permutation is currently internal
-                          to each attention call, costing 4 sequence-
-                          dim reshards per layer per step; the
-                          production form pre-permutes tokens once and
-                          trains entirely in zigzag order (only
-                          attention mixes positions) — use this impl
-                          as the validated algorithm, not yet as a
-                          throughput claim
+                          The permutation happens ONCE per forward:
+                          tokens are permuted into zigzag order, the
+                          whole stack runs in zigzag space (RoPE
+                          rotates by true positions via the
+                          ``positions`` gather; norms/MLP/residuals
+                          are position-independent; attention uses
+                          layout="zigzag_pre"), and the output is
+                          un-permuted at the end — two token/output
+                          gathers per forward instead of four
+                          sequence reshards per LAYER
 
     Composes with FSDP and pure DP: when the mesh also carries dp/fsdp
     axes (parallel.mesh.make_sp_mesh(..., fsdp=n)), the batch dim of
@@ -505,7 +519,11 @@ def forward_sp(
         return ring_attention(
             q, k, v, mesh, axis_name=axis_name, batch_axes=batch_axes,
             head_axes=head_axes,
-            layout="zigzag" if impl == "ring_zigzag" else "contiguous",
+            # the stack already runs in zigzag space for ring_zigzag
+            # (tokens permuted once below), so attention takes the
+            # pre-permuted fast path — no per-layer gathers
+            layout="zigzag_pre" if impl == "ring_zigzag"
+            else "contiguous",
         ).astype(q.dtype)
 
     def apply_stack(layers, h, body):
@@ -518,6 +536,22 @@ def forward_sp(
             h, NamedSharding(mesh, P(batch_axes or None, axis_name, None)))
         return lax.scan(lambda h, lp: (body(h, lp), None), h, layers)[0]
 
+    if impl == "ring_zigzag":
+        # permute ONCE into zigzag order and run the whole stack there;
+        # everything except attention and RoPE is position-independent,
+        # attention takes the zigzag_pre fast path, RoPE rotates by the
+        # true positions (the permutation itself), and the natural
+        # order is restored on the D-wide hidden states before the head
+        from pytorch_operator_tpu.parallel.ring_attention import (
+            zigzag_layout,
+        )
+
+        perm, inv = zigzag_layout(tokens.shape[1], mesh.shape[axis_name],
+                                  axis_name)
+        return _forward_with(params, tokens[:, perm], cfg, apply_stack,
+                             attn=attn, return_hidden=return_hidden,
+                             positions=jnp.asarray(perm),
+                             inv_positions=jnp.asarray(inv))
     return _forward_with(params, tokens, cfg, apply_stack, attn=attn,
                          return_hidden=return_hidden)
 

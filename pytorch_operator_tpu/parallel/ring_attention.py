@@ -225,12 +225,22 @@ def _ring_body_zigzag(q, k, v, axis_name, scale, block, interpret):
     return out.astype(q.dtype)
 
 
-def _zigzag_perm(T: int, sp: int):
-    """Global row permutation for the zigzag layout: device i's slice
-    holds chunks (i, 2*sp-1-i) of 2*sp, so sharding the PERMUTED array
-    over sp lands each pair on its device.  Returns (perm, inverse)."""
+def zigzag_layout(T: int, sp: int, axis_name: str = "sp"):
+    """Validated global row permutation for the zigzag layout.
+
+    Device i's slice holds chunks (i, 2*sp-1-i) of 2*sp, so sharding
+    the PERMUTED array over sp lands each pair on its device.  Returns
+    (perm, inverse); the single owner of the layout contract — both
+    ring_attention's internal-permute path and llama.forward_sp's
+    once-per-forward zigzag-space pipeline call this.
+    """
     import numpy as np
 
+    if T % (2 * sp):
+        raise ValueError(
+            f"seq len {T} not divisible by 2*{axis_name}={2 * sp} "
+            f"(zigzag splits each device's slice into front/back "
+            f"half-chunks)")
     C = T // (2 * sp)
     order = []
     for i in range(sp):
@@ -269,11 +279,16 @@ def ring_attention(
     ``layout="zigzag"`` (causal only) balances the causal ring's load:
     the contiguous layout leaves rank 0 computing 1 chunk while rank
     S-1 computes S, so the step critical path is the last rank; zigzag
-    gives device i global chunks (i, 2S-1-i), evening live work to
-    ~(S+1)/2 half-pairs per device per rotation.  Inputs/outputs keep
-    the natural sequence order — the permutation is internal (a
-    production pipeline would pre-permute once and train entirely in
-    zigzag order to avoid the per-call gather).
+    gives device i global chunks (i, 2S-1-i), evening live work.  With
+    ``"zigzag"`` inputs/outputs keep the natural sequence order (the
+    permutation is applied internally, 4 gathers per call);
+    ``"zigzag_pre"`` expects q/k/v ALREADY in zigzag row order
+    (``zigzag_layout(T, sp)``) and returns the output in that same
+    order with no gathers — the production form, used by
+    llama.forward_sp which permutes once per forward and runs the
+    whole stack in zigzag space.  There is no runtime check that
+    pre-permuted inputs really are permuted; get the order wrong and
+    the causal mask is silently wrong.
     """
     from pytorch_operator_tpu.ops.flash_attention import _exact_block
 
@@ -308,22 +323,26 @@ def ring_attention(
         # bodies in models/llama.py)
         check_vma=False,
     )
-    if layout == "zigzag":
+    if layout in ("zigzag", "zigzag_pre"):
         if not causal:
-            raise ValueError("layout='zigzag' exists to balance CAUSAL "
-                             "ring load; use the default layout for "
-                             "non-causal attention")
-        if T % (2 * sp):
-            raise ValueError(f"seq len {T} not divisible by 2*{axis_name}"
-                             f"={2 * sp} (zigzag splits each device's "
-                             f"slice into front/back half-chunks)")
-        perm, inv = _zigzag_perm(T, sp)
+            raise ValueError(f"layout={layout!r} exists to balance "
+                             f"CAUSAL ring load; use the default layout "
+                             f"for non-causal attention")
         fn = jax.shard_map(
             partial(_ring_body_zigzag, axis_name=axis_name,
                     scale=Dh ** -0.5,
                     block=_exact_block(t_local // 2, Dh),
                     interpret=interpret),
             **shard_kw)
+        if layout == "zigzag_pre":
+            # caller already laid q/k/v out in zigzag order (the
+            # production path: llama.forward_sp permutes ONCE per
+            # forward and runs the whole stack in zigzag space) —
+            # outputs come back in the same zigzag order.  Validate the
+            # divisibility even though no permutation is applied here.
+            zigzag_layout(T, sp, axis_name)
+            return fn(q, k, v)
+        perm, inv = zigzag_layout(T, sp, axis_name)
         out = fn(q[:, perm], k[:, perm], v[:, perm])
         return out[:, inv]
     if layout != "contiguous":

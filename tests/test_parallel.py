@@ -249,6 +249,41 @@ class TestZigzagRing:
         with pytest.raises(ValueError, match="unknown ring layout"):
             ring_attention(x, x, x, mesh, axis_name="sp", layout="spiral")
 
+    def test_forward_sp_permutes_once_not_per_layer(self, monkeypatch):
+        """The production contract: forward_sp(impl='ring_zigzag') runs
+        the whole stack in zigzag space — every per-layer attention call
+        takes layout='zigzag_pre' (no per-layer gathers) and the output
+        still matches the dense model in natural order (RoPE gathered
+        by true positions)."""
+        import importlib
+
+        from pytorch_operator_tpu.models import llama
+
+        ring_mod = importlib.import_module(
+            "pytorch_operator_tpu.parallel.ring_attention")
+        layouts: list = []
+        real = ring_mod.ring_attention
+
+        def spy(*a, **kw):
+            layouts.append(kw.get("layout"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(ring_mod, "ring_attention", spy)
+        mesh = make_sp_mesh(dp=2, sp=4)
+        cfg = llama.tiny(n_heads=8, n_kv_heads=4, max_seq_len=64)
+        params = llama.init_params(jax.random.key(81), cfg)
+        tokens = jax.random.randint(jax.random.key(82), (2, 64), 0,
+                                    cfg.vocab_size)
+        out = llama.forward_sp(params, tokens, cfg, mesh,
+                               impl="ring_zigzag")
+        ref = llama.forward(params, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=1e-3)
+        # the layer stack is a lax.scan, so attention traces ONCE —
+        # and that single trace is on the pre-permuted path (the
+        # per-call 'zigzag' layout with its 4 gathers never appears)
+        assert layouts == ["zigzag_pre"], layouts
+
     def test_forward_sp_ring_zigzag_trains_like_dense(self):
         from functools import partial
 
