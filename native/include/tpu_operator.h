@@ -34,6 +34,9 @@ int wq_get(void* q, double timeout_seconds, char* buf, int buflen);
 
 void wq_done(void* q, const char* item);
 void wq_forget(void* q, const char* item);
+/* 1 while the item awaits (re)processing; the informer's burst
+ * coalescing keys off this. */
+int wq_is_dirty(void* q, const char* item);
 int wq_num_requeues(void* q, const char* item);
 int wq_len(void* q);
 void wq_shutdown(void* q);

@@ -26,6 +26,9 @@ struct Waiting {
   Clock::time_point ready_at;
   uint64_t seq;
   std::string item;
+  // entries from AddRateLimited are cancellable (pending_retry_); plain
+  // AddAfter timers (deadline/TTL wake-ups) never are
+  bool is_retry;
   bool operator>(const Waiting& o) const {
     if (ready_at != o.ready_at) return ready_at > o.ready_at;
     return seq > o.seq;
@@ -52,20 +55,29 @@ class WorkQueue {
     waiting_.push(Waiting{
         Clock::now() + std::chrono::duration_cast<Clock::duration>(
                            std::chrono::duration<double>(delay)),
-        seq_++, item});
+        seq_++, item, false});
     cv_.notify_one();
   }
 
+  // At most one live retry per item: a retry for an already-dirty key
+  // is dropped (the imminent processing supersedes it), a newer retry
+  // replaces a pending one, and Forget cancels it — else a rate-limited
+  // requeue plus a live watch event double-processes the key.
   void AddRateLimited(const std::string& item) {
-    double delay;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      int n = failures_[item]++;
-      delay = base_delay_;
-      for (int i = 0; i < n && delay < max_delay_; i++) delay *= 2;
-      if (delay > max_delay_) delay = max_delay_;
-    }
-    AddAfter(item, delay);
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    int n = failures_[item]++;
+    double delay = base_delay_;
+    for (int i = 0; i < n && delay < max_delay_; i++) delay *= 2;
+    if (delay > max_delay_) delay = max_delay_;
+    if (dirty_.count(item)) return;
+    uint64_t seq = seq_++;
+    pending_retry_[item] = seq;
+    waiting_.push(Waiting{
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(delay)),
+        seq, item, true});
+    cv_.notify_one();
   }
 
   // 1 = item, 0 = timeout, -1 = shutdown
@@ -130,9 +142,18 @@ class WorkQueue {
     }
   }
 
+  // Reset backoff AND cancel the item's pending retry (Forget runs
+  // after a successful sync, making a scheduled retry pure
+  // double-processing); plain AddAfter timers are untouched.
   void Forget(const std::string& item) {
     std::lock_guard<std::mutex> lk(mu_);
     failures_.erase(item);
+    pending_retry_.erase(item);
+  }
+
+  int IsDirty(const std::string& item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dirty_.count(item) ? 1 : 0;
   }
 
   int NumRequeues(const std::string& item) {
@@ -164,9 +185,15 @@ class WorkQueue {
   void DrainReadyLocked() {
     const auto now = Clock::now();
     while (!waiting_.empty() && waiting_.top().ready_at <= now) {
-      std::string item = waiting_.top().item;
+      const Waiting top = waiting_.top();
       waiting_.pop();
-      AddReadyLocked(item);
+      if (top.is_retry) {
+        auto it = pending_retry_.find(top.item);
+        if (it == pending_retry_.end() || it->second != top.seq)
+          continue;  // superseded by a newer retry or cancelled by Forget
+        pending_retry_.erase(it);
+      }
+      AddReadyLocked(top.item);
     }
   }
 
@@ -184,6 +211,7 @@ class WorkQueue {
   std::priority_queue<Waiting, std::vector<Waiting>, std::greater<Waiting>>
       waiting_;
   std::unordered_map<std::string, int> failures_;
+  std::unordered_map<std::string, uint64_t> pending_retry_;
   uint64_t seq_ = 0;
   int active_getters_ = 0;
   bool shutdown_ = false;
@@ -234,6 +262,9 @@ void wq_done(void* q, const char* item) {
 }
 void wq_forget(void* q, const char* item) {
   static_cast<WorkQueue*>(q)->Forget(item);
+}
+int wq_is_dirty(void* q, const char* item) {
+  return static_cast<WorkQueue*>(q)->IsDirty(item);
 }
 int wq_num_requeues(void* q, const char* item) {
   return static_cast<WorkQueue*>(q)->NumRequeues(item);

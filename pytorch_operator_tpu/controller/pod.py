@@ -15,7 +15,7 @@ from typing import Dict, List
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
 from ..k8s import serde
-from ..k8s.errors import ApiError
+from ..runtime.controls import submit_creates_with_expectations
 from ..runtime.expectations import expectation_pods_key
 from ..runtime.job_controller import gen_general_name, gen_pod_group_name
 from ..runtime.logger import logger_for_pod, logger_for_replica
@@ -74,12 +74,20 @@ class PodReconcilerMixin:
             if 0 <= index < replicas and index not in warn_set:
                 sole_row_by_index[index] = r
 
+        # Pipelined create path: build every missing pod first, then
+        # submit them as ONE batch through the control's bounded fan-out
+        # (create_many) — expectations are raised up-front for the whole
+        # batch and decremented per observed failure, so the
+        # CreationObserved bookkeeping is identical to N sequential
+        # creates while the API round-trips overlap.
+        planned: List[dict] = []
         for index in range(replicas):
             if index in create_set:
                 log.info("Need to create new pod: %s-%d", rt, index)
                 master_role = rtype == constants.REPLICA_TYPE_MASTER
-                self.create_new_pod(job, job_dict, rtype, str(index), spec,
-                                    master_role, gang_enabled=gang_enabled)
+                planned.append(self.build_new_pod(
+                    job, job_dict, rtype, str(index), spec, master_role,
+                    gang_enabled))
             elif index in warn_set:
                 log.warning("We have too many pods for %s %d", rt, index)
             else:
@@ -106,6 +114,9 @@ class PodReconcilerMixin:
                         job_dict,
                     )
 
+        if planned:
+            self.submit_pod_creates(job, job_dict, rtype, planned)
+
         status_machine.apply_replica_counts(job.status, rtype, *counts)
 
         self.update_status_single(job, job_dict, rtype, replicas, restart)
@@ -121,14 +132,47 @@ class PodReconcilerMixin:
         master_role: bool,
         gang_enabled: bool | None = None,
     ) -> None:
-        """pod.go:140-232."""
+        """pod.go:140-232 — compat single-pod entry (direct callers and
+        tests): a batch of one through the pipelined path."""
         if gang_enabled is None:
             gang_enabled = self.gang_scheduling_enabled(job)
-        rt = rtype.lower()
-        job_key = job.key
-        self.expectations.expect_creations(expectation_pods_key(job_key, rt), 1)
+        pod = self.build_new_pod(job, job_dict, rtype, index, spec,
+                                 master_role, gang_enabled)
+        self.submit_pod_creates(job, job_dict, rtype, [pod])
 
-        controller_ref = self.gen_owner_reference(job_dict)
+    def submit_pod_creates(
+        self, job: PyTorchJob, job_dict: dict, rtype: str, pods: List[dict]
+    ) -> None:
+        """Issue one batch of pod creates through the bounded fan-out.
+
+        Expectations are raised up-front for the whole batch (upstream
+        kube's ExpectCreations(key, diff) shape) and decremented once per
+        failed create — successes are observed by the pod informer,
+        failures in the shared protocol helper.  Without the per-failure
+        rollback a failed create (e.g. AlreadyExists colliding with a pod
+        of the job's previous incarnation that GC hasn't removed yet)
+        parks the job unsynced until the 5-minute expectations TTL — the
+        deliberate divergence from the reference's pod.go:218-226
+        surfaced by the churn bench.
+        """
+        submit_creates_with_expectations(
+            self.expectations, expectation_pods_key(job.key, rtype.lower()),
+            self.pod_control.create_many, job.metadata.namespace, pods,
+            job_dict, self.gen_owner_reference(job_dict))
+
+    def build_new_pod(
+        self,
+        job: PyTorchJob,
+        job_dict: dict,
+        rtype: str,
+        index: str,
+        spec: ReplicaSpec,
+        master_role: bool,
+        gang_enabled: bool,
+    ) -> dict:
+        """Render one replica's pod template (the pure part of
+        pod.go:140-232; no API calls, no expectations)."""
+        rt = rtype.lower()
         labels = self.gen_labels(job.metadata.name)
         labels[constants.LABEL_REPLICA_TYPE] = rt
         labels[constants.LABEL_REPLICA_INDEX] = index
@@ -184,22 +228,7 @@ class PodReconcilerMixin:
                 constants.GANG_SCHEDULING_POD_GROUP_ANNOTATION
             ] = gen_pod_group_name(job.metadata.name)
 
-        try:
-            self.pod_control.create_pod_with_controller_ref(
-                job.metadata.namespace, pod, job_dict, controller_ref
-            )
-        except ApiError:
-            # Roll back the raised expectation: without this, a failed
-            # create (e.g. AlreadyExists colliding with a pod of the
-            # job's previous incarnation that GC hasn't removed yet)
-            # parks the job unsynced until the 5-minute expectations
-            # TTL.  Upstream kube controllers decrement via
-            # CreationObserved on create failure; the reference's
-            # pod.go:218-226 inherits the leak — this is a deliberate
-            # divergence, surfaced by the 100-job churn bench.
-            self.expectations.creation_observed(
-                expectation_pods_key(job_key, rt))
-            raise
+        return pod
 
     def _is_non_gang_scheduler_set(self, job: PyTorchJob) -> bool:
         for spec in job.spec.pytorch_replica_specs.values():

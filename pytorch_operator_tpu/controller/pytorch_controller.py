@@ -20,7 +20,7 @@ from ..api.v1.defaults import set_defaults
 from ..api.v1.types import PyTorchJob
 from ..api.v1.validation import ValidationError, validate_spec
 from ..k8s import serde
-from ..k8s.errors import NotFoundError
+from ..k8s.errors import ConflictError, NotFoundError
 from ..metrics import default_registry
 from ..runtime.expectations import (
     expectation_pods_key,
@@ -57,7 +57,8 @@ class PyTorchController(
         # key -> UID of the incarnation whose sync last ran; lets sync_job
         # detect expectations raised by a dead incarnation (see sync_job)
         self._synced_uid: dict = {}
-        self.job_informer = Informer(cluster.jobs, resync_period=job_resync)
+        self.job_informer = Informer(cluster.jobs, resync_period=job_resync,
+                                     coalesce=self._coalesce_job_event)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
         )
@@ -93,6 +94,22 @@ class PyTorchController(
         return self.config.tpu_auto_gang and job_requests_tpu(job)
 
     # -- plumbing ----------------------------------------------------------
+    def _coalesce_job_event(self, key: str, old: dict, new: dict) -> bool:
+        """Informer burst coalescing for the job informer: a MODIFIED
+        event for a key that is already dirty in the workqueue updates
+        the store but skips the handler dispatch — the pending sync reads
+        the fresh store, so the dispatch could only re-enqueue a key the
+        queue would dedup anyway.  Events that change .spec or the
+        deletionTimestamp are never coalesced: update_job reschedules the
+        ActiveDeadlineSeconds wake-up on spec changes, and that timer
+        must not be lost to a burst."""
+        if old.get("spec") != new.get("spec"):
+            return False
+        if (old.get("metadata") or {}).get("deletionTimestamp") != (
+                (new.get("metadata") or {}).get("deletionTimestamp")):
+            return False
+        return self.work_queue.is_dirty(key)
+
     def _job_from_unstructured(self, obj: dict) -> PyTorchJob:
         """informer.go:83-104: convert + validate."""
         job = PyTorchJob.from_dict(obj)
@@ -132,7 +149,51 @@ class PyTorchController(
         self.enqueue_job(obj)
 
     def _update_job_status(self, job: PyTorchJob) -> None:
-        self.cluster.jobs.update(job.to_dict(), subresource="status")
+        """Persist the status delta as a JSON-merge-patch against the
+        status subresource instead of PUTting the whole object
+        (controller.go:336's UpdateStatus round-trips the full job; the
+        churn bench showed those bodies dominating status-write cost).
+
+        The diff base is the informer-cached object — the same copy this
+        sync parsed — and the patch carries that copy's resourceVersion
+        as an optimistic precondition, so a concurrent writer can't be
+        silently clobbered by the wholesale ``conditions`` list replace.
+        On a 409 the base is re-read (informer cache first; a live GET
+        when the cache hasn't caught up yet) and the patch retried once;
+        a second conflict propagates so the sync requeues with backoff.
+        """
+        namespace = job.metadata.namespace
+        name = job.metadata.name
+        new_status = job.to_dict().get("status") or {}
+        cached = self._get_job_from_cache(namespace, name)
+        for attempt in range(2):
+            old_status = (cached or {}).get("status") or {}
+            diff = status_machine.status_merge_diff(old_status, new_status)
+            if not diff:
+                return
+            body: dict = {"status": diff}
+            rv = ((cached or {}).get("metadata") or {}).get("resourceVersion")
+            if rv:
+                body["metadata"] = {"resourceVersion": rv}
+            try:
+                self.cluster.jobs.patch(
+                    namespace, name, body, subresource="status")
+                return
+            except ConflictError:
+                if attempt:
+                    raise
+                fresh = self._get_job_from_cache(namespace, name)
+                fresh_rv = ((fresh or {}).get("metadata") or {}).get(
+                    "resourceVersion")
+                if fresh is not None and fresh_rv != rv:
+                    cached = fresh
+                else:
+                    # cache hasn't observed the conflicting write yet:
+                    # one live read gets the authoritative base
+                    try:
+                        cached = self.cluster.jobs.get(namespace, name)
+                    except NotFoundError:
+                        return  # job deleted under us; nothing to persist
 
     # -- lifecycle ---------------------------------------------------------
     def start_informers(self) -> None:

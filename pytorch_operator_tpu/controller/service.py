@@ -14,7 +14,7 @@ from typing import List
 
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
-from ..k8s.errors import ApiError
+from ..runtime.controls import submit_creates_with_expectations
 from ..runtime.expectations import expectation_services_key
 from ..runtime.job_controller import gen_general_name
 from ..runtime.logger import logger_for_replica
@@ -30,34 +30,54 @@ class ServiceReconcilerMixin:
         rtype: str,
         spec: ReplicaSpec,
     ) -> None:
-        """service.go:36-71, generalized to any replica type."""
+        """service.go:36-71, generalized to any replica type; missing
+        services are collected from the slice scan and submitted as one
+        fan-out batch (see submit_service_creates)."""
         rt = rtype.lower()
         log = logger_for_replica(self.logger, job, rt)
         services = self.filter_services_for_replica_type(services, rt)
         replicas = int(spec.replicas or 0)
         service_slices = self.get_service_slices(services, replicas)
+        planned: List[dict] = []
         for index, service_slice in enumerate(service_slices):
             if len(service_slice) > 1:
                 log.warning("We have too many services for %s %d", rt, index)
             elif len(service_slice) == 0:
                 log.info("Need to create new service: %s-%d", rt, index)
-                self.create_new_service(job, job_dict, rtype, str(index))
+                planned.append(self.build_new_service(job, rtype, str(index)))
+        if planned:
+            self.submit_service_creates(job, job_dict, rtype, planned)
 
     def create_new_service(
         self, job: PyTorchJob, job_dict: dict, rtype: str, index: str
     ) -> None:
-        """service.go:95-159."""
+        """service.go:95-159 — compat single-service entry: a batch of
+        one through the pipelined path."""
+        service = self.build_new_service(job, rtype, index)
+        self.submit_service_creates(job, job_dict, rtype, [service])
+
+    def submit_service_creates(
+        self, job: PyTorchJob, job_dict: dict, rtype: str, services: List[dict]
+    ) -> None:
+        """One fan-out batch of service creates; expectations raised
+        up-front and rolled back per failed create (the divergence note
+        in pod.py submit_pod_creates applies verbatim — a leaked
+        expectation parks the job until the 5-minute TTL)."""
+        submit_creates_with_expectations(
+            self.expectations,
+            expectation_services_key(job.key, rtype.lower()),
+            self.service_control.create_many, job.metadata.namespace,
+            services, job_dict, self.gen_owner_reference(job_dict))
+
+    def build_new_service(self, job: PyTorchJob, rtype: str, index: str) -> dict:
+        """Render one replica's headless Service (pure; no API calls)."""
         rt = rtype.lower()
-        self.expectations.expect_creations(
-            expectation_services_key(job.key, rt), 1
-        )
-        controller_ref = self.gen_owner_reference(job_dict)
         labels = self.gen_labels(job.metadata.name)
         labels[constants.LABEL_REPLICA_TYPE] = rt
         labels[constants.LABEL_REPLICA_INDEX] = index
 
         port = get_port_from_job(job, constants.REPLICA_TYPE_MASTER)
-        service = {
+        return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {
@@ -70,14 +90,3 @@ class ServiceReconcilerMixin:
                 "ports": [{"name": constants.DEFAULT_PORT_NAME, "port": port}],
             },
         }
-        try:
-            self.service_control.create_service_with_controller_ref(
-                job.metadata.namespace, service, job_dict, controller_ref
-            )
-        except ApiError:
-            # roll back the expectation on create failure (see the
-            # matching divergence note in pod.py create_new_pod) —
-            # otherwise the job parks unsynced until the 5-minute TTL
-            self.expectations.creation_observed(
-                expectation_services_key(job.key, rt))
-            raise

@@ -132,3 +132,31 @@ def apply_replica_counts(status: JobStatus, rtype: str, active: int,
     rs.active += active
     rs.succeeded += succeeded
     rs.failed += failed
+
+
+def status_merge_diff(old: Optional[dict], new: Optional[dict]) -> dict:
+    """JSON-merge-patch (RFC 7386) delta turning wire-format ``old`` into
+    ``new``: changed/added keys carry the new value (dicts recurse, lists
+    replace wholesale), keys absent from ``new`` become explicit nulls.
+    The null-deletes reproduce exactly what the previous full-object
+    status PUT did — unknown server-side fields were already dropped by
+    the typed round-trip — while a reconcile that only flips one
+    replica's count now ships a few bytes instead of the whole object.
+    An empty dict means "nothing changed": skip the write entirely.
+    """
+    old = old or {}
+    new = new or {}
+    patch: dict = {}
+    for key, value in new.items():
+        if key not in old:
+            patch[key] = value
+        elif isinstance(value, dict) and isinstance(old[key], dict):
+            sub = status_merge_diff(old[key], value)
+            if sub:
+                patch[key] = sub
+        elif value != old[key]:
+            patch[key] = value
+    for key in old:
+        if key not in new:
+            patch[key] = None
+    return patch

@@ -65,6 +65,25 @@ def run_churn_scenario(jobs: int = 100, workers: int = 4,
 
     ns = "default"
     cluster = FakeCluster()
+    # Status-write verb accounting (wrapped BEFORE the controller
+    # subscribes): the pipelined reconcile I/O layer must persist status
+    # as merge-patches of the changed sub-tree — a full-object PUT here
+    # is a regression, and the bench artifact records the split.
+    status_writes = {"puts": 0, "patches": 0}
+    _orig_update, _orig_patch = cluster.jobs.update, cluster.jobs.patch
+
+    def _counting_update(obj, subresource=None):
+        if subresource == "status":
+            status_writes["puts"] += 1
+        return _orig_update(obj, subresource=subresource)
+
+    def _counting_patch(namespace, name, patch, subresource=None):
+        if subresource == "status":
+            status_writes["patches"] += 1
+        return _orig_patch(namespace, name, patch, subresource=subresource)
+
+    cluster.jobs.update = _counting_update
+    cluster.jobs.patch = _counting_patch
     kubelet = FakeKubelet(cluster)
     kubelet.start()
     ctl = PyTorchController(cluster, config=JobControllerConfig(),
@@ -147,6 +166,8 @@ def run_churn_scenario(jobs: int = 100, workers: int = 4,
             "duplicate_pod_jobs": duplicates,
             "pods_final": len(pods),
             "pods_expected": jobs * (workers + 1),
+            "status_full_puts": status_writes["puts"],
+            "status_merge_patches": status_writes["patches"],
         }
     finally:
         stop.set()

@@ -146,17 +146,30 @@ class FakeResourceStore:
             return copy.deepcopy(new_obj)
 
     def patch(self, namespace: str, name: str, patch: dict, subresource: Optional[str] = None) -> dict:
-        """Strategic-merge-ish patch: dicts merge recursively, lists replace."""
+        """JSON-merge-patch: dicts merge recursively, nulls delete, lists
+        replace.  A ``metadata.resourceVersion`` in the patch body acts as
+        an optimistic-concurrency precondition exactly as on a real API
+        server — mismatch raises ConflictError (409) — and through the
+        status subresource only ``.status`` may change (the rv
+        precondition is honored, everything else outside status is
+        ignored), so the sim and http tiers exercise the same
+        merge-patch + conflict-retry path the controller ships."""
         with self._cluster.lock:
             key = self._key(namespace, name)
             existing = self._objects.get(key)
             if existing is None:
                 raise NotFoundError(f'{self.kind} "{name}" not found')
+            sent_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            if sent_rv and sent_rv != existing["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f'{self.kind} "{name}": resourceVersion conflict'
+                )
             new_obj = copy.deepcopy(existing)
-            target = new_obj
             if subresource == "status":
-                patch = {"status": patch.get("status", patch)}
-            _merge(target, patch)
+                body = patch["status"] if "status" in patch else {
+                    k: v for k, v in patch.items() if k != "metadata"}
+                patch = {"status": body}
+            _merge(new_obj, patch)
             new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
             self._objects[key] = new_obj
             self._notify(MODIFIED, new_obj)

@@ -291,8 +291,18 @@ class StubApiServer:
                 except ApiError as e:
                     self._error(e)
 
+        class Server(ThreadingHTTPServer):
+            # The stdlib default accept backlog is 5; the controller's
+            # width-8 create fan-out opens one connection per request, so
+            # a batch burst overflows the backlog and the dropped SYN
+            # retransmits after ~1s — visible as a spurious 1.1s tail on
+            # the bench's http tier.  A real kube-apiserver has a large
+            # backlog; match that so the stub doesn't penalize
+            # concurrency the production server absorbs.
+            request_queue_size = 128
+
         self._stopping = threading.Event()
-        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.server = Server((host, port), Handler)
         if ssl_context is not None:
             self.server.socket = ssl_context.wrap_socket(
                 self.server.socket, server_side=True)

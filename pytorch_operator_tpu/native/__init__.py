@@ -53,6 +53,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.wq_get.argtypes = [c_void, ctypes.c_double, c_char, ctypes.c_int]
     lib.wq_done.argtypes = [c_void, c_char]
     lib.wq_forget.argtypes = [c_void, c_char]
+    lib.wq_is_dirty.restype = ctypes.c_int
+    lib.wq_is_dirty.argtypes = [c_void, c_char]
     lib.wq_num_requeues.restype = ctypes.c_int
     lib.wq_num_requeues.argtypes = [c_void, c_char]
     lib.wq_len.restype = ctypes.c_int
@@ -278,6 +280,10 @@ class NativeWorkQueue:
         q = self._q
         if q:
             self._lib.wq_forget(q, item.encode())
+
+    def is_dirty(self, item: str) -> bool:
+        q = self._q
+        return bool(self._lib.wq_is_dirty(q, item.encode())) if q else False
 
     def num_requeues(self, item: str) -> int:
         q = self._q

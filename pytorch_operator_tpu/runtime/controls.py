@@ -11,7 +11,10 @@ names for the tier-2 unit tests (service_control.go:148-210).
 from __future__ import annotations
 
 import copy
-from typing import List, Optional
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Tuple
 
 from ..k8s import serde
 from ..k8s.errors import ApiError
@@ -30,6 +33,104 @@ FAILED_DELETE_SERVICE_REASON = "FailedDeleteService"
 
 def _owner_ref_dict(ref: OwnerReference) -> dict:
     return serde.to_dict(ref)
+
+
+def create_fanout_width() -> int:
+    """Bounded width of the create fan-out (PYTORCH_OPERATOR_CREATE_FANOUT,
+    default 8; 1 = fully sequential, the pre-fan-out behavior).  Read per
+    batch so the A/B bench can flip it without rebuilding controls."""
+    try:
+        width = int(os.environ.get("PYTORCH_OPERATOR_CREATE_FANOUT", "8"))
+    except ValueError:
+        return 8
+    return max(1, width)
+
+
+_fanout_pools: dict = {}
+_fanout_pool_lock = threading.Lock()
+
+
+def _fanout_pool_for(width: int) -> ThreadPoolExecutor:
+    """Shared long-lived executor per CONFIGURED width (never per batch
+    size, and never shut down while the process lives): per-batch pool
+    construction would pay thread-spawn latency on every reconcile, and
+    tearing a pool down while a concurrent batch submits into it raises
+    RuntimeError mid-batch.  Only the env knob's values ever materialize
+    a pool (width 1 stays sequential), so at most a couple exist.  Safe
+    to share across controllers — batch tasks never submit back into the
+    pool, so it cannot self-deadlock."""
+    with _fanout_pool_lock:
+        pool = _fanout_pools.get(width)
+        if pool is None:
+            pool = ThreadPoolExecutor(
+                max_workers=width,
+                thread_name_prefix=f"create-fanout-{width}")
+            _fanout_pools[width] = pool
+        return pool
+
+
+def run_create_batch(
+    fn: Callable[[dict], dict], objs: List[dict],
+    width: Optional[int] = None,
+) -> List[Tuple[Optional[dict], Optional[Exception]]]:
+    """Apply ``fn`` to every object, concurrently up to the fan-out width.
+
+    Returns ``[(created, None) | (None, error)]`` aligned with ``objs`` —
+    every object is attempted even when earlier ones fail, so the caller
+    can decrement its expectations exactly once per observed failure.
+    Width 1 (or a single object) stays on the calling thread, preserving
+    the sequential path byte-for-byte; pass ``width=1`` explicitly for
+    deterministic ordering (the fake controls do).
+    """
+    if width is None:
+        width = create_fanout_width()
+    if width <= 1 or len(objs) <= 1:
+        results: List[Tuple[Optional[dict], Optional[Exception]]] = []
+        for obj in objs:
+            try:
+                results.append((fn(obj), None))
+            except Exception as e:
+                results.append((None, e))
+        return results
+    pool = _fanout_pool_for(width)
+    futures = [pool.submit(fn, obj) for obj in objs]
+    results = []
+    for future in futures:
+        try:
+            results.append((future.result(), None))
+        except Exception as e:
+            results.append((None, e))
+    return results
+
+
+def submit_creates_with_expectations(
+    expectations, key: str, create_many, namespace: str, objs: List[dict],
+    controller_obj: dict, controller_ref: OwnerReference,
+) -> None:
+    """The one copy of the batch-create expectations protocol (pods and
+    services both ride it): raise expectations for the whole batch
+    up-front, fan out the creates, decrement once per failed create, and
+    re-raise the first error so the sync requeues and re-plans only the
+    still-missing objects.  If the batch submission itself dies (not a
+    per-item error), every raised expectation is rolled back before
+    re-raising — the ledger must never outlive the batch that raised it,
+    or the job parks unsynced until the 5-minute expectations TTL.
+    """
+    expectations.expect_creations(key, len(objs))
+    try:
+        results = create_many(namespace, objs, controller_obj, controller_ref)
+    except Exception:
+        for _ in objs:
+            expectations.creation_observed(key)
+        raise
+    first_err: Optional[Exception] = None
+    for _created, err in results:
+        if err is not None:
+            expectations.creation_observed(key)
+            if first_err is None:
+                first_err = err
+    if first_err is not None:
+        raise first_err
 
 
 class PodControl:
@@ -63,6 +164,25 @@ class PodControl:
             created["metadata"]["name"],
         )
         return created
+
+    def create_many(
+        self,
+        namespace: str,
+        pods: List[dict],
+        controller_obj: dict,
+        controller_ref: OwnerReference,
+    ) -> List[Tuple[Optional[dict], Optional[Exception]]]:
+        """Create a batch of pods with bounded fan-out (create_fanout_width
+        concurrent API calls).  Per-pod events fire exactly as the
+        sequential path records them; the aligned result list carries one
+        error per failed create so expectations can be rolled back
+        per-failure without aborting the rest of the batch."""
+        return run_create_batch(
+            lambda pod: self.create_pod_with_controller_ref(
+                namespace, pod, controller_obj, controller_ref
+            ),
+            pods,
+        )
 
     def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
@@ -108,6 +228,21 @@ class ServiceControl:
         )
         return created
 
+    def create_many(
+        self,
+        namespace: str,
+        services: List[dict],
+        controller_obj: dict,
+        controller_ref: OwnerReference,
+    ) -> List[Tuple[Optional[dict], Optional[Exception]]]:
+        """Bounded-fan-out batch create; see PodControl.create_many."""
+        return run_create_batch(
+            lambda service: self.create_service_with_controller_ref(
+                namespace, service, controller_obj, controller_ref
+            ),
+            services,
+        )
+
     def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
             self._services.delete(namespace, name)
@@ -136,9 +271,15 @@ class FakePodControl:
         self.delete_pod_names: List[str] = []
         self.patches: List[dict] = []
         self.create_error: Optional[Exception] = None
+        # per-name injection for the fan-out tests: one batch can mix
+        # successes with distinct failures (AlreadyExists vs 500)
+        self.create_errors: dict = {}
         self.delete_error: Optional[Exception] = None
 
     def create_pod_with_controller_ref(self, namespace, pod, controller_obj, controller_ref):
+        name = (pod.get("metadata") or {}).get("name")
+        if name in self.create_errors:
+            raise self.create_errors[name]
         if self.create_error is not None:
             raise self.create_error
         pod = copy.deepcopy(pod)
@@ -148,6 +289,15 @@ class FakePodControl:
         self.templates.append(pod)
         self.controller_refs.append(controller_ref)
         return pod
+
+    def create_many(self, namespace, pods, controller_obj, controller_ref):
+        """Shared sequential path (width=1) so template order stays
+        deterministic for asserts; same aligned-results contract as the
+        real control."""
+        return run_create_batch(
+            lambda pod: self.create_pod_with_controller_ref(
+                namespace, pod, controller_obj, controller_ref),
+            pods, width=1)
 
     def delete_pod(self, namespace, name, controller_obj):
         if self.delete_error is not None:
@@ -167,8 +317,12 @@ class FakeServiceControl:
         self.delete_service_names: List[str] = []
         self.patches: List[dict] = []
         self.create_error: Optional[Exception] = None
+        self.create_errors: dict = {}
 
     def create_service_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
+        name = (service.get("metadata") or {}).get("name")
+        if name in self.create_errors:
+            raise self.create_errors[name]
         if self.create_error is not None:
             raise self.create_error
         service = copy.deepcopy(service)
@@ -177,6 +331,12 @@ class FakeServiceControl:
         )
         self.templates.append(service)
         return service
+
+    def create_many(self, namespace, services, controller_obj, controller_ref):
+        return run_create_batch(
+            lambda service: self.create_service_with_controller_ref(
+                namespace, service, controller_obj, controller_ref),
+            services, width=1)
 
     def delete_service(self, namespace, name, controller_obj):
         self.delete_service_names.append(name)

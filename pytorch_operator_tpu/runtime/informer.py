@@ -104,9 +104,21 @@ class Informer:
     handlers, matching client-go resync semantics (this is what gives the
     reference its periodic reconcile, controller.go:129)."""
 
-    def __init__(self, source, resync_period: float = 0.0):
+    def __init__(self, source, resync_period: float = 0.0, coalesce=None):
         self._source = source
         self.store = _make_store()
+        # ``coalesce(key, old, new) -> bool``: burst coalescing for
+        # MODIFIED events (live and resync-synthesized).  When it returns
+        # True the store is still updated but the update handlers are NOT
+        # dispatched — used for the job informer, whose update handler
+        # only re-enqueues: while the key is already dirty in the
+        # workqueue, the pending sync will read the fresh store anyway,
+        # so each event in a status-churn burst would only burn handler
+        # CPU.  The controller's hook declines to coalesce events that
+        # change .spec or the deletionTimestamp (those reschedule
+        # deadline timers), and informers whose handlers do bookkeeping
+        # per event (pods: expectations observation) never set this.
+        self._coalesce = coalesce
         self._handlers = EventHandlers()
         self._synced = False
         self._started = False
@@ -213,6 +225,9 @@ class Informer:
             with self._apply_lock:
                 if self._mutation_seq != start_seq:
                     continue  # events interleaved with the LIST; retry
+                # One pass over the fresh LIST: each key fires at most one
+                # synthetic callback per resync (the enqueue-at-most-once
+                # guarantee the workqueue's dedup then upholds).
                 stale_keys = [k for k in self.store.keys() if k not in fresh]
                 for key, obj in fresh.items():
                     cur = self.store.get_by_key(key)
@@ -222,6 +237,9 @@ class Informer:
                             fn(obj)
                     else:
                         self.store.update(obj)
+                        if (self._coalesce is not None
+                                and self._coalesce(key, cur, obj)):
+                            continue  # already dirty: pending sync covers it
                         for fn in self._handlers.update_funcs:
                             fn(cur, obj)
                 for key in stale_keys:
@@ -257,6 +275,9 @@ class Informer:
             elif event_type == "MODIFIED":
                 old = self.store.get_by_key(key)
                 self.store.update(obj)
+                if (self._coalesce is not None and old is not None
+                        and self._coalesce(key, old, obj)):
+                    return  # burst coalesced: store fresh, dispatch skipped
                 for fn in self._handlers.update_funcs:
                     fn(old if old is not None else obj, obj)
             elif event_type == "DELETED":

@@ -52,8 +52,15 @@ class WorkQueue:
         self._dirty: set = set()
         self._processing: set = set()
         self._shutdown = False
-        self._waiting: List[Tuple[float, int, Any]] = []  # (ready_at, seq, item)
+        # (ready_at, seq, item, is_retry) — is_retry marks entries from
+        # add_rate_limited, which are cancellable (see _pending_retry);
+        # plain add_after entries (deadline/TTL timers) never are.
+        self._waiting: List[Tuple[float, int, Any, bool]] = []
         self._seq = 0
+        # item -> seq of its single live retry entry; a heap entry whose
+        # seq no longer matches was superseded by a newer retry or
+        # cancelled by forget() and is dropped on drain
+        self._pending_retry: Dict[Any, int] = {}
         self.rate_limiter = rate_limiter or RateLimiter()
 
     # -- core queue --------------------------------------------------------
@@ -100,7 +107,11 @@ class WorkQueue:
     def _drain_ready_locked(self) -> None:
         now = time.monotonic()
         while self._waiting and self._waiting[0][0] <= now:
-            _, _, item = heapq.heappop(self._waiting)
+            _, seq, item, is_retry = heapq.heappop(self._waiting)
+            if is_retry:
+                if self._pending_retry.get(item) != seq:
+                    continue  # superseded by a newer retry or forget()
+                del self._pending_retry[item]
             # Same dedupe semantics as add().
             if item in self._dirty:
                 continue
@@ -124,6 +135,15 @@ class WorkQueue:
         with self._lock:
             return len(self._queue)
 
+    def is_dirty(self, item: Any) -> bool:
+        """True while the item awaits (re)processing — queued, or re-added
+        during processing.  The informer's burst coalescing keys off this:
+        a MODIFIED event for a dirty key updates the store but skips the
+        redundant handler dispatch (the pending sync reads the fresh
+        store anyway)."""
+        with self._lock:
+            return item in self._dirty
+
     # -- delayed / rate-limited adds ---------------------------------------
     def add_after(self, item: Any, delay: float) -> None:
         if delay <= 0:
@@ -133,13 +153,39 @@ class WorkQueue:
             if self._shutdown:
                 return
             self._seq += 1
-            heapq.heappush(self._waiting, (time.monotonic() + delay, self._seq, item))
+            heapq.heappush(
+                self._waiting,
+                (time.monotonic() + delay, self._seq, item, False))
             self._lock.notify()
 
     def add_rate_limited(self, item: Any) -> None:
-        self.add_after(item, self.rate_limiter.when(item))
+        """Schedule a backoff retry.  At most ONE live retry per item:
+        a retry for a key that is already dirty (queued or re-added) is
+        dropped — the imminent processing supersedes it, and a failure
+        there re-schedules with the next backoff — and a newer retry
+        replaces any pending one.  Without this, a rate-limited requeue
+        plus a live watch event could double-process one key after the
+        first done()."""
+        delay = self.rate_limiter.when(item)
+        with self._lock:
+            if self._shutdown:
+                return
+            if item in self._dirty:
+                return
+            self._seq += 1
+            self._pending_retry[item] = self._seq
+            heapq.heappush(
+                self._waiting,
+                (time.monotonic() + delay, self._seq, item, True))
+            self._lock.notify()
 
     def forget(self, item: Any) -> None:
+        """Reset backoff AND cancel the item's pending retry, if any —
+        forget() runs after a successful sync, which makes a scheduled
+        retry pure double-processing.  Plain add_after entries (deadline
+        timers) are never cancelled."""
+        with self._lock:
+            self._pending_retry.pop(item, None)
         self.rate_limiter.forget(item)
 
     def num_requeues(self, item: Any) -> int:

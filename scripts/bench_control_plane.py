@@ -136,8 +136,18 @@ def _set_variant(variant: str) -> None:
     os.environ["PYTORCH_OPERATOR_NATIVE"] = "1" if variant == "native" else "0"
 
 
-def run_sim(jobs: int, workers: int, variant: str = "native") -> dict:
+def _set_io(io: str) -> None:
+    """'sequential' pins the create fan-out width to 1 (the pre-pipeline
+    behavior: one blocking API call per pod/service); 'fanout' restores
+    the default width-8 batch submit."""
+    os.environ["PYTORCH_OPERATOR_CREATE_FANOUT"] = (
+        "1" if io == "sequential" else "8")
+
+
+def run_sim(jobs: int, workers: int, variant: str = "native",
+            io: str = "fanout") -> dict:
     _set_variant(variant)
+    _set_io(io)
     cluster = FakeCluster()
     kubelet = FakeKubelet(cluster)
     kubelet.start()
@@ -154,7 +164,7 @@ def run_sim(jobs: int, workers: int, variant: str = "native") -> dict:
 
 
 def run_http(jobs: int, workers: int, variant: str = "native",
-             n_streams: int = 0) -> dict:
+             n_streams: int = 0, io: str = "fanout") -> dict:
     """Reaction latency over real HTTP; optionally with N watch streams
     PARKED on the same server.
 
@@ -170,6 +180,7 @@ def run_http(jobs: int, workers: int, variant: str = "native",
     from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
 
     _set_variant(variant)
+    _set_io(io)
     srv = StubApiServer().start()
     kubelet = FakeKubelet(srv.cluster)
     kubelet.start()
@@ -355,8 +366,95 @@ def run_churn(jobs: int, workers: int, threadiness: int = 4,
     from pytorch_operator_tpu.k8s.churn import run_churn_scenario
 
     _set_variant(variant)
+    _set_io("fanout")
     return run_churn_scenario(jobs=jobs, workers=workers,
                               threadiness=threadiness, timeout=timeout)
+
+
+def run_io_ab(jobs: int, workers: int, variant: str = "native",
+              rounds: int = 3) -> dict:
+    """The pipelined-reconcile-I/O A/B: identical job shape driven with
+    the create fan-out pinned to sequential (width 1, the pre-pipeline
+    behavior) vs the default width-8 batch submit, on both the sim and
+    http tiers.  Interleaved A/B rounds with per-variant medians, same
+    reasoning as run_storm_rounds: a single round on a shared 1-core
+    box can show a spurious ratio either way."""
+    series: dict = {
+        f"io_{io}_{tier}": []
+        for io in ("sequential", "fanout") for tier in ("sim", "http")}
+    for rnd in range(rounds):
+        for io in ("sequential", "fanout"):
+            print(f"[bench_cp] io={io} round {rnd + 1}/{rounds} "
+                  f"({jobs} jobs x 1+{workers})...", file=sys.stderr)
+            series[f"io_{io}_sim"].append(
+                run_sim(jobs, workers, variant, io=io))
+            series[f"io_{io}_http"].append(
+                run_http(jobs, workers, variant, io=io))
+    out = {}
+    for key, runs in series.items():
+        agg: dict = {}
+        for stat in ("first_pod", "all_pods", "running", "succeeded"):
+            med = [r[stat]["median_ms"] for r in runs if r[stat]["n"]]
+            p95 = [r[stat]["p95_ms"] for r in runs if r[stat]["n"]]
+            agg[stat] = {
+                "median_ms": round(statistics.median(med), 1) if med else None,
+                "p95_ms": round(statistics.median(p95), 1) if p95 else None,
+                "n": sum(r[stat]["n"] for r in runs),
+            }
+        agg["rounds_all_pods_median"] = [r["all_pods"]["median_ms"]
+                                         for r in runs]
+        out[key] = agg
+    return out
+
+
+def _io_reading(results: dict, io_workers: int) -> str:
+    """Verdict for the reconcile-I/O A/B, computed from THIS run.  The
+    bar (ISSUE 1): >=1.5x median all-pods improvement on the sim tier
+    for the 1+{io_workers} shape — reported honestly either way."""
+    if "io_sequential_sim" not in results:
+        return ""
+    lines = []
+    ratios = {}
+    for tier in ("sim", "http"):
+        seq = results[f"io_sequential_{tier}"]["all_pods"]
+        fan = results[f"io_fanout_{tier}"]["all_pods"]
+        if seq["median_ms"] and fan["median_ms"]:
+            ratios[tier] = seq["median_ms"] / fan["median_ms"]
+            lines.append(
+                f"{tier} all-pods median {seq['median_ms']} ms sequential "
+                f"-> {fan['median_ms']} ms fanout "
+                f"({ratios[tier]:.2f}x)")
+    if not ratios:
+        return ("  **Reconcile-I/O A/B produced no comparable medians** — "
+                "no conclusion drawn.")
+    detail = "; ".join(lines)
+    cores = os.cpu_count() or 1
+    sim_ratio = ratios.get("sim")
+    rounds = (f"  Raw interleaved all-pods medians per round (ms): "
+              f"sim sequential "
+              f"{results['io_sequential_sim'].get('rounds_all_pods_median')}"
+              f" vs fanout "
+              f"{results['io_fanout_sim'].get('rounds_all_pods_median')}; "
+              f"the verdict uses medians across rounds.")
+    if sim_ratio is not None and sim_ratio >= 1.5:
+        return (f"  **Reconcile-I/O verdict (1 Master + {io_workers} "
+                f"Workers): the fan-out path clears the 1.5x bar on the "
+                f"sim tier on this run** — {detail}.  Creates overlap in "
+                f"the bounded executor instead of serializing one API "
+                f"round-trip per replica." + rounds)
+    return (f"  **Reconcile-I/O verdict (1 Master + {io_workers} Workers): "
+            f"the 1.5x sim-tier bar was "
+            f"{'missed' if sim_ratio else 'not measurable'} on this run "
+            f"({detail}).**  Honest reading: the sim tier's creates land "
+            f"in the GIL-bound in-memory FakeCluster under one lock, so "
+            f"fan-out threads cannot overlap them — on this "
+            f"{cores}-core box the sim tier measures queue/handler "
+            f"latency, not I/O overlap, and the residual gain comes from "
+            f"batched expectations and coalesced handler dispatch.  The "
+            f"regime the fan-out exists for is the http tier (real "
+            f"sockets, serde, round-trips) and real API servers with "
+            f"network RTTs, where the win scales with replica count x "
+            f"per-create latency." + rounds)
 
 
 def _ab_reading(results: dict) -> str:
@@ -496,7 +594,8 @@ def _storm_reading(results: dict) -> str:
 
 
 def render_md(results: dict, jobs: int, workers: int,
-              churn_jobs: int, churn_workers: int) -> str:
+              churn_jobs: int, churn_workers: int,
+              io_workers: int = 7) -> str:
     now = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC")
 
@@ -511,10 +610,12 @@ def render_md(results: dict, jobs: int, workers: int,
     def churn_row(label, res):
         converged = ("yes" if res["converged"] else
                      f"**NO** ({len(res['unconverged_jobs'] or [])} stuck)")
+        writes = (f"{res.get('status_merge_patches', '?')} patch / "
+                  f"{res.get('status_full_puts', '?')} PUT")
         return (f"| {label} | {converged} | {res['convergence_wall_s']} | "
                 f"{res['jobs_per_s']} | {res['succeeded_median_ms']} / "
                 f"{res['succeeded_p95_ms']} | {res['queue_drain_s']} | "
-                f"{res['pods_final']}/{res['pods_expected']} |")
+                f"{res['pods_final']}/{res['pods_expected']} | {writes} |")
 
     return "\n".join([
         "# BENCH_CONTROL_PLANE — PyTorchJob create→first-step latency",
@@ -558,16 +659,40 @@ def render_md(results: dict, jobs: int, workers: int,
         "http.client reads.  See the A/B reading below for what this "
         "run actually showed.",
         "",
+        f"## Reconcile I/O A/B ({jobs} jobs x (1 Master + {io_workers} "
+        "Workers), native core; `--io sequential` pins "
+        "`PYTORCH_OPERATOR_CREATE_FANOUT=1`, `fanout` uses the default "
+        "width-8 batch submit; median / p95 ms)",
+        "",
+        "| tier | first pod | all pods | Running | Succeeded |",
+        "|---|---|---|---|---|",
+    ] + [
+        row(f"{tier} io={io}", results[f"io_{io}_{tier}"])
+        for tier in ("sim", "http")
+        for io in ("sequential", "fanout")
+        if f"io_{io}_{tier}" in results
+    ] + [
+        "",
+        _io_reading(results, io_workers),
+        "",
         f"## Churn convergence ({churn_jobs} jobs x (1+{churn_workers}) "
         f"pods, threadiness "
         f"{results['churn_native']['threadiness']}, interleaved "
         "delete/recreate every 7th job)",
         "",
         "| variant | converged | convergence wall s | jobs/s | "
-        "create→Succeeded med/p95 ms | queue drain s | pods |",
-        "|---|---|---|---|---|---|---|",
+        "create→Succeeded med/p95 ms | queue drain s | pods | "
+        "status writes |",
+        "|---|---|---|---|---|---|---|---|",
         churn_row("native", results["churn_native"]),
         churn_row("python", results["churn_python"]),
+        "",
+        "The `status writes` column counts the verbs the controller used "
+        "against the job status subresource during churn: the pipelined "
+        "I/O layer persists a JSON-merge-patch of only the changed "
+        "status sub-tree (with a resourceVersion precondition and a "
+        "one-shot conflict retry), so full-object PUTs must be 0; the "
+        "`pods` column still asserts zero expectation-leak duplicates.",
         "",
         "`sim` is the controller against the in-memory fake cluster "
         "(pure reconcile latency); `http` runs the production REST "
@@ -615,23 +740,34 @@ def main() -> None:
                     help="event generation rate; deliveries/s = "
                          "streams x hz")
     ap.add_argument("--storm-threadiness", type=int, default=8)
+    ap.add_argument("--io", choices=("ab", "sequential", "fanout"),
+                    default="ab",
+                    help="create-path I/O mode: 'sequential' pins the "
+                         "fan-out width to 1, 'fanout' uses the default "
+                         "width 8, 'ab' additionally runs the dedicated "
+                         "sequential-vs-fanout comparison tier")
+    ap.add_argument("--io-workers", type=int, default=7,
+                    help="worker count for the reconcile-I/O A/B tier "
+                         "(ISSUE 1 shape: 1 Master + 7 Workers)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     saved = os.environ.get("PYTORCH_OPERATOR_NATIVE")
+    saved_io = os.environ.get("PYTORCH_OPERATOR_CREATE_FANOUT")
+    run_io = "fanout" if args.io == "ab" else args.io
     results: dict = {}
     try:
         for variant in ("native", "python"):
             print(f"[bench_cp] sim/{variant} ({args.jobs} jobs)...",
                   file=sys.stderr)
             results[f"sim_{variant}"] = run_sim(args.jobs, args.workers,
-                                                variant)
+                                                variant, io=run_io)
             print(json.dumps({"tier": f"sim_{variant}",
                               **results[f"sim_{variant}"]}))
             print(f"[bench_cp] http/{variant} ({args.jobs} jobs)...",
                   file=sys.stderr)
             results[f"http_{variant}"] = run_http(args.jobs, args.workers,
-                                                  variant)
+                                                  variant, io=run_io)
             print(json.dumps({"tier": f"http_{variant}",
                               **results[f"http_{variant}"]}))
             for n_streams in args.parked:
@@ -647,6 +783,10 @@ def main() -> None:
                 args.churn_jobs, args.churn_workers, variant=variant)
             print(json.dumps({"tier": f"churn_{variant}",
                               **results[f"churn_{variant}"]}))
+        if args.io == "ab":
+            results.update(run_io_ab(args.jobs, args.io_workers))
+            for key in sorted(k for k in results if k.startswith("io_")):
+                print(json.dumps({"tier": key, **results[key]}))
         if args.storm_streams:
             print(f"[bench_cp] storm ({args.storm_streams} streams x "
                   f"{args.storm_hz} Hz, 5 interleaved A/B rounds)...",
@@ -659,15 +799,18 @@ def main() -> None:
                 print(json.dumps({"tier": f"storm_{variant}",
                                   **results[f"storm_{variant}"]}))
     finally:
-        if saved is None:
-            os.environ.pop("PYTORCH_OPERATOR_NATIVE", None)
-        else:
-            os.environ["PYTORCH_OPERATOR_NATIVE"] = saved
+        for var, old in (("PYTORCH_OPERATOR_NATIVE", saved),
+                         ("PYTORCH_OPERATOR_CREATE_FANOUT", saved_io)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
 
     if args.out:
         with open(args.out, "w") as f:
             f.write(render_md(results, args.jobs, args.workers,
-                              args.churn_jobs, args.churn_workers))
+                              args.churn_jobs, args.churn_workers,
+                              io_workers=args.io_workers))
         print(f"[bench_cp] wrote {args.out}", file=sys.stderr)
 
 

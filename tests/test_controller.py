@@ -593,3 +593,177 @@ def test_expectations_gate_resync():
     # no pods exist in the cluster store → it recreates (fake controls don't
     # persist), proving the gate opened
     assert len(ctl.pod_control.templates) > n
+
+
+# --------------------------------------------------------------------------
+# Pipelined reconcile I/O: fan-out creates + status merge-patch
+# --------------------------------------------------------------------------
+
+
+def test_fanout_create_failures_decrement_expectations_exactly():
+    """One batch of N concurrent pod creates where one fails with
+    AlreadyExists and another with a 500: expectations must be raised
+    up-front for the whole batch and decremented exactly once per
+    observed failure, and the job must converge on the requeue instead
+    of parking until the 5-minute TTL."""
+    from pytorch_operator_tpu.k8s.errors import AlreadyExistsError, ApiError
+    from pytorch_operator_tpu.runtime.expectations import (
+        expectation_pods_key,
+    )
+
+    ctl, cluster, _ = make_controller()
+    job = new_job(workers=4)
+    inject_job(ctl, job)
+    ctl.pod_control.create_errors = {
+        "test-pytorchjob-worker-1": AlreadyExistsError("pod exists"),
+        "test-pytorchjob-worker-2": ApiError("internal server error"),
+    }
+
+    forget, err = ctl.sync_job(KEY)
+    assert err is not None and not forget  # first failure requeues
+
+    worker_key = expectation_pods_key(KEY, "worker")
+    exp = ctl.expectations.get(worker_key)
+    # 4 raised up-front, exactly 2 rolled back for the observed failures
+    assert exp is not None and exp.adds == 2
+    created = sorted(t["metadata"]["name"]
+                     for t in ctl.pod_control.templates)
+    assert created == [
+        "test-pytorchjob-master-0",
+        "test-pytorchjob-worker-0",
+        "test-pytorchjob-worker-3",
+    ]
+
+    # the informer observes the 2 successful worker creates -> satisfied
+    for t in ctl.pod_control.templates:
+        t["metadata"]["namespace"] = TEST_NAMESPACE
+        ctl.add_pod(t)
+    assert ctl.expectations.satisfied(worker_key)
+
+    # failure cleared: the requeued sync proceeds immediately (no TTL
+    # wait) and re-plans the still-missing indices
+    ctl.pod_control.create_errors = {}
+    n = len(ctl.pod_control.templates)
+    forget, err = ctl.sync_job(KEY)
+    assert err is None
+    assert len(ctl.pod_control.templates) > n
+
+
+def _seed_job_with_status(ctl, cluster, workers=1):
+    """Create a job whose server copy and informer cache agree, with a
+    canonical serialized status, and return its parsed form."""
+    from pytorch_operator_tpu.api.v1.types import ReplicaStatus
+
+    job = new_job(workers=workers)
+    job.status.replica_statuses = {
+        "Master": ReplicaStatus(active=1),
+        "Worker": ReplicaStatus(active=0),
+    }
+    stored = cluster.jobs.create(TEST_NAMESPACE, job.to_dict())
+    ctl.job_informer.store.add(stored)
+    from pytorch_operator_tpu.api.v1.types import PyTorchJob
+
+    return PyTorchJob.from_dict(stored)
+
+
+def _record_status_writes(cluster):
+    patches = []
+    orig_patch = cluster.jobs.patch
+
+    def recording_patch(namespace, name, patch, subresource=None):
+        patches.append((patch, subresource))
+        return orig_patch(namespace, name, patch, subresource=subresource)
+
+    def forbidden_update(obj, subresource=None):
+        raise AssertionError(
+            "full-object status PUT — the controller must merge-patch")
+
+    cluster.jobs.patch = recording_patch
+    cluster.jobs.update = forbidden_update
+    return patches
+
+
+def test_status_update_sends_merge_patch_of_changed_subtree_only():
+    """A reconcile that only flips one replica's active count must send
+    a patch containing only .status (plus the resourceVersion
+    precondition) — and only the changed sub-tree of it."""
+    ctl, cluster, _ = make_controller()
+    parsed = _seed_job_with_status(ctl, cluster)
+    patches = _record_status_writes(cluster)
+
+    parsed.status.replica_statuses["Worker"].active = 1
+    ctl._update_job_status(parsed)
+
+    assert len(patches) == 1
+    patch, subresource = patches[0]
+    assert subresource == "status"
+    assert set(patch) == {"status", "metadata"}
+    assert set(patch["metadata"]) == {"resourceVersion"}
+    assert patch["status"] == {
+        "replicaStatuses": {"Worker": {"active": 1}}}
+    stored = cluster.jobs.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    assert stored["status"]["replicaStatuses"]["Worker"]["active"] == 1
+    assert stored["status"]["replicaStatuses"]["Master"]["active"] == 1
+
+    # no delta -> no write at all
+    ctl.job_informer.store.add(stored)
+    refreshed = ctl._job_from_unstructured(stored)
+    ctl._update_job_status(refreshed)
+    assert len(patches) == 1
+
+
+def test_status_patch_stale_rv_conflict_retries_once_then_succeeds():
+    """Stub server 409 on the first attempt (stale resourceVersion from
+    the informer cache): the controller re-reads and retries exactly
+    once, then succeeds."""
+    ctl, cluster, _ = make_controller()
+    parsed = _seed_job_with_status(ctl, cluster)
+    # bump the server object behind the cache's back: the cache rv the
+    # first patch carries is now stale -> genuine 409 from the store
+    cluster.jobs.patch(TEST_NAMESPACE, TEST_JOB_NAME,
+                       {"metadata": {"labels": {"tick": "1"}}})
+    patches = _record_status_writes(cluster)
+
+    parsed.status.replica_statuses["Worker"].active = 1
+    ctl._update_job_status(parsed)
+
+    assert len(patches) == 2  # 409 then retry
+    assert all(sub == "status" for _, sub in patches)
+    stored = cluster.jobs.get(TEST_NAMESPACE, TEST_JOB_NAME)
+    assert stored["status"]["replicaStatuses"]["Worker"]["active"] == 1
+
+
+def test_status_patch_second_conflict_propagates():
+    from pytorch_operator_tpu.k8s.errors import ConflictError
+
+    ctl, cluster, _ = make_controller()
+    parsed = _seed_job_with_status(ctl, cluster)
+
+    def always_conflict(namespace, name, patch, subresource=None):
+        raise ConflictError("resourceVersion conflict")
+
+    cluster.jobs.patch = always_conflict
+    parsed.status.replica_statuses["Worker"].active = 1
+    with pytest.raises(ConflictError):
+        ctl._update_job_status(parsed)  # sync_job would requeue
+
+
+def test_job_coalesce_hook_skips_only_safe_bursts():
+    """Status-only MODIFIED bursts for a dirty key are coalesced; spec
+    or deletionTimestamp changes always dispatch (they reschedule the
+    ActiveDeadlineSeconds wake-up / drive deletion handling)."""
+    ctl, cluster, _ = make_controller()
+    meta = {"namespace": TEST_NAMESPACE, "name": TEST_JOB_NAME}
+    old = {"metadata": dict(meta), "spec": {"x": 1}, "status": {"a": 1}}
+    status_only = {"metadata": dict(meta), "spec": {"x": 1},
+                   "status": {"a": 2}}
+    spec_change = {"metadata": dict(meta), "spec": {"x": 2},
+                   "status": {"a": 2}}
+    deleting = {"metadata": {**meta, "deletionTimestamp": "t"},
+                "spec": {"x": 1}, "status": {"a": 2}}
+
+    assert not ctl._coalesce_job_event(KEY, old, status_only)  # not dirty
+    ctl.work_queue.add(KEY)
+    assert ctl._coalesce_job_event(KEY, old, status_only)
+    assert not ctl._coalesce_job_event(KEY, old, spec_change)
+    assert not ctl._coalesce_job_event(KEY, old, deleting)

@@ -70,6 +70,44 @@ class TestWorkQueueContract:
         assert item == "k"
         assert time.monotonic() - t0 >= 0.05
 
+    def test_is_dirty(self, queue):
+        assert not queue.is_dirty("k")
+        queue.add("k")
+        assert queue.is_dirty("k")
+        queue.get(1.0)
+        assert not queue.is_dirty("k")  # processing, not dirty
+        queue.add("k")
+        assert queue.is_dirty("k")
+
+    def test_forget_cancels_pending_retry(self, queue):
+        queue.add_rate_limited("k")
+        queue.forget("k")
+        assert queue.get(0.2) == (None, False)
+
+    def test_plain_add_after_survives_forget(self, queue):
+        queue.add_after("k", 0.05)
+        queue.forget("k")
+        assert queue.get(2.0)[0] == "k"
+
+    def test_retry_deduped_against_queued_key(self, queue):
+        """Rate-limited requeue + live watch event must not
+        double-process the key after the first done()."""
+        queue.add("k")
+        assert queue.get(1.0)[0] == "k"
+        queue.add("k")               # watch event while processing
+        queue.add_rate_limited("k")  # failed sync's retry -> deduped
+        queue.done("k")
+        assert queue.get(1.0)[0] == "k"  # the single re-process
+        queue.done("k")
+        assert queue.get(0.2) == (None, False)
+
+    def test_newer_retry_supersedes_pending(self, queue):
+        queue.add_rate_limited("k")
+        queue.add_rate_limited("k")
+        assert queue.get(2.0)[0] == "k"
+        queue.done("k")
+        assert queue.get(0.3) == (None, False)
+
     def test_rate_limited_backoff_counts(self, queue):
         queue.add_rate_limited("k")
         queue.add_rate_limited("k")

@@ -330,3 +330,243 @@ def test_informer_callbacks_enqueue_owner():
     assert ctl.expectations.satisfied(expectation_pods_key(key, "worker"))
     item, _ = ctl.work_queue.get(timeout=0.1)
     assert item == key
+
+
+def test_workqueue_is_dirty_tracks_pending_state():
+    q = WorkQueue()
+    assert not q.is_dirty("a")
+    q.add("a")
+    assert q.is_dirty("a")
+    q.get(timeout=0.1)
+    assert not q.is_dirty("a")  # processing, not dirty
+    q.add("a")  # re-added during processing
+    assert q.is_dirty("a")
+
+
+def test_workqueue_forget_cancels_pending_retry():
+    """forget() after a successful sync must cancel the scheduled backoff
+    retry — otherwise the retry fires later and double-processes a key
+    that already converged."""
+    q = WorkQueue()
+    q.add_rate_limited("a")  # ~5ms backoff
+    q.forget("a")
+    got, _ = q.get(timeout=0.2)
+    assert got is None
+
+
+def test_workqueue_plain_add_after_survives_forget():
+    """Deadline/TTL timers ride add_after and must NOT be cancelled by
+    forget() (every successful sync forgets the key; the
+    ActiveDeadlineSeconds wake-up still has to fire)."""
+    q = WorkQueue()
+    q.add_after("a", 0.05)
+    q.forget("a")
+    got, _ = q.get(timeout=2.0)
+    assert got == "a"
+
+
+def test_workqueue_retry_deduped_against_queued_key():
+    """A rate-limited requeue plus a live watch event used to
+    double-process one key after the first done(): the retry for an
+    already-dirty key is dropped (the imminent processing supersedes
+    it)."""
+    q = WorkQueue()
+    q.add("a")
+    item, _ = q.get(timeout=0.5)
+    assert item == "a"
+    q.add("a")  # live watch event while processing: dirty again
+    q.add_rate_limited("a")  # failed sync schedules a retry -> deduped
+    q.done("a")
+    item, _ = q.get(timeout=0.5)
+    assert item == "a"  # the single re-process
+    q.done("a")
+    got, _ = q.get(timeout=0.2)
+    assert got is None, "retry ghost double-processed the key"
+
+
+def test_workqueue_newer_retry_supersedes_pending():
+    q = WorkQueue()
+    q.add_rate_limited("a")  # 5ms
+    q.add_rate_limited("a")  # 10ms — replaces the pending entry
+    item, _ = q.get(timeout=2.0)
+    assert item == "a"
+    q.done("a")
+    got, _ = q.get(timeout=0.3)
+    assert got is None, "superseded retry entry still fired"
+
+
+# --------------------------------------------------------------------------
+# informer burst coalescing
+# --------------------------------------------------------------------------
+
+
+class _ListSource:
+    """Minimal informer source: scripted LIST + manual event emission."""
+
+    def __init__(self, objs=()):
+        self.objs = list(objs)
+        self.listeners = []
+
+    def add_listener(self, fn):
+        self.listeners.append(fn)
+
+    def remove_listener(self, fn):
+        self.listeners.remove(fn)
+
+    def list(self, namespace=None):
+        return list(self.objs)
+
+    def emit(self, etype, obj):
+        for fn in list(self.listeners):
+            fn(etype, obj)
+
+
+def _obj(name, rv, spec=None):
+    return {"metadata": {"namespace": "ns", "name": name,
+                         "resourceVersion": str(rv)},
+            "spec": spec or {}}
+
+
+def test_informer_coalesces_modified_while_key_dirty():
+    dirty = set()
+    src = _ListSource()
+    inf = Informer(src, coalesce=lambda key, old, new: key in dirty)
+    updates = []
+    inf.add_event_handler(on_update=lambda old, new: updates.append(
+        new["metadata"]["resourceVersion"]))
+    inf.start()
+
+    src.emit("ADDED", _obj("a", 1))
+    src.emit("MODIFIED", _obj("a", 2))  # not dirty: dispatched
+    dirty.add("ns/a")
+    src.emit("MODIFIED", _obj("a", 3))  # dirty: store updated, no dispatch
+    src.emit("MODIFIED", _obj("a", 4))
+    assert updates == ["2"]
+    assert inf.store.get_by_key("ns/a")["metadata"]["resourceVersion"] == "4"
+    dirty.clear()
+    src.emit("MODIFIED", _obj("a", 5))  # clean again: dispatched
+    assert updates == ["2", "5"]
+
+
+def test_informer_resync_dispatches_each_key_once_per_pass():
+    src = _ListSource([_obj("a", 1), _obj("b", 1)])
+    inf = Informer(src)
+    counts = {}
+    inf.add_event_handler(on_update=lambda old, new: counts.__setitem__(
+        new["metadata"]["name"], counts.get(new["metadata"]["name"], 0) + 1))
+    inf.start()
+    inf.resync()
+    assert counts == {"a": 1, "b": 1}
+    inf.resync()
+    assert counts == {"a": 2, "b": 2}
+
+
+def test_informer_resync_respects_coalesce():
+    dirty = {"ns/a"}
+    src = _ListSource([_obj("a", 1), _obj("b", 1)])
+    inf = Informer(src, coalesce=lambda key, old, new: key in dirty)
+    updates = []
+    inf.add_event_handler(on_update=lambda old, new: updates.append(
+        new["metadata"]["name"]))
+    inf.start()
+    src.objs = [_obj("a", 2), _obj("b", 2)]
+    inf.resync()
+    assert updates == ["b"]  # dirty key coalesced, store still healed
+    assert inf.store.get_by_key("ns/a")["metadata"]["resourceVersion"] == "2"
+
+
+def test_pod_control_create_many_overlaps_requests(monkeypatch):
+    """The fan-out batch must issue creates concurrently: a barrier only
+    opens when all four creates are in flight at once, so a serialized
+    implementation deadlocks (and fails the barrier timeout)."""
+    monkeypatch.setenv("PYTORCH_OPERATOR_CREATE_FANOUT", "8")
+    from pytorch_operator_tpu.k8s.objects import OwnerReference
+    from pytorch_operator_tpu.runtime.controls import PodControl
+
+    barrier = threading.Barrier(4, timeout=5)
+
+    class SlowPods:
+        def create(self, namespace, pod):
+            barrier.wait()
+            return pod
+
+    control = PodControl(SlowPods(), FakeRecorder())
+    ref = OwnerReference(api_version="v1", kind="PyTorchJob",
+                         name="j", uid="u")
+    pods = [{"metadata": {"name": f"p-{i}"}} for i in range(4)]
+    results = control.create_many("ns", pods, {}, ref)
+    assert [err for _, err in results] == [None] * 4
+    assert [created["metadata"]["name"]
+            for created, _ in results] == ["p-0", "p-1", "p-2", "p-3"]
+
+
+def test_pod_control_create_many_sequential_width_one(monkeypatch):
+    """Width 1 restores the sequential path (the bench's --io sequential
+    pin) and still reports per-object errors without aborting the
+    batch."""
+    monkeypatch.setenv("PYTORCH_OPERATOR_CREATE_FANOUT", "1")
+    from pytorch_operator_tpu.k8s.errors import ApiError
+    from pytorch_operator_tpu.k8s.objects import OwnerReference
+    from pytorch_operator_tpu.runtime.controls import PodControl
+
+    calls = []
+
+    class Pods:
+        def create(self, namespace, pod):
+            calls.append(pod["metadata"]["name"])
+            if pod["metadata"]["name"] == "p-1":
+                raise ApiError("boom")
+            return pod
+
+    control = PodControl(Pods(), FakeRecorder())
+    ref = OwnerReference(api_version="v1", kind="PyTorchJob",
+                         name="j", uid="u")
+    pods = [{"metadata": {"name": f"p-{i}"}} for i in range(3)]
+    results = control.create_many("ns", pods, {}, ref)
+    assert calls == ["p-0", "p-1", "p-2"]
+    assert results[0][1] is None and results[2][1] is None
+    assert isinstance(results[1][1], ApiError)
+
+
+def test_submit_creates_rolls_back_all_expectations_on_batch_failure():
+    """If the batch submission itself dies (not a per-item error), every
+    raised expectation must be rolled back — otherwise the job parks
+    unsynced until the 5-minute expectations TTL."""
+    from pytorch_operator_tpu.runtime.controls import (
+        submit_creates_with_expectations,
+    )
+
+    e = ControllerExpectations()
+    key = expectation_pods_key("ns/job", "worker")
+
+    def exploding_create_many(namespace, objs, controller_obj, ref):
+        raise RuntimeError("pool torn down mid-batch")
+
+    with pytest.raises(RuntimeError):
+        submit_creates_with_expectations(
+            e, key, exploding_create_many, "ns",
+            [{"metadata": {"name": f"p-{i}"}} for i in range(3)], {}, None)
+    assert e.satisfied(key)
+
+
+def test_fanout_pool_keyed_by_configured_width_not_batch_size(monkeypatch):
+    """Two concurrent batches of different sizes must share the one
+    pool for the configured width — per-batch-size pools would tear
+    each other down mid-submit."""
+    monkeypatch.setenv("PYTORCH_OPERATOR_CREATE_FANOUT", "8")
+    from pytorch_operator_tpu.runtime import controls
+
+    seen_pools = set()
+    orig = controls._fanout_pool_for
+
+    def spy(width):
+        pool = orig(width)
+        seen_pools.add(id(pool))
+        return pool
+
+    monkeypatch.setattr(controls, "_fanout_pool_for", spy)
+    for n in (7, 2, 5):
+        results = controls.run_create_batch(
+            lambda obj: obj, [{"i": i} for i in range(n)])
+        assert len(results) == n and all(e is None for _, e in results)
+    assert len(seen_pools) == 1
