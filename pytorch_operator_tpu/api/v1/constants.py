@@ -87,3 +87,37 @@ ENV_PJRT_DEVICE = "PJRT_DEVICE"
 TPU_RESOURCE = "google.com/tpu"
 NODE_SELECTOR_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 NODE_SELECTOR_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+
+# --- Disruption handling ----------------------------------------------------
+# Condition reason set on the job's Restarting condition when a proactive
+# gang restart fires ahead of a node preemption.
+TPU_PREEMPTED_REASON = "TPUPreempted"
+# Emitted instead of a restart once the per-job budget is exhausted.
+PREEMPTION_RESTARTS_EXHAUSTED_REASON = "TPUPreemptionRestartsExhausted"
+
+# Per-job knobs (annotations on the PyTorchJob):
+#   disruption-handling: "disabled" opts one job out of proactive
+#     restarts even when the operator runs with
+#     --enable-disruption-handling;
+#   max-preemption-restarts: overrides the operator-wide budget.
+ANNOTATION_DISRUPTION_HANDLING = "pytorch.kubeflow.org/disruption-handling"
+ANNOTATION_MAX_PREEMPTION_RESTARTS = (
+    "pytorch.kubeflow.org/max-preemption-restarts")
+DISRUPTION_HANDLING_DISABLED = "disabled"
+
+# Pod condition type the eviction machinery sets ahead of a
+# disruption-driven kill (k8s.io/api/core/v1 DisruptionTarget).
+POD_CONDITION_DISRUPTION_TARGET = "DisruptionTarget"
+
+# Node taints that mean "this node is going away" — the single source of
+# the detection vocabulary shared by disruption.detector (recognition)
+# and k8s.fake_kubelet (injection).
+IMPENDING_NODE_TERMINATION_TAINT = (
+    "cloud.google.com/impending-node-termination")
+NODE_UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+NODE_NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+DISRUPTION_TAINT_KEYS = (
+    IMPENDING_NODE_TERMINATION_TAINT,
+    NODE_UNREACHABLE_TAINT,
+    NODE_NOT_READY_TAINT,
+)

@@ -56,6 +56,12 @@ class JobStatus:
     start_time: Optional[str] = None
     completion_time: Optional[str] = None
     last_reconcile_time: Optional[str] = None
+    # Proactive gang restarts consumed from the preemption budget
+    # (disruption subsystem); rides the normal status merge-patch so the
+    # cutoff survives operator restarts.  None (never preempted) keeps
+    # the serde omitempty invariant: an untouched status serializes to
+    # nothing.
+    preemption_restarts: Optional[int] = None
 
 
 @dataclass

@@ -120,6 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "even without --enable-gang-scheduling (TPU slices "
                         "are all-or-nothing); =false restores reference "
                         "opt-in behavior")
+    p.add_argument("--enable-disruption-handling", action="store_true",
+                   help="watch Node taints / pod DisruptionTarget "
+                        "conditions and proactively gang-restart jobs on "
+                        "impending TPU preemption (one batched restart "
+                        "instead of N per-pod failure/backoff cycles)")
+    p.add_argument("--max-preemption-restarts", type=int, default=3,
+                   help="proactive gang restarts allowed per job before "
+                        "falling back to per-pod failure handling "
+                        "(per-job override: the "
+                        "pytorch.kubeflow.org/max-preemption-restarts "
+                        "annotation)")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for the /metrics endpoint (0 = disabled)")
     p.add_argument("--resync-period", "--resyc-period", dest="resync_period",
@@ -208,6 +219,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         init_container_image=args.init_container_image,
         tpu_auto_gang=args.tpu_auto_gang,
         resync_period_seconds=parse_duration(args.resync_period),
+        enable_disruption_handling=args.enable_disruption_handling,
+        max_preemption_restarts=args.max_preemption_restarts,
     )
     controller = PyTorchController(cluster, config=config, registry=registry)
 

@@ -121,12 +121,22 @@ class JobLifecycleMixin:
         self, job: PyTorchJob, job_dict: dict, pods: List[dict], services: List[dict]
     ) -> None:
         """job.go:153-181.  Unlike the reference (which no-ops for Running
-        too), CleanPodPolicy=Running deletes only still-active pods."""
+        too), CleanPodPolicy=Running deletes only still-active pods.
+
+        Deletes ride the same bounded fan-out as creates (ROADMAP
+        delete-fan-out item): one ``delete_many`` batch per replica type
+        with deletion expectations raised up-front and decremented per
+        failure, so an 8-worker teardown overlaps its API round-trips
+        instead of paying them serially.  Objects without a replica-type
+        label (adopted strays) fall back to one direct delete each —
+        there is no expectations key to account them under.
+        """
         if not pods and not services:
             return
         policy = job.spec.clean_pod_policy or constants.CLEAN_POD_POLICY_NONE
         if policy == constants.CLEAN_POD_POLICY_NONE:
             return
+        doomed = []
         for pod in pods:
             phase = (pod.get("status") or {}).get("phase")
             if policy == constants.CLEAN_POD_POLICY_RUNNING and phase not in (
@@ -134,20 +144,30 @@ class JobLifecycleMixin:
                 "Pending",
             ):
                 continue
-            self.pod_control.delete_pod(
-                pod["metadata"].get("namespace", ""),
-                pod["metadata"].get("name", ""),
-                job_dict,
-            )
+            doomed.append(pod)
+        for rtype, group in _group_by_replica_type(doomed).items():
+            if rtype:
+                self.submit_pod_deletes(job, job_dict, rtype, group)
+            else:
+                for pod in group:
+                    self.pod_control.delete_pod(
+                        pod["metadata"].get("namespace", ""),
+                        pod["metadata"].get("name", ""),
+                        job_dict,
+                    )
         # TPU deviation: every replica has a service; delete them all (the
         # reference removes only the master's, service filter in
         # job.go:171-180).
-        for service in services:
-            self.service_control.delete_service(
-                service["metadata"].get("namespace", ""),
-                service["metadata"].get("name", ""),
-                job_dict,
-            )
+        for rtype, group in _group_by_replica_type(services).items():
+            if rtype:
+                self.submit_service_deletes(job, job_dict, rtype, group)
+            else:
+                for service in group:
+                    self.service_control.delete_service(
+                        service["metadata"].get("namespace", ""),
+                        service["metadata"].get("name", ""),
+                        job_dict,
+                    )
 
     def cleanup_job(self, job: PyTorchJob) -> None:
         """TTLSecondsAfterFinished enforcement (job.go:184-206)."""
@@ -173,6 +193,17 @@ class JobLifecycleMixin:
             self.cluster.jobs.delete(job.metadata.namespace, job.metadata.name)
         except NotFoundError:
             pass
+
+
+def _group_by_replica_type(objs: List[dict]) -> dict:
+    """Group wire objects by their replica-type label; unlabeled objects
+    land under ``""``."""
+    groups: dict = {}
+    for obj in objs:
+        rtype = (obj.get("metadata", {}).get("labels") or {}).get(
+            constants.LABEL_REPLICA_TYPE, "")
+        groups.setdefault(rtype, []).append(obj)
+    return groups
 
 
 def _cond_dict(c) -> dict:

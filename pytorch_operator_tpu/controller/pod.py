@@ -15,7 +15,10 @@ from typing import Dict, List
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
 from ..k8s import serde
-from ..runtime.controls import submit_creates_with_expectations
+from ..runtime.controls import (
+    submit_creates_with_expectations,
+    submit_deletes_with_expectations,
+)
 from ..runtime.expectations import expectation_pods_key
 from ..runtime.job_controller import gen_general_name, gen_pod_group_name
 from ..runtime.logger import logger_for_pod, logger_for_replica
@@ -159,6 +162,22 @@ class PodReconcilerMixin:
             self.expectations, expectation_pods_key(job.key, rtype.lower()),
             self.pod_control.create_many, job.metadata.namespace, pods,
             job_dict, self.gen_owner_reference(job_dict))
+
+    def submit_pod_deletes(
+        self, job: PyTorchJob, job_dict: dict, rtype: str, pods: List[dict]
+    ) -> None:
+        """Issue one batch of pod deletes through the bounded fan-out —
+        the delete-side mirror of submit_pod_creates (ROADMAP fan-out
+        item): deletion expectations raised up-front for the batch,
+        decremented per failed delete, successes observed by the pod
+        informer's DELETED callback.  Rides under CleanPodPolicy
+        All/Running terminal cleanup and the disruption subsystem's
+        proactive gang restart."""
+        names = [p.get("metadata", {}).get("name", "") for p in pods]
+        submit_deletes_with_expectations(
+            self.expectations, expectation_pods_key(job.key, rtype.lower()),
+            self.pod_control.delete_many, job.metadata.namespace, names,
+            job_dict)
 
     def build_new_pod(
         self,

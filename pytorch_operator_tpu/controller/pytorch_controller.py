@@ -19,6 +19,7 @@ from ..api.v1 import constants
 from ..api.v1.defaults import set_defaults
 from ..api.v1.types import PyTorchJob
 from ..api.v1.validation import ValidationError, validate_spec
+from ..disruption.handler import DisruptionHandlingMixin
 from ..k8s import serde
 from ..k8s.errors import ConflictError, NotFoundError
 from ..metrics import default_registry
@@ -37,7 +38,8 @@ from .service import ServiceReconcilerMixin
 
 
 class PyTorchController(
-    JobLifecycleMixin, PodReconcilerMixin, ServiceReconcilerMixin, JobController
+    JobLifecycleMixin, PodReconcilerMixin, ServiceReconcilerMixin,
+    DisruptionHandlingMixin, JobController
 ):
     def __init__(
         self,
@@ -78,6 +80,18 @@ class PyTorchController(
         self.jobs_restarted_counter = registry.counter(
             "pytorch_operator_jobs_restarted_total", "Counts number of PyTorch jobs restarted"
         )
+        # Status merge-patches carry a resourceVersion precondition; 409s
+        # are retried once with a fresh base.  Counting them makes
+        # multi-writer contention visible instead of silently paying the
+        # extra GET (ROADMAP conflict-telemetry item).
+        self.status_conflicts_counter = registry.counter(
+            "pytorch_operator_status_patch_conflicts_total",
+            "Counts resourceVersion conflicts (409) hit while patching "
+            "job status; each costs one base re-read and retry",
+        )
+        # Disruption subsystem (metrics always registered; the watcher
+        # only when --enable-disruption-handling built a node informer).
+        self.init_disruption_handling(registry)
         # Handlers are attributes so tier-2 tests can stub the status write
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
@@ -164,7 +178,9 @@ class PyTorchController(
         """
         namespace = job.metadata.namespace
         name = job.metadata.name
-        new_status = job.to_dict().get("status") or {}
+        # serialize only .status — this is the hottest write path, and
+        # to_dict(job) would re-serde the full pod templates per patch
+        new_status = serde.to_dict(job.status)
         cached = self._get_job_from_cache(namespace, name)
         for attempt in range(2):
             old_status = (cached or {}).get("status") or {}
@@ -180,6 +196,7 @@ class PyTorchController(
                     namespace, name, body, subresource="status")
                 return
             except ConflictError:
+                self.status_conflicts_counter.inc()
                 if attempt:
                     raise
                 fresh = self._get_job_from_cache(namespace, name)
@@ -195,11 +212,22 @@ class PyTorchController(
                     except NotFoundError:
                         return  # job deleted under us; nothing to persist
 
+    # -- disruption hooks --------------------------------------------------
+    def update_pod(self, old_pod: dict, new_pod: dict) -> None:
+        """Pod informer hook: detection source 2 (DisruptionTarget
+        conditions) rides the normal update stream; the base bookkeeping
+        runs unchanged."""
+        if self.disruption_handling_enabled():
+            self.note_pod_disruption(new_pod)
+        super().update_pod(old_pod, new_pod)
+
     # -- lifecycle ---------------------------------------------------------
     def start_informers(self) -> None:
         self.job_informer.start()
         self.pod_informer.start()
         self.service_informer.start()
+        if self.node_informer is not None:
+            self.node_informer.start()
 
     def run(self, threadiness: int = 1, stop_event: Optional[threading.Event] = None):
         """controller.go:185-213."""
@@ -254,6 +282,10 @@ class PyTorchController(
                 "PyTorchJob has been deleted: %s", key)
             self.jobs_deleted_counter.inc()
             self._synced_uid.pop(key, None)
+            # a disruption noted for a now-deleted job must not linger
+            # (nor fire against a same-key recreate)
+            with self._disruption_lock:
+                self._pending_disruptions.pop(key, None)
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
@@ -334,6 +366,18 @@ class PyTorchController(
                     rs = job.status.replica_statuses[rtype]
                     rs.succeeded += rs.active
                     rs.active = 0
+            if job.status != old_status:
+                self.update_status_handler(job)
+            return
+
+        # Proactive disruption handling: an impending preemption noted by
+        # the watcher consumes this sync for ONE gang restart (batched
+        # pod delete + TPUPreempted Restarting condition) instead of the
+        # per-replica reconcile below; the deletion expectations then
+        # gate re-syncs until the informer has observed every delete, and
+        # the following sync recreates the full gang.
+        if self.disruption_handling_enabled() and \
+                self.maybe_handle_disruption(job, job_dict, pods):
             if job.status != old_status:
                 self.update_status_handler(job)
             return

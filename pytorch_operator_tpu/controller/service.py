@@ -14,7 +14,10 @@ from typing import List
 
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob, ReplicaSpec
-from ..runtime.controls import submit_creates_with_expectations
+from ..runtime.controls import (
+    submit_creates_with_expectations,
+    submit_deletes_with_expectations,
+)
 from ..runtime.expectations import expectation_services_key
 from ..runtime.job_controller import gen_general_name
 from ..runtime.logger import logger_for_replica
@@ -68,6 +71,21 @@ class ServiceReconcilerMixin:
             expectation_services_key(job.key, rtype.lower()),
             self.service_control.create_many, job.metadata.namespace,
             services, job_dict, self.gen_owner_reference(job_dict))
+
+    def submit_service_deletes(
+        self, job: PyTorchJob, job_dict: dict, rtype: str,
+        services: List[dict]
+    ) -> None:
+        """Delete-side mirror of submit_service_creates: one bounded
+        fan-out batch with deletion expectations raised up-front and
+        rolled back per failure (observed deletes decrement via the
+        service informer's DELETED callback)."""
+        names = [s.get("metadata", {}).get("name", "") for s in services]
+        submit_deletes_with_expectations(
+            self.expectations,
+            expectation_services_key(job.key, rtype.lower()),
+            self.service_control.delete_many, job.metadata.namespace,
+            names, job_dict)
 
     def build_new_service(self, job: PyTorchJob, rtype: str, index: str) -> dict:
         """Render one replica's headless Service (pure; no API calls)."""

@@ -1,0 +1,45 @@
+"""Disruption subsystem: TPU preemption detection and proactive gang
+restart.
+
+The reference operator only reacts to disruption *after* a pod fails
+(restart policies + backoff, SURVEY.md §0.4).  On preemptible/spot TPU
+slices GCE announces disruption ahead of time — node taints
+(``cloud.google.com/impending-node-termination``), pod
+``DisruptionTarget`` conditions, nodes going NotReady — and a
+gang-scheduled job with one preempted worker is already dead, so waiting
+for per-pod failure backoff wastes whole-slice time.  This package
+closes the gap:
+
+  * :mod:`detector` — pure predicates mapping node/pod state to a
+    disruption reason;
+  * :mod:`watcher` — a node-informer consumer that resolves disrupted
+    nodes to the gang jobs running on them;
+  * :mod:`handler` — the controller mixin that turns one detection into
+    exactly one proactive gang restart (batched delete via the
+    ``delete_many`` fan-out, a ``Restarting`` condition with reason
+    ``TPUPreempted``, an event, and a bounded per-job restart budget);
+  * :mod:`chaos` — scripted preemption storms over the fake kubelet's
+    injection API for the sim tier.
+
+Enabled by ``--enable-disruption-handling`` in ``cmd/operator.py``.
+"""
+
+from .chaos import PreemptionStorm
+from .detector import (
+    DISRUPTION_TAINT_KEYS,
+    is_tpu_node,
+    node_disruption_reason,
+    pod_disruption_reason,
+)
+from .handler import DisruptionHandlingMixin
+from .watcher import DisruptionWatcher
+
+__all__ = [
+    "DISRUPTION_TAINT_KEYS",
+    "DisruptionHandlingMixin",
+    "DisruptionWatcher",
+    "PreemptionStorm",
+    "is_tpu_node",
+    "node_disruption_reason",
+    "pod_disruption_reason",
+]

@@ -1,0 +1,86 @@
+"""Chaos scripting: preemption storms over the fake kubelet.
+
+The fake kubelet exposes the single-node injection primitive
+(``inject_preemption``: taint at T, kill the node's pods with exit 143
+after grace).  This module composes it into storms — the maintenance
+events, zone drains and spot-market sweeps a preemptible TPU fleet
+actually sees — so sim/e2e tests can script multi-node scenarios
+declaratively and assert the operator's aggregate behavior (restart
+count, convergence, no expectation leaks).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+
+class PreemptionStorm:
+    """A scripted sequence of node preemptions against one fake kubelet.
+
+    ``schedule(node, at, grace)`` queues one preemption; ``start()`` arms
+    all of them relative to now.  ``sweep(nodes, start, stagger)`` is the
+    common shape: consecutive nodes preempted ``stagger`` seconds apart,
+    like a zone-wide spot reclaim walking through a rack.
+    """
+
+    def __init__(self, kubelet, exit_code: int = 143):
+        self.kubelet = kubelet
+        self.exit_code = exit_code
+        self._planned: List[tuple] = []  # (node, at, grace)
+        self._timers: List[threading.Timer] = []
+        self._lock = threading.Lock()
+        self._started = False
+
+    def schedule(self, node: str, at: float = 0.0,
+                 grace: float = 0.05) -> "PreemptionStorm":
+        with self._lock:
+            if self._started:
+                raise RuntimeError("storm already started")
+            self._planned.append((node, at, grace))
+        return self
+
+    def sweep(self, nodes: Sequence[str], start: float = 0.0,
+              stagger: float = 0.1,
+              grace: float = 0.05) -> "PreemptionStorm":
+        for i, node in enumerate(nodes):
+            self.schedule(node, at=start + i * stagger, grace=grace)
+        return self
+
+    def start(self) -> "PreemptionStorm":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            planned = list(self._planned)
+        for node, at, grace in planned:
+            if at <= 0:
+                self.kubelet.inject_preemption(
+                    node, grace=grace, exit_code=self.exit_code)
+            else:
+                timer = threading.Timer(
+                    at, self.kubelet.inject_preemption, args=(node,),
+                    kwargs={"grace": grace, "exit_code": self.exit_code})
+                timer.daemon = True
+                with self._lock:
+                    self._timers.append(timer)
+                timer.start()
+        return self
+
+    def cancel(self) -> None:
+        with self._lock:
+            for timer in self._timers:
+                timer.cancel()
+            self._timers.clear()
+
+
+def preempt_node_of_pod(kubelet, cluster, namespace: str, pod_name: str,
+                        grace: float = 0.05) -> Optional[str]:
+    """Convenience for tests: preempt whichever node the named pod is
+    bound to; returns the node name (None when the pod is unbound)."""
+    pod = cluster.pods.get(namespace, pod_name)
+    node = (pod.get("spec") or {}).get("nodeName")
+    if not node:
+        return None
+    kubelet.inject_preemption(node, grace=grace)
+    return node

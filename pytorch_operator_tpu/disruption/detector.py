@@ -1,0 +1,76 @@
+"""Pure disruption predicates over node/pod wire objects.
+
+Detection sources, in the order a GCE preemption usually surfaces them:
+
+  1. node taints — GCE taints the node with
+     ``cloud.google.com/impending-node-termination`` ahead of a
+     preemptible/spot VM termination; ``node.kubernetes.io/unreachable``
+     / ``not-ready`` are the node-lifecycle controller's verdicts after
+     the VM is already gone;
+  2. pod ``DisruptionTarget`` conditions — the eviction machinery marks
+     the doomed pod directly;
+  3. a TPU node whose Ready condition goes false — a dead TPU VM without
+     any taint (hard crashes skip the polite notice).
+
+All functions are side-effect free so the unit tier can table-test them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.v1 import constants
+
+# Taint keys that mean "this node is going away" (detection source 1).
+# Defined once in api/v1/constants.py, shared with the chaos injector
+# (k8s.fake_kubelet) so injection and recognition cannot drift;
+# re-exported here for the detector's public surface.
+IMPENDING_NODE_TERMINATION_TAINT = constants.IMPENDING_NODE_TERMINATION_TAINT
+NODE_UNREACHABLE_TAINT = constants.NODE_UNREACHABLE_TAINT
+NODE_NOT_READY_TAINT = constants.NODE_NOT_READY_TAINT
+DISRUPTION_TAINT_KEYS = constants.DISRUPTION_TAINT_KEYS
+
+
+def is_tpu_node(node: dict) -> bool:
+    """A node that carries google.com/tpu capacity (or the GKE TPU
+    accelerator label — capacity may be momentarily absent while the
+    device plugin restarts)."""
+    status = node.get("status") or {}
+    for field in ("capacity", "allocatable"):
+        if (status.get(field) or {}).get(constants.TPU_RESOURCE):
+            return True
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return constants.NODE_SELECTOR_TPU_ACCELERATOR in labels
+
+
+def _node_ready(node: dict) -> Optional[bool]:
+    """Tri-state Ready: True/False from the condition, None when the
+    node reports no Ready condition at all."""
+    for cond in (node.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return None
+
+
+def node_disruption_reason(node: dict) -> Optional[str]:
+    """The disruption taint key when the node is going away, the string
+    ``"TPUNodeNotReady"`` for a TPU node that lost readiness, else None
+    (healthy)."""
+    taints = (node.get("spec") or {}).get("taints") or []
+    for taint in taints:
+        if taint.get("key") in DISRUPTION_TAINT_KEYS:
+            return taint.get("key")
+    if is_tpu_node(node) and _node_ready(node) is False:
+        return "TPUNodeNotReady"
+    return None
+
+
+def pod_disruption_reason(pod: dict) -> Optional[str]:
+    """``DisruptionTarget`` condition reason (or the condition type when
+    no reason is set) for a pod the eviction machinery has marked; None
+    otherwise."""
+    for cond in (pod.get("status") or {}).get("conditions") or []:
+        if (cond.get("type") == constants.POD_CONDITION_DISRUPTION_TARGET
+                and cond.get("status") == "True"):
+            return cond.get("reason") or constants.POD_CONDITION_DISRUPTION_TARGET
+    return None

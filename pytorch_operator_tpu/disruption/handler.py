@@ -1,0 +1,231 @@
+"""Controller-side disruption policy: one detection -> one gang restart.
+
+Mixed into PyTorchController.  The watcher (and the pod informer's
+``DisruptionTarget`` hook) note disruptions into a pending map keyed by
+job; the next sync of that job consumes the note and — for gang jobs —
+performs ONE proactive gang restart: every replica pod deleted through
+the bounded ``delete_many`` fan-out with deletion expectations raised
+up-front, a ``Restarting`` condition with reason ``TPUPreempted``, a
+warning event, and the per-job preemption budget
+(``status.preemptionRestarts`` vs ``--max-preemption-restarts`` or the
+per-job annotation) decremented.  Jobs that opted out, non-gang jobs,
+and jobs over budget fall through to the legacy per-pod failure path
+unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob
+from ..runtime.expectations import expectation_pods_key
+from ..runtime.informer import meta_namespace_key
+from ..runtime.job_controller import _controller_ref_of
+from ..runtime.logger import logger_for_job
+from ..runtime.recorder import EVENT_TYPE_WARNING
+from .detector import pod_disruption_reason
+from .watcher import DisruptionWatcher
+
+
+class DisruptionHandlingMixin:
+    def init_disruption_handling(self, registry) -> None:
+        """Build the disruption metrics and (when enabled and the cluster
+        models Nodes) the watcher over the runtime's node informer."""
+        self._pending_disruptions: Dict[str, dict] = {}
+        self._disruption_lock = threading.Lock()
+        self.preemptions_detected_counter = registry.counter(
+            "pytorch_operator_preemptions_detected_total",
+            "Counts disruption detections (node taints, DisruptionTarget "
+            "conditions, NotReady TPU nodes) attributed to a job",
+        )
+        self.preemption_gang_restarts_counter = registry.counter(
+            "pytorch_operator_preemption_gang_restarts_total",
+            "Counts proactive gang restarts triggered by impending "
+            "preemption",
+        )
+        self.preemption_restarts_suppressed_counter = registry.counter(
+            "pytorch_operator_preemption_restarts_suppressed_total",
+            "Counts disruptions NOT proactively restarted (opt-out, "
+            "non-gang job, or exhausted restart budget)",
+        )
+        self.preemption_restart_latency = registry.histogram(
+            "pytorch_operator_preemption_restart_latency_seconds",
+            "Seconds from disruption detection to the gang restart's "
+            "batched pod delete being issued",
+        )
+        self.disruption_watcher: Optional[DisruptionWatcher] = None
+        if self.config.enable_disruption_handling and \
+                self.node_informer is not None:
+            self.disruption_watcher = DisruptionWatcher(
+                self.cluster, self.node_informer, self._note_disruption,
+                kind=self.KIND)
+
+    def disruption_handling_enabled(self) -> bool:
+        return self.config.enable_disruption_handling
+
+    # -- detection intake --------------------------------------------------
+    def _note_disruption(self, job_key: str, reason: str, source: str,
+                         uid: Optional[str] = None) -> None:
+        """Record a disruption for the job and wake its sync.  Multiple
+        signals for the same job coalesce while one note is pending —
+        the whole point is ONE restart per disruption, not one per
+        signal (taint + DisruptionTarget + N pod failures).  ``uid``
+        fences the note to the job incarnation it was observed against:
+        a delete-recreate under the same key drops it at sync time."""
+        with self._disruption_lock:
+            if job_key in self._pending_disruptions:
+                return
+            self._pending_disruptions[job_key] = {
+                "reason": reason,
+                "source": source,
+                "uid": uid,
+                "detected_at": time.monotonic(),
+            }
+        self.preemptions_detected_counter.inc()
+        self.work_queue.add(job_key)
+
+    def note_pod_disruption(self, pod: dict) -> None:
+        """Pod-informer hook (detection source 2): a ``DisruptionTarget``
+        condition marks the pod ahead of an eviction kill.
+
+        Pods already being deleted (a gang restart's own deletes in
+        flight) or already terminal are skipped: their late-arriving
+        condition updates describe a disruption that has ALREADY been
+        handled (or will be, by the normal failure path) — re-noting
+        would gang-restart the freshly recreated pods and burn a second
+        budget unit for one real preemption."""
+        reason = pod_disruption_reason(pod)
+        if reason is None:
+            return
+        meta = pod.get("metadata") or {}
+        if meta.get("deletionTimestamp"):
+            return
+        if ((pod.get("status") or {}).get("phase")) in ("Succeeded",
+                                                        "Failed"):
+            return
+        ref = _controller_ref_of(meta)
+        if ref is None or ref.kind != self.KIND:
+            return
+        # cache-validated resolution (UID checked): a signal from a pod
+        # of a deleted/recreated job must not be pinned on the new one
+        job = self._resolve_controller_ref(meta.get("namespace", ""), ref)
+        if job is None:
+            return
+        job_key = meta_namespace_key(job)
+        # a gang restart's own deletes may still be in flight (API
+        # latency + grace on a real cluster): outstanding deletion
+        # expectations for this replica set mean the disruption is
+        # already being handled — re-noting would restart the
+        # recreated gang a second time
+        rtype = (meta.get("labels") or {}).get(constants.LABEL_REPLICA_TYPE)
+        if rtype:
+            exp = self.expectations.get(expectation_pods_key(job_key, rtype))
+            if exp is not None and exp.dels > 0:
+                return
+        self._note_disruption(
+            job_key, reason, f'pod/{meta.get("name", "")}',
+            uid=(job.get("metadata") or {}).get("uid"))
+
+    # -- the proactive restart --------------------------------------------
+    def maybe_handle_disruption(
+        self, job: PyTorchJob, job_dict: dict, pods: List[dict]
+    ) -> bool:
+        """Consume a pending disruption note for this job.  Returns True
+        when a proactive gang restart was performed (the caller persists
+        status and ends the sync); False hands the sync to the normal
+        reconcile path."""
+        with self._disruption_lock:
+            note = self._pending_disruptions.pop(job.key, None)
+        if note is None:
+            return False
+        if note.get("uid") and job.metadata.uid and \
+                note["uid"] != job.metadata.uid:
+            # noted against a previous incarnation of this key: the new
+            # job never saw the disruption — drop the stale note
+            return False
+        log = logger_for_job(self.logger, job)
+        if not self.gang_scheduling_enabled(job):
+            # Non-gang jobs lose only the disrupted replica; per-pod
+            # restart policies already handle that cheaply.
+            self.preemption_restarts_suppressed_counter.inc()
+            return False
+        annotations = job.metadata.annotations or {}
+        if annotations.get(constants.ANNOTATION_DISRUPTION_HANDLING) == \
+                constants.DISRUPTION_HANDLING_DISABLED:
+            log.info("disruption on %s ignored: job opted out",
+                     note["source"])
+            self.preemption_restarts_suppressed_counter.inc()
+            return False
+        budget = self._preemption_budget(job)
+        used = job.status.preemption_restarts or 0
+        if used >= budget:
+            msg = (f"PyTorchJob {job.metadata.name}: node preemption "
+                   f"detected ({note['reason']}) but the proactive restart "
+                   f"budget ({budget}) is exhausted; falling back to "
+                   f"per-pod failure handling")
+            log.warning(msg)
+            self.recorder.event(
+                job_dict, EVENT_TYPE_WARNING,
+                constants.PREEMPTION_RESTARTS_EXHAUSTED_REASON, msg)
+            self.preemption_restarts_suppressed_counter.inc()
+            return False
+        if not pods:
+            return False  # nothing to restart (e.g. preempted pre-create)
+
+        # One batched delete per replica type, expectations raised
+        # up-front — N replicas restart as one unit instead of N
+        # failure/backoff cycles.  If any delete fails the note goes
+        # BACK in the map before the error requeues the sync: the
+        # watcher's per-node flag will not re-fire, so a consumed note
+        # is the only memory that this disruption still needs handling.
+        from ..controller.job import _group_by_replica_type
+
+        try:
+            for rtype, group in sorted(
+                    _group_by_replica_type(pods).items()):
+                if rtype:
+                    self.submit_pod_deletes(job, job_dict, rtype, group)
+                else:  # unlabeled strays: no expectations key to batch under
+                    for pod in group:
+                        self.pod_control.delete_pod(
+                            pod["metadata"].get("namespace", ""),
+                            pod["metadata"].get("name", ""), job_dict)
+        except Exception:
+            with self._disruption_lock:
+                self._pending_disruptions.setdefault(job.key, note)
+            raise
+
+        msg = (f"PyTorchJob {job.metadata.name} is restarting: impending "
+               f"TPU preemption on {note['source']} ({note['reason']}); "
+               f"gang-restarting all {len(pods)} replica pod(s) "
+               f"[restart {used + 1}/{budget}]")
+        log.warning(msg)
+        from ..controller import status as status_machine
+
+        status_machine.update_job_conditions(
+            job.status, constants.JOB_RESTARTING,
+            constants.TPU_PREEMPTED_REASON, msg)
+        self.recorder.event(
+            job_dict, EVENT_TYPE_WARNING, constants.TPU_PREEMPTED_REASON, msg)
+        job.status.preemption_restarts = used + 1
+        self.preemption_gang_restarts_counter.inc()
+        self.preemption_restart_latency.observe(
+            time.monotonic() - note["detected_at"])
+        self.jobs_restarted_counter.inc()
+        return True
+
+    def _preemption_budget(self, job: PyTorchJob) -> int:
+        annotations = job.metadata.annotations or {}
+        override = annotations.get(
+            constants.ANNOTATION_MAX_PREEMPTION_RESTARTS)
+        if override:
+            try:
+                return max(0, int(override))
+            except ValueError:
+                logger_for_job(self.logger, job).warning(
+                    "invalid %s annotation %r; using operator default",
+                    constants.ANNOTATION_MAX_PREEMPTION_RESTARTS, override)
+        return self.config.max_preemption_restarts

@@ -1,0 +1,119 @@
+"""Node-disruption watcher: informer events -> affected gang jobs.
+
+Consumes the node informer (runtime.Informer over the cluster's Nodes)
+and, when a node transitions into a disrupted state
+(:func:`detector.node_disruption_reason`), resolves the pods bound to it
+(``spec.nodeName``) back to their owning jobs through the controller
+owner reference and fires
+``on_job_disruption(job_key, reason, node, uid=owner_uid)`` once per
+(node, reason) transition.  The per-node flag clears when the
+node turns healthy again, so a node that is preempted, replaced and
+re-tainted later fires again — while taint-update churn on an
+already-flagged node stays silent.
+
+The concrete controller (disruption.handler) owns the policy; this class
+owns only detection fan-in.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from .detector import node_disruption_reason
+
+_log = logging.getLogger(__name__)
+
+
+class DisruptionWatcher:
+    def __init__(
+        self,
+        cluster,
+        informer,
+        on_job_disruption: Callable[..., None],
+        kind: str = "PyTorchJob",
+    ):
+        """``informer`` is a runtime.Informer over ``cluster.nodes``;
+        the watcher registers its handlers but leaves start/stop to the
+        controller's informer lifecycle."""
+        self.cluster = cluster
+        self.informer = informer
+        self.on_job_disruption = on_job_disruption
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._flagged: Dict[str, str] = {}  # node name -> last fired reason
+        informer.add_event_handler(
+            on_add=self._node_added, on_update=self._node_updated,
+            on_delete=self._node_deleted,
+        )
+
+    # -- informer handlers -------------------------------------------------
+    def _node_added(self, node: dict) -> None:
+        self._evaluate(node)
+
+    def _node_updated(self, old: dict, new: dict) -> None:
+        self._evaluate(new)
+
+    def _node_deleted(self, node: dict) -> None:
+        # A deleted node is indistinguishable from a hard preemption with
+        # no notice: treat it as unreachable if anything still runs there.
+        name = (node.get("metadata") or {}).get("name", "")
+        with self._lock:
+            already = name in self._flagged
+            self._flagged.pop(name, None)
+        if not already:
+            self._fire(name, "NodeDeleted")
+
+    # -- core --------------------------------------------------------------
+    def _evaluate(self, node: dict) -> None:
+        name = (node.get("metadata") or {}).get("name", "")
+        if not name:
+            return
+        reason = node_disruption_reason(node)
+        with self._lock:
+            if reason is None:
+                # healthy again: re-arm so the next disruption fires
+                self._flagged.pop(name, None)
+                return
+            if self._flagged.get(name) == reason:
+                return  # already fired for this transition
+            self._flagged[name] = reason
+        self._fire(name, reason)
+
+    def _fire(self, node_name: str, reason: str) -> None:
+        fired = 0
+        for job_key, uid in self._affected_jobs(node_name):
+            try:
+                self.on_job_disruption(job_key, reason, node_name, uid=uid)
+                fired += 1
+            except Exception:
+                _log.exception("disruption callback failed for %s", job_key)
+        if fired:
+            _log.info("node %s disrupted (%s): flagged %d job(s)",
+                      node_name, reason, fired)
+
+    def _affected_jobs(self, node_name: str):
+        """(job_key, owner uid) pairs for jobs with a pod bound to the
+        node, via controller owner refs.  The uid fences the consumer's
+        note against a delete-recreate of the same key."""
+        pairs = []
+        seen = set()
+        for pod in self.cluster.pods.list():
+            if (pod.get("spec") or {}).get("nodeName") != node_name:
+                continue
+            meta = pod.get("metadata") or {}
+            ref = self._controller_ref(meta)
+            if ref is None:
+                continue
+            key = f'{meta.get("namespace", "default")}/{ref.get("name", "")}'
+            if key not in seen:
+                seen.add(key)
+                pairs.append((key, ref.get("uid") or None))
+        return pairs
+
+    def _controller_ref(self, meta: dict) -> Optional[dict]:
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("controller") and ref.get("kind") == self.kind:
+                return ref
+        return None

@@ -214,7 +214,12 @@ class FakeCluster:
 
     Kinds are addressed by their lowercase plural, matching REST paths:
     ``pods``, ``services``, ``events``, ``pytorchjobs``, ``podgroups``,
-    ``endpoints``, ``leases``.
+    ``endpoints``, ``leases``, ``nodes``.
+
+    Nodes are cluster-scoped on a real API server; the fake keeps them
+    in the same namespaced store machinery under the ``default``
+    namespace (every accessor passes ``namespace=None``/``"default"``),
+    which preserves the store interface the informers ride.
     """
 
     KINDS = {
@@ -225,6 +230,7 @@ class FakeCluster:
         "pytorchjobs": "PyTorchJob",
         "podgroups": "PodGroup",
         "leases": "Lease",
+        "nodes": "Node",
     }
 
     def __init__(self):
@@ -273,6 +279,10 @@ class FakeCluster:
     @property
     def podgroups(self) -> FakeResourceStore:
         return self.stores["podgroups"]
+
+    @property
+    def nodes(self) -> FakeResourceStore:
+        return self.stores["nodes"]
 
     # -- owner-reference garbage collection --------------------------------
     def _collect_garbage(self, deleted_owner: dict) -> None:

@@ -7,15 +7,60 @@ Pending -> Running -> Succeeded/Failed on a background thread, so the full
 controller loop (informers, workqueue, status machine, GC) can be
 exercised end-to-end in-process — the e2e driver
 (test/e2e/v1/default/defaults.go) flow without a cluster.
+
+It also plays the node side of the cluster: every pod is bound to a Node
+object (``spec.nodeName``), lazily provisioning fake TPU nodes the way a
+GKE node pool would, and exposes a chaos-injection API
+(:meth:`FakeKubelet.inject_preemption`) that scripts the GCE preemption
+sequence — taint the node with the impending-termination taint, then
+SIGTERM (exit 143) every pod on it after a grace window — so sim/e2e
+tests can drive the disruption subsystem through realistic storms.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
-from .errors import NotFoundError
+from .errors import ApiError, NotFoundError
 from .fake import ADDED, FakeCluster
+
+# GCE/GKE disruption vocabulary — shared with disruption.detector via
+# api/v1/constants so injection and recognition cannot drift.
+from ..api.v1 import constants as _api_constants
+
+IMPENDING_PREEMPTION_TAINT = _api_constants.IMPENDING_NODE_TERMINATION_TAINT
+TPU_RESOURCE = _api_constants.TPU_RESOURCE
+TPU_ACCELERATOR_LABEL = _api_constants.NODE_SELECTOR_TPU_ACCELERATOR
+
+# SIGTERM exit code a preempted container reports.
+SIGTERM_EXIT_CODE = 143
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def new_tpu_node(name: str, tpu_chips: int = 4,
+                 accelerator: str = "tpu-v4-podslice") -> dict:
+    """A Ready TPU node in wire format (what a GKE TPU node pool adds)."""
+    chips = str(tpu_chips)
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {TPU_ACCELERATOR_LABEL: accelerator},
+        },
+        "spec": {},
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True",
+                            "lastTransitionTime": _now_iso()}],
+            "capacity": {TPU_RESOURCE: chips},
+            "allocatable": {TPU_RESOURCE: chips},
+        },
+    }
 
 
 class FakeKubelet:
@@ -30,6 +75,11 @@ class FakeKubelet:
         # logs(pod, phase, exit_code) -> str stored on the pod, readable
         # via the SDK's get_logs (fake.kubelet/logs annotation)
         logs: Optional[Callable[[dict, str, int], str]] = None,
+        # Node-pool shape: None (default) provisions a fresh node per pod
+        # — one worker per TPU VM, the slice topology the disruption
+        # tests rely on (tainting one node hits exactly one replica).
+        # An int N round-robins pods over at most N healthy nodes.
+        max_nodes: Optional[int] = None,
     ):
         self.cluster = cluster
         self.run_delay = run_delay
@@ -39,6 +89,16 @@ class FakeKubelet:
             lambda pod, phase, code:
             f"{pod['metadata']['name']}: {phase} exit={code}\naccuracy=0.9876\n"
         )
+        self.max_nodes = max_nodes
+        self._node_seq = 0
+        self._bind_rr = 0
+        # Node pool bookkeeping: a deleted pod releases its (still
+        # healthy) node for reuse, so long churn runs hold the node
+        # count at ~peak concurrent pods instead of growing one node
+        # per pod ever created — tainted/NotReady nodes are never
+        # reused (a preempted VM is replaced, not recycled).
+        self._node_of_pod: Dict[str, str] = {}
+        self._free_nodes: List[str] = []
         self._timers: Dict[str, threading.Timer] = {}
         self._lock = threading.Lock()
         self._stopped = False
@@ -54,12 +114,178 @@ class FakeKubelet:
             self._timers.clear()
         self.cluster.pods.remove_listener(self._on_pod_event)
 
+    # -- node pool ---------------------------------------------------------
+    def _provision_node(self) -> str:
+        with self._lock:
+            self._node_seq += 1
+            name = f"fake-tpu-node-{self._node_seq}"
+        try:
+            self.cluster.nodes.create("default", new_tpu_node(name))
+        except ApiError:
+            pass  # name collision with a pre-seeded node: reuse it
+        return name
+
+    @staticmethod
+    def _schedulable(node: dict) -> bool:
+        if (node.get("spec") or {}).get("taints"):
+            return False
+        for cond in (node.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    def _pick_node(self) -> str:
+        """A freed healthy node when one exists, else a fresh node
+        (one per live pod — one worker per TPU VM); bounded round-robin
+        over healthy nodes when ``max_nodes`` caps the pool."""
+        if self.max_nodes is None:
+            # never hold self._lock across a cluster-store call: store
+            # listeners run under the cluster lock and re-enter here
+            while True:
+                with self._lock:
+                    candidate = (self._free_nodes.pop()
+                                 if self._free_nodes else None)
+                if candidate is None:
+                    return self._provision_node()
+                try:
+                    node = self.cluster.nodes.get("default", candidate)
+                except NotFoundError:
+                    continue
+                if self._schedulable(node):
+                    return candidate
+        healthy = sorted(
+            n["metadata"]["name"]
+            for n in self.cluster.nodes.list()
+            if self._schedulable(n)
+        )
+        if len(healthy) < self.max_nodes:
+            return self._provision_node()
+        with self._lock:
+            self._bind_rr = (self._bind_rr + 1) % len(healthy)
+            return healthy[self._bind_rr]
+
+    def _bind_pod(self, ns: str, name: str, pod: dict) -> None:
+        if (pod.get("spec") or {}).get("nodeName"):
+            return
+        node = self._pick_node()
+        try:
+            self.cluster.pods.patch(ns, name, {"spec": {"nodeName": node}})
+        except NotFoundError:
+            return
+        with self._lock:
+            self._node_of_pod[f"{ns}/{name}"] = node
+
+    def _release_node(self, ns: str, name: str) -> None:
+        with self._lock:
+            node = self._node_of_pod.pop(f"{ns}/{name}", None)
+        if node is None:
+            return
+        try:
+            healthy = self._schedulable(
+                self.cluster.nodes.get("default", node))
+        except NotFoundError:
+            return
+        if healthy:
+            with self._lock:
+                self._free_nodes.append(node)
+
+    # -- chaos injection ---------------------------------------------------
+    def taint_node(self, name: str, key: str = IMPENDING_PREEMPTION_TAINT,
+                   value: str = "", effect: str = "NoSchedule") -> None:
+        """Append a taint to the node (idempotent per key) — how GCE
+        announces an impending preemption ahead of the actual kill."""
+        node = self.cluster.nodes.get("default", name)
+        taints = (node.get("spec") or {}).get("taints") or []
+        if any(t.get("key") == key for t in taints):
+            return
+        taints = taints + [{"key": key, "value": value, "effect": effect,
+                            "timeAdded": _now_iso()}]
+        self.cluster.nodes.patch("default", name, {"spec": {"taints": taints}})
+
+    def set_node_ready(self, name: str, ready: bool,
+                       reason: str = "") -> None:
+        """Flip the node's Ready condition (NotReady TPU nodes are a
+        disruption signal of their own)."""
+        status = "True" if ready else "False"
+        self.cluster.nodes.patch("default", name, {"status": {"conditions": [
+            {"type": "Ready", "status": status, "reason": reason,
+             "lastTransitionTime": _now_iso()},
+        ]}})
+
+    def pods_on_node(self, name: str) -> List[dict]:
+        return [
+            p for p in self.cluster.pods.list()
+            if (p.get("spec") or {}).get("nodeName") == name
+        ]
+
+    def fail_pod(self, ns: str, name: str,
+                 exit_code: int = SIGTERM_EXIT_CODE) -> None:
+        """Kill one pod: cancel its pending phase timers and mark it
+        Failed with the given exit code (143 = SIGTERM'd by the node)."""
+        with self._lock:
+            for key in (f"{ns}/{name}/run", f"{ns}/{name}/complete"):
+                timer = self._timers.pop(key, None)
+                if timer is not None:
+                    timer.cancel()
+        try:
+            self.cluster.pods.set_status(ns, name, {
+                "phase": "Failed",
+                "reason": "Terminated",
+                "containerStatuses": [
+                    {
+                        "name": "pytorch",
+                        "restartCount": 0,
+                        "state": {"terminated": {"exitCode": exit_code}},
+                    }
+                ],
+            })
+        except NotFoundError:
+            pass
+
+    def inject_preemption(self, node_name: str, taint_delay: float = 0.0,
+                          grace: float = 0.05,
+                          exit_code: int = SIGTERM_EXIT_CODE,
+                          taint_key: str = IMPENDING_PREEMPTION_TAINT) -> None:
+        """Script one node preemption: taint at T+``taint_delay``, then
+        after ``grace`` kill every pod still bound to the node with
+        ``exit_code``.  The window between taint and kill is what the
+        disruption subsystem races — a proactive gang restart inside it
+        replaces N independent failure/backoff cycles."""
+
+        def _kill() -> None:
+            for pod in self.pods_on_node(node_name):
+                meta = pod.get("metadata") or {}
+                self.fail_pod(meta.get("namespace", "default"),
+                              meta.get("name", ""), exit_code)
+
+        def _taint() -> None:
+            try:
+                self.taint_node(node_name, key=taint_key, effect="NoSchedule")
+            except NotFoundError:
+                return
+            self._schedule(f"node/{node_name}/kill", grace, _kill)
+
+        if taint_delay > 0:
+            self._schedule(f"node/{node_name}/taint", taint_delay, _taint)
+        else:
+            _taint()
+
+    def complete_pod_now(self, ns: str, name: str) -> None:
+        """Test hook: run the completion decision for one pod
+        immediately — pods parked Running by a ``decide`` that returned
+        None re-consult the (possibly swapped) decision."""
+        self._complete_pod(ns, name)
+
     # ------------------------------------------------------------------
     def _on_pod_event(self, event_type: str, pod: dict) -> None:
-        if event_type != ADDED:
-            return
         meta = pod.get("metadata", {})
         ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        if event_type == "DELETED":
+            self._release_node(ns, name)
+            return
+        if event_type != ADDED:
+            return
+        self._bind_pod(ns, name, pod)
         self._set_phase(ns, name, "Pending")
         self._schedule(f"{ns}/{name}/run", self.run_delay, self._run_pod, ns, name)
 

@@ -97,6 +97,9 @@ class PodSpec:
     restart_policy: str = ""
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # Bound node (set by the scheduler/kubelet, not the controller) —
+    # the disruption watcher maps node taints back to the pods on them.
+    node_name: str = ""
     host_network: Optional[bool] = None
     volumes: List[dict] = field(default_factory=list)
     tolerations: List[dict] = field(default_factory=list)
@@ -131,10 +134,24 @@ class ContainerStatus:
 
 
 @dataclass
+class PodCondition:
+    """k8s.io/api/core/v1 PodCondition — the subset the disruption
+    detector reads (``DisruptionTarget`` is set by the kubelet/eviction
+    API ahead of a preemption-driven pod kill)."""
+
+    type: str = ""
+    status: str = ""  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
 class PodStatus:
     phase: str = ""
     reason: str = ""
     message: str = ""
+    conditions: List[PodCondition] = field(default_factory=list)
     container_statuses: List[ContainerStatus] = field(default_factory=list)
     init_container_statuses: List[ContainerStatus] = field(default_factory=list)
 
@@ -213,6 +230,52 @@ class PodGroup:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodGroupSpec = field(default_factory=PodGroupSpec)
     status: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Nodes (k8s.io/api/core/v1 Node) — the subset the disruption subsystem
+# consumes: taints (GCE announces impending preemption by tainting the
+# node), Ready conditions, and google.com/tpu capacity.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule | NoExecute
+    time_added: Optional[str] = None
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""  # Ready | MemoryPressure | ...
+    status: str = ""  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class NodeSpec:
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: Optional[bool] = None
+
+
+@dataclass
+class NodeStatus:
+    conditions: List[NodeCondition] = field(default_factory=list)
+    capacity: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    api_version: str = "v1"
+    kind: str = "Node"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
 
 
 def to_dict(obj: Any) -> dict:

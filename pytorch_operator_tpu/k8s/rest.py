@@ -44,10 +44,16 @@ _RESOURCE_PATHS = {
     "services": "/api/v1",
     "events": "/api/v1",
     "endpoints": "/api/v1",
+    "nodes": "/api/v1",
     "pytorchjobs": "/apis/kubeflow.org/v1",
     "leases": "/apis/coordination.k8s.io/v1",
     "podgroups": "/apis/scheduling.incubator.k8s.io/v1alpha1",
 }
+
+# Resources with no namespace segment in their REST paths.  The store
+# interface still accepts a namespace argument (FakeResourceStore
+# compatibility); it is simply dropped when building the URL.
+_CLUSTER_SCOPED = {"nodes"}
 
 
 class KubeConfig:
@@ -339,6 +345,8 @@ class RestResourceStore:
     def _path(self, namespace: Optional[str], name: Optional[str] = None,
               subresource: Optional[str] = None, query: str = "") -> str:
         p = self._prefix
+        if self._plural in _CLUSTER_SCOPED:
+            namespace = None
         if namespace:
             p += f"/namespaces/{namespace}"
         p += f"/{self._plural}"
@@ -571,6 +579,12 @@ class RestCluster:
     @property
     def podgroups(self) -> RestResourceStore:
         return self.resource("podgroups")
+
+    @property
+    def nodes(self) -> RestResourceStore:
+        # Nodes are cluster-scoped: never confined to --namespace (the
+        # store drops the namespace segment from its paths anyway).
+        return self.resource("nodes")
 
     def read_pod_log(self, namespace: str, name: str) -> str:
         """GET .../pods/{name}/log (plain text)."""

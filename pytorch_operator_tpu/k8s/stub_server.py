@@ -339,10 +339,23 @@ def main() -> int:
     parser = argparse.ArgumentParser(description="stub kube-apiserver")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--seed-nodes", type=int, default=0, metavar="N",
+                        help="pre-create N Ready TPU nodes (cluster-scoped "
+                             "/api/v1/nodes), so the dev sandbox can "
+                             "exercise the disruption subsystem: taint one "
+                             "with PATCH to simulate a preemption notice")
     args = parser.parse_args()
     server = StubApiServer(host=args.host, port=args.port)
+    if args.seed_nodes:
+        from .fake_kubelet import new_tpu_node
+
+        for i in range(args.seed_nodes):
+            server.cluster.nodes.create(
+                "default", new_tpu_node(f"stub-tpu-node-{i}"))
     server.start()
-    print(f"stub API server on {args.host}:{server.port}", flush=True)
+    print(f"stub API server on {args.host}:{server.port}"
+          + (f" ({args.seed_nodes} TPU nodes seeded)" if args.seed_nodes
+             else ""), flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -64,6 +64,58 @@ class Gauge(_Metric):
             self._value -= amount
 
 
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (text 0.0.4 ``_bucket``/``_sum``/
+    ``_count`` exposition) — carries the disruption subsystem's
+    restart-latency distribution, which a single counter can't."""
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help_text: str = "", buckets=None):
+        super().__init__(name, help_text, "histogram")
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._bucket_counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            # per-bucket (non-cumulative) storage; exposition cumulates
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    self._bucket_counts[i] += 1
+                    break
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def expose(self) -> str:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.type}",
+            ]
+            cumulative = 0
+            for le, n in zip(self.buckets, self._bucket_counts):
+                cumulative += n
+                lines.append(
+                    f'{self.name}_bucket{{le="{self._format(le)}"}} {cumulative}')
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+            lines.append(f"{self.name}_sum {self._format(self._sum)}")
+            lines.append(f"{self.name}_count {self._count}")
+            return "\n".join(lines) + "\n"
+
+
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
@@ -75,11 +127,19 @@ class Registry:
     def gauge(self, name: str, help_text: str = "") -> Gauge:
         return self._get_or_create(name, help_text, Gauge)
 
-    def _get_or_create(self, name, help_text, cls):
+    def histogram(self, name: str, help_text: str = "",
+                  buckets=None) -> Histogram:
+        return self._get_or_create(
+            name, help_text,
+            lambda n, h: Histogram(n, h, buckets=buckets))
+
+    def _get_or_create(self, name, help_text, factory):
+        """``factory(name, help_text) -> _Metric``; metric classes
+        (Counter, Gauge) qualify directly."""
         with self._lock:
             metric = self._metrics.get(name)
             if metric is None:
-                metric = cls(name, help_text)
+                metric = factory(name, help_text)
                 self._metrics[name] = metric
             return metric
 

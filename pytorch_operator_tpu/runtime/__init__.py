@@ -10,6 +10,9 @@ from .controls import (
     FakeServiceControl,
     PodControl,
     ServiceControl,
+    run_batch,
+    submit_creates_with_expectations,
+    submit_deletes_with_expectations,
 )
 from .expectations import (
     ControllerExpectations,
@@ -35,6 +38,9 @@ __all__ = [
     "ServiceControl",
     "FakePodControl",
     "FakeServiceControl",
+    "run_batch",
+    "submit_creates_with_expectations",
+    "submit_deletes_with_expectations",
     "EventRecorder",
     "FakeRecorder",
     "JobController",

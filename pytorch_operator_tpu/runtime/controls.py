@@ -69,31 +69,32 @@ def _fanout_pool_for(width: int) -> ThreadPoolExecutor:
         return pool
 
 
-def run_create_batch(
-    fn: Callable[[dict], dict], objs: List[dict],
+def run_batch(
+    fn: Callable, items: List,
     width: Optional[int] = None,
-) -> List[Tuple[Optional[dict], Optional[Exception]]]:
-    """Apply ``fn`` to every object, concurrently up to the fan-out width.
+) -> List[Tuple[Optional[object], Optional[Exception]]]:
+    """Apply ``fn`` to every item, concurrently up to the fan-out width.
 
-    Returns ``[(created, None) | (None, error)]`` aligned with ``objs`` —
-    every object is attempted even when earlier ones fail, so the caller
+    Returns ``[(result, None) | (None, error)]`` aligned with ``items`` —
+    every item is attempted even when earlier ones fail, so the caller
     can decrement its expectations exactly once per observed failure.
-    Width 1 (or a single object) stays on the calling thread, preserving
+    Width 1 (or a single item) stays on the calling thread, preserving
     the sequential path byte-for-byte; pass ``width=1`` explicitly for
-    deterministic ordering (the fake controls do).
+    deterministic ordering (the fake controls do).  Shared by the create
+    and delete fan-outs — ``fn`` is any per-item API call.
     """
     if width is None:
         width = create_fanout_width()
-    if width <= 1 or len(objs) <= 1:
-        results: List[Tuple[Optional[dict], Optional[Exception]]] = []
-        for obj in objs:
+    if width <= 1 or len(items) <= 1:
+        results: List[Tuple[Optional[object], Optional[Exception]]] = []
+        for item in items:
             try:
-                results.append((fn(obj), None))
+                results.append((fn(item), None))
             except Exception as e:
                 results.append((None, e))
         return results
     pool = _fanout_pool_for(width)
-    futures = [pool.submit(fn, obj) for obj in objs]
+    futures = [pool.submit(fn, item) for item in items]
     results = []
     for future in futures:
         try:
@@ -101,6 +102,11 @@ def run_create_batch(
         except Exception as e:
             results.append((None, e))
     return results
+
+
+# Historical name (the create path landed first); tests and external
+# callers may still import it.
+run_create_batch = run_batch
 
 
 def submit_creates_with_expectations(
@@ -127,6 +133,34 @@ def submit_creates_with_expectations(
     for _created, err in results:
         if err is not None:
             expectations.creation_observed(key)
+            if first_err is None:
+                first_err = err
+    if first_err is not None:
+        raise first_err
+
+
+def submit_deletes_with_expectations(
+    expectations, key: str, delete_many, namespace: str, names: List[str],
+    controller_obj: dict,
+) -> None:
+    """Mirror of :func:`submit_creates_with_expectations` for the delete
+    side: raise ``expect_deletions`` for the whole batch up-front, fan
+    the deletes out, decrement once per failed delete (successes are
+    observed by the pod/service informer's DELETED callback), and
+    re-raise the first error so the sync requeues and retries only the
+    still-present objects.  A batch-level failure rolls every raised
+    expectation back — the ledger must never outlive the batch."""
+    expectations.expect_deletions(key, len(names))
+    try:
+        results = delete_many(namespace, names, controller_obj)
+    except Exception:
+        for _ in names:
+            expectations.deletion_observed(key)
+        raise
+    first_err: Optional[Exception] = None
+    for _deleted, err in results:
+        if err is not None:
+            expectations.deletion_observed(key)
             if first_err is None:
                 first_err = err
     if first_err is not None:
@@ -198,6 +232,22 @@ class PodControl:
             "Deleted pod: %s", name,
         )
 
+    def delete_many(
+        self, namespace: str, names: List[str], controller_obj: dict,
+    ) -> List[Tuple[Optional[str], Optional[Exception]]]:
+        """Delete a batch of pods with the same bounded fan-out as
+        create_many: per-pod events fire exactly as the sequential path
+        records them and the aligned result list carries one error per
+        failed delete, so expectations roll back per-failure without
+        aborting the rest of the batch (a gang restart deletes every
+        replica in one batch; CleanPodPolicy=All/Running rides it too)."""
+
+        def _one(name: str) -> str:
+            self.delete_pod(namespace, name, controller_obj)
+            return name
+
+        return run_batch(_one, names)
+
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
         return self._pods.patch(namespace, name, patch)
 
@@ -257,6 +307,17 @@ class ServiceControl:
             "Deleted service: %s", name,
         )
 
+    def delete_many(
+        self, namespace: str, names: List[str], controller_obj: dict,
+    ) -> List[Tuple[Optional[str], Optional[Exception]]]:
+        """Bounded-fan-out batch delete; see PodControl.delete_many."""
+
+        def _one(name: str) -> str:
+            self.delete_service(namespace, name, controller_obj)
+            return name
+
+        return run_batch(_one, names)
+
     def patch_service(self, namespace: str, name: str, patch: dict) -> dict:
         return self._services.patch(namespace, name, patch)
 
@@ -275,6 +336,7 @@ class FakePodControl:
         # successes with distinct failures (AlreadyExists vs 500)
         self.create_errors: dict = {}
         self.delete_error: Optional[Exception] = None
+        self.delete_errors: dict = {}
 
     def create_pod_with_controller_ref(self, namespace, pod, controller_obj, controller_ref):
         name = (pod.get("metadata") or {}).get("name")
@@ -300,9 +362,20 @@ class FakePodControl:
             pods, width=1)
 
     def delete_pod(self, namespace, name, controller_obj):
+        if name in self.delete_errors:
+            raise self.delete_errors[name]
         if self.delete_error is not None:
             raise self.delete_error
         self.delete_pod_names.append(name)
+
+    def delete_many(self, namespace, names, controller_obj):
+        """Sequential (width=1) so delete order stays deterministic for
+        asserts; same aligned-results contract as the real control."""
+        def _one(name):
+            self.delete_pod(namespace, name, controller_obj)
+            return name
+
+        return run_batch(_one, names, width=1)
 
     def patch_pod(self, namespace, name, patch):
         self.patches.append(patch)
@@ -318,6 +391,7 @@ class FakeServiceControl:
         self.patches: List[dict] = []
         self.create_error: Optional[Exception] = None
         self.create_errors: dict = {}
+        self.delete_errors: dict = {}
 
     def create_service_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
         name = (service.get("metadata") or {}).get("name")
@@ -339,7 +413,16 @@ class FakeServiceControl:
             services, width=1)
 
     def delete_service(self, namespace, name, controller_obj):
+        if name in self.delete_errors:
+            raise self.delete_errors[name]
         self.delete_service_names.append(name)
+
+    def delete_many(self, namespace, names, controller_obj):
+        def _one(name):
+            self.delete_service(namespace, name, controller_obj)
+            return name
+
+        return run_batch(_one, names, width=1)
 
     def patch_service(self, namespace, name, patch):
         self.patches.append(patch)

@@ -56,10 +56,19 @@ class JobControllerConfig:
         init_container_image: str = "alpine:3.10",
         tpu_auto_gang: bool = True,
         resync_period_seconds: float = 0.0,
+        enable_disruption_handling: bool = False,
+        max_preemption_restarts: int = 3,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
         self.init_container_image = init_container_image
+        # Disruption subsystem (--enable-disruption-handling): watch Node
+        # taints / pod DisruptionTarget conditions and proactively
+        # gang-restart preempted jobs instead of waiting out N per-pod
+        # failure/backoff cycles.  max_preemption_restarts bounds the
+        # proactive restarts per job (annotation-overridable per job).
+        self.enable_disruption_handling = enable_disruption_handling
+        self.max_preemption_restarts = max_preemption_restarts
         # Periodic informer relist-and-diff (reference --resyc-period,
         # options.go:24, default 12h; the job informer additionally resyncs
         # every 30s, informer.go:24).  0 disables (unit-test default);
@@ -110,12 +119,22 @@ class JobController:
         resync = self.config.resync_period_seconds
         self.pod_informer = Informer(cluster.pods, resync_period=resync)
         self.service_informer = Informer(cluster.services, resync_period=resync)
+        # Node informer: only materialized when disruption handling is on
+        # and the cluster backend models Nodes (FakeCluster/RestCluster
+        # both do; bare test doubles may not).  The concrete controller's
+        # disruption watcher registers its handlers on it.
+        self.node_informer: Optional[Informer] = None
+        if self.config.enable_disruption_handling:
+            nodes = getattr(cluster, "nodes", None)
+            if nodes is not None:
+                self.node_informer = Informer(nodes, resync_period=resync)
         self._stop = threading.Event()
 
         self.pod_informer.add_event_handler(
             on_add=self.add_pod, on_update=self.update_pod, on_delete=self.delete_pod
         )
-        self.service_informer.add_event_handler(on_add=self.add_service)
+        self.service_informer.add_event_handler(
+            on_add=self.add_service, on_delete=self.delete_service)
 
     # -- labels / owner refs ----------------------------------------------
     def gen_labels(self, job_name: str) -> Dict[str, str]:
@@ -228,6 +247,24 @@ class JobController:
         if rtype is None:
             return
         self.expectations.creation_observed(expectation_services_key(job_key, rtype))
+        self.enqueue_job(job)
+
+    def delete_service(self, service: dict) -> None:
+        """Observe a service deletion (mirror of delete_pod): the batch
+        delete path raises deletion expectations up-front, so DELETED
+        events must decrement them or the job parks until the TTL."""
+        meta = service.get("metadata", {})
+        ref = _controller_ref_of(meta)
+        if ref is None:
+            return
+        job = self._resolve_controller_ref(meta.get("namespace", ""), ref)
+        if job is None:
+            return
+        job_key = meta_namespace_key(job)
+        rtype = meta.get("labels", {}).get(constants.LABEL_REPLICA_TYPE)
+        if rtype is None:
+            return
+        self.expectations.deletion_observed(expectation_services_key(job_key, rtype))
         self.enqueue_job(job)
 
     # -- list + adopt/orphan (jobcontroller/pod.go:165-241) ----------------

@@ -523,17 +523,19 @@ class PyTorchJobClient:
                  master: bool = True,
                  replica_type: Optional[str] = None,
                  replica_index: Optional[str] = None,
-                 follow: bool = False):
+                 follow: bool = False) -> Dict[str, str]:
         """Fetch pod logs, master-only by default (reference: :357-393).
 
-        With ``follow=False`` returns {pod_name: log_text} and logs each
-        like the reference does.  With ``follow=True`` returns an
-        iterator of ``(pod_name, line)`` tuples streamed live — lines
-        arrive while the pod is still running, and the iterator ends
-        when every selected pod's stream closes.  (The reference passes
-        ``follow`` through to read_namespaced_pod_log, which blocks
-        until the stream ends and returns the accumulated text; this
-        client exposes the same server-side stream incrementally.)
+        Always returns ``{pod_name: log_text}`` — the reference
+        contract (it passes ``follow`` through to
+        read_namespaced_pod_log, which blocks until the stream ends and
+        returns the accumulated text).  ``follow=True`` therefore tails
+        the live server-side streams, logging lines as they arrive, and
+        returns the accumulated text per pod once every stream closes.
+        For incremental consumption use :meth:`stream_logs`, which
+        yields ``(pod_name, line)`` tuples live (ADVICE round 5: the
+        iterator briefly lived here under ``follow=True``, breaking
+        reference-ported callers).
         """
         namespace = namespace or utils.get_default_target_namespace()
         pod_names = self.get_pod_names(
@@ -543,13 +545,48 @@ class PyTorchJobClient:
             raise RuntimeError(
                 f"no pods found for PyTorchJob {namespace}/{name}")
         if follow:
-            return self._follow_logs(pod_names, namespace)
+            acc = {pod: [] for pod in pod_names}
+            for pod, line in self._follow_logs(pod_names, namespace):
+                acc[pod].append(line)
+            # streams closed (pods terminal): one final read returns the
+            # byte-exact text — line reassembly can't know whether the
+            # log ended with a newline, so both modes must share the
+            # same source of truth
+            logs = {}
+            for pod in pod_names:
+                try:
+                    logs[pod] = self._backend.read_pod_log(namespace, pod)
+                except Exception:  # pod GC'd under us: keep the tail
+                    logs[pod] = "".join(f"{line}\n"
+                                        for line in acc[pod])
+            return logs
         logs = {}
         for pod in pod_names:
             text = self._backend.read_pod_log(namespace, pod)
             logs[pod] = text
             logger.info("the logs of Pod %s:\n%s", pod, text)
         return logs
+
+    def stream_logs(self, name: str, namespace: Optional[str] = None,
+                    master: bool = True,
+                    replica_type: Optional[str] = None,
+                    replica_index: Optional[str] = None):
+        """Live log tail: an iterator of ``(pod_name, line)`` tuples.
+
+        Lines arrive while the pods are still running (the follow-mode
+        kubelet stream), interleaved across every selected pod; the
+        iterator ends when all streams close.  This is the incremental
+        sibling of ``get_logs(follow=True)``, which accumulates the same
+        streams into the reference's dict contract.
+        """
+        namespace = namespace or utils.get_default_target_namespace()
+        pod_names = self.get_pod_names(
+            name, namespace=namespace, master=master,
+            replica_type=replica_type, replica_index=replica_index)
+        if not pod_names:
+            raise RuntimeError(
+                f"no pods found for PyTorchJob {namespace}/{name}")
+        return self._follow_logs(pod_names, namespace)
 
     def _follow_logs(self, pod_names: List[str], namespace: str):
         """Generator behind get_logs(follow=True): tail every selected

@@ -1,0 +1,579 @@
+"""Disruption subsystem: detection, policy, delete fan-out, and the
+sim-tier chaos scenario.
+
+The acceptance scenario (ISSUE 2): with disruption handling enabled, a
+tainted-node preemption of 1 of 8 workers produces exactly ONE proactive
+gang restart — a single batched delete, a ``Restarting`` condition with
+reason ``TPUPreempted``, no per-pod backoff cycles, no expectation
+leaks — and the job still reaches ``Succeeded``; with handling disabled
+the legacy per-pod failure path is unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.api.v1.defaults import set_defaults
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.disruption import (
+    DisruptionWatcher,
+    node_disruption_reason,
+    pod_disruption_reason,
+)
+from pytorch_operator_tpu.disruption.detector import (
+    IMPENDING_NODE_TERMINATION_TAINT,
+    NODE_UNREACHABLE_TAINT,
+)
+from pytorch_operator_tpu.k8s.errors import ApiError
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet, new_tpu_node
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import (
+    FakePodControl,
+    FakeServiceControl,
+    Informer,
+    JobControllerConfig,
+)
+from pytorch_operator_tpu.runtime.expectations import (
+    ControllerExpectations,
+    expectation_pods_key,
+    expectation_services_key,
+)
+
+from testutil import job_condition, new_job, wait_for
+
+
+def _mk_node(name="n1", taints=None, ready="True", tpu=True):
+    node = new_tpu_node(name) if tpu else {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {},
+        "status": {"conditions": [{"type": "Ready", "status": ready}]},
+    }
+    if taints:
+        node["spec"]["taints"] = taints
+    if tpu:
+        node["status"]["conditions"] = [{"type": "Ready", "status": ready}]
+    return node
+
+
+class TestDetector:
+    def test_healthy_tpu_node_is_not_disrupted(self):
+        assert node_disruption_reason(_mk_node()) is None
+
+    @pytest.mark.parametrize("key", [
+        IMPENDING_NODE_TERMINATION_TAINT,
+        NODE_UNREACHABLE_TAINT,
+        "node.kubernetes.io/not-ready",
+    ])
+    def test_disruption_taints_detected(self, key):
+        node = _mk_node(taints=[{"key": key, "effect": "NoSchedule"}])
+        assert node_disruption_reason(node) == key
+
+    def test_unrelated_taint_ignored(self):
+        node = _mk_node(taints=[{"key": "example.com/dedicated",
+                                 "effect": "NoSchedule"}])
+        assert node_disruption_reason(node) is None
+
+    def test_not_ready_tpu_node_is_disrupted(self):
+        assert node_disruption_reason(
+            _mk_node(ready="False")) == "TPUNodeNotReady"
+
+    def test_not_ready_cpu_node_is_not_tpu_disruption(self):
+        # only TPU nodes escalate bare NotReady (a flaky CPU node is the
+        # node-lifecycle controller's problem, not a slice preemption)
+        assert node_disruption_reason(
+            _mk_node(ready="False", tpu=False)) is None
+
+    def test_pod_disruption_target_condition(self):
+        pod = {"status": {"conditions": [
+            {"type": "DisruptionTarget", "status": "True",
+             "reason": "PreemptionByScheduler"}]}}
+        assert pod_disruption_reason(pod) == "PreemptionByScheduler"
+        assert pod_disruption_reason({"status": {}}) is None
+        assert pod_disruption_reason({"status": {"conditions": [
+            {"type": "DisruptionTarget", "status": "False"}]}}) is None
+
+
+def _bound_pod(name, job_name, node, rtype="worker", index="0",
+               uid="job-uid"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "labels": {constants.LABEL_REPLICA_TYPE: rtype,
+                       constants.LABEL_REPLICA_INDEX: index},
+            "ownerReferences": [{
+                "apiVersion": constants.API_VERSION, "kind": constants.KIND,
+                "name": job_name, "uid": uid, "controller": True}],
+        },
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "pytorch", "image": "i"}]},
+    }
+
+
+class TestWatcher:
+    def test_fires_once_per_node_transition(self):
+        cluster = FakeCluster()
+        cluster.nodes.create("default", _mk_node("n1"))
+        cluster.pods.create("default", _bound_pod("j-worker-0", "j", "n1"))
+        fired = []
+        informer = Informer(cluster.nodes)
+        DisruptionWatcher(cluster, informer,
+                          lambda key, reason, node, uid=None: fired.append(
+                              (key, reason, node)))
+        informer.start()
+        assert fired == []  # healthy at start
+        taint = [{"key": IMPENDING_NODE_TERMINATION_TAINT,
+                  "effect": "NoSchedule"}]
+        cluster.nodes.patch("default", "n1", {"spec": {"taints": taint}})
+        assert fired == [("default/j", IMPENDING_NODE_TERMINATION_TAINT,
+                          "n1")]
+        # churn on an already-flagged node stays silent
+        cluster.nodes.patch("default", "n1",
+                            {"metadata": {"labels": {"x": "y"}}})
+        assert len(fired) == 1
+        # healthy again re-arms; the next taint fires again
+        cluster.nodes.patch("default", "n1", {"spec": {"taints": None}})
+        cluster.nodes.patch("default", "n1", {"spec": {"taints": taint}})
+        assert len(fired) == 2
+
+    def test_resolves_only_jobs_on_the_node(self):
+        cluster = FakeCluster()
+        cluster.nodes.create("default", _mk_node("n1"))
+        cluster.nodes.create("default", _mk_node("n2"))
+        cluster.pods.create("default",
+                            _bound_pod("a-worker-0", "a", "n1", uid="ua"))
+        cluster.pods.create("default",
+                            _bound_pod("b-worker-0", "b", "n2", uid="ub"))
+        fired = []
+        informer = Informer(cluster.nodes)
+        DisruptionWatcher(cluster, informer,
+                          lambda key, reason, node, uid=None:
+                          fired.append(key))
+        informer.start()
+        cluster.nodes.patch("default", "n2", {"spec": {"taints": [
+            {"key": NODE_UNREACHABLE_TAINT, "effect": "NoExecute"}]}})
+        assert fired == ["default/b"]
+
+
+def _policy_controller(max_restarts=3, enabled=True):
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(enable_disruption_handling=enabled,
+                                   max_preemption_restarts=max_restarts),
+        registry=registry)
+    ctl.pod_control = FakePodControl()
+    ctl.service_control = FakeServiceControl()
+    return cluster, ctl
+
+
+def _gang_job(name="test-pytorchjob", workers=2):
+    job = new_job(workers=workers, name=name, tpu_chips=4)
+    set_defaults(job)
+    return job
+
+
+def _pods_for(job, node="n1"):
+    pods = [_bound_pod(f"{job.metadata.name}-master-0", job.metadata.name,
+                       node, rtype="master", uid=job.metadata.uid)]
+    workers = int(job.spec.pytorch_replica_specs["Worker"].replicas or 0)
+    for i in range(workers):
+        pods.append(_bound_pod(f"{job.metadata.name}-worker-{i}",
+                               job.metadata.name, node, rtype="worker",
+                               index=str(i), uid=job.metadata.uid))
+    return pods
+
+
+class TestHandlerPolicy:
+    def test_gang_restart_batches_all_replicas(self):
+        cluster, ctl = _policy_controller()
+        job = _gang_job()
+        pods = _pods_for(job)
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        assert ctl.preemptions_detected_counter.value == 1
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert sorted(ctl.pod_control.delete_pod_names) == sorted(
+            p["metadata"]["name"] for p in pods)
+        # deletion expectations raised per replica type, none observed yet
+        assert ctl.expectations.get(
+            expectation_pods_key(job.key, "master")).dels == 1
+        assert ctl.expectations.get(
+            expectation_pods_key(job.key, "worker")).dels == 2
+        # budget consumed + condition carries TPUPreempted
+        assert job.status.preemption_restarts == 1
+        conds = {c.type: c for c in job.status.conditions}
+        assert conds[constants.JOB_RESTARTING].reason == \
+            constants.TPU_PREEMPTED_REASON
+        assert ctl.preemption_gang_restarts_counter.value == 1
+        assert ctl.preemption_restart_latency.count == 1
+        # the note was consumed: a second sync does nothing
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is False
+
+    def test_max_restarts_cutoff(self):
+        cluster, ctl = _policy_controller(max_restarts=2)
+        job = _gang_job()
+        job.status.preemption_restarts = 2
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is False
+        assert ctl.pod_control.delete_pod_names == []
+        assert ctl.preemption_restarts_suppressed_counter.value == 1
+        reasons = {e["reason"] for e in cluster.events.list()}
+        assert constants.PREEMPTION_RESTARTS_EXHAUSTED_REASON in reasons
+
+    def test_annotation_budget_override(self):
+        cluster, ctl = _policy_controller(max_restarts=1)
+        job = _gang_job()
+        job.metadata.annotations[
+            constants.ANNOTATION_MAX_PREEMPTION_RESTARTS] = "5"
+        job.status.preemption_restarts = 3
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is True
+        assert job.status.preemption_restarts == 4
+
+    def test_per_job_opt_out(self):
+        cluster, ctl = _policy_controller()
+        job = _gang_job()
+        job.metadata.annotations[constants.ANNOTATION_DISRUPTION_HANDLING] = \
+            constants.DISRUPTION_HANDLING_DISABLED
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is False
+        assert ctl.pod_control.delete_pod_names == []
+
+    def test_non_gang_job_not_gang_restarted(self):
+        cluster, ctl = _policy_controller()
+        job = new_job(workers=2, name="plain-job")  # no TPU request
+        set_defaults(job)
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is False
+        assert ctl.pod_control.delete_pod_names == []
+        assert ctl.preemption_restarts_suppressed_counter.value == 1
+
+    def test_failed_gang_delete_reinserts_note_for_retry(self):
+        """A partial delete failure must not lose the disruption: the
+        note goes back (the watcher's node flag won't re-fire), the
+        budget stays unspent, and the requeued sync retries."""
+        cluster, ctl = _policy_controller()
+        job = _gang_job()
+        pods = _pods_for(job)
+        ctl.pod_control.delete_errors[
+            pods[1]["metadata"]["name"]] = ApiError("transient 500")
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        with pytest.raises(ApiError):
+            ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert not job.status.preemption_restarts
+        assert ctl.preemption_gang_restarts_counter.value == 0
+        # the requeued sync finds the note again and succeeds
+        ctl.pod_control.delete_errors.clear()
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), pods) is True
+        assert job.status.preemption_restarts == 1
+
+    def test_pod_signal_suppressed_while_gang_delete_in_flight(self):
+        """A DisruptionTarget update racing the gang restart's own
+        deletes must not re-note the job (one preemption, one budget
+        unit)."""
+        cluster, ctl = _policy_controller()
+        job = _gang_job()
+        job_dict = job.to_dict()
+        ctl.job_informer.store.add(job_dict)
+        pod = _bound_pod(f"{job.metadata.name}-worker-0",
+                         job.metadata.name, "n1", uid=job.metadata.uid)
+        pod["status"] = {"phase": "Running", "conditions": [
+            {"type": "DisruptionTarget", "status": "True",
+             "reason": "PreemptionByScheduler"}]}
+        ctl.expectations.expect_deletions(
+            expectation_pods_key(job.key, "worker"), 2)
+        ctl.note_pod_disruption(pod)
+        assert ctl.maybe_handle_disruption(
+            job, job_dict, _pods_for(job)) is False  # no note recorded
+        # once the deletes drained, the same signal counts again
+        ctl.expectations.delete_expectations(
+            expectation_pods_key(job.key, "worker"))
+        ctl.note_pod_disruption(pod)
+        assert ctl.maybe_handle_disruption(
+            job, job_dict, _pods_for(job)) is True
+
+    def test_duplicate_signals_coalesce_to_one_note(self):
+        cluster, ctl = _policy_controller()
+        job = _gang_job()
+        ctl._note_disruption(job.key, "taint", "node/n1")
+        ctl._note_disruption(job.key, "DisruptionTarget", "pod/p0")
+        assert ctl.preemptions_detected_counter.value == 1
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is True
+        assert ctl.maybe_handle_disruption(
+            job, job.to_dict(), _pods_for(job)) is False
+
+
+class TestDeleteFanout:
+    def test_pod_control_delete_many_overlaps_requests(self, monkeypatch):
+        """The delete batch must overlap its API calls exactly like the
+        create fan-out: a barrier only opens when all four deletes are
+        in flight at once."""
+        monkeypatch.setenv("PYTORCH_OPERATOR_CREATE_FANOUT", "8")
+        from pytorch_operator_tpu.runtime.controls import PodControl
+        from pytorch_operator_tpu.runtime.recorder import FakeRecorder
+
+        barrier = threading.Barrier(4, timeout=5)
+
+        class SlowPods:
+            def delete(self, namespace, name):
+                barrier.wait()
+
+        control = PodControl(SlowPods(), FakeRecorder())
+        results = control.delete_many(
+            "ns", [f"p-{i}" for i in range(4)], {})
+        assert [err for _, err in results] == [None] * 4
+        assert [name for name, _ in results] == [f"p-{i}" for i in range(4)]
+
+    def test_submit_deletes_decrements_per_failure(self):
+        from pytorch_operator_tpu.runtime.controls import (
+            submit_deletes_with_expectations,
+        )
+
+        e = ControllerExpectations()
+        key = expectation_pods_key("ns/job", "worker")
+        control = FakePodControl()
+        control.delete_errors["p-1"] = ApiError("boom")
+        with pytest.raises(ApiError):
+            submit_deletes_with_expectations(
+                e, key, control.delete_many, "ns",
+                ["p-0", "p-1", "p-2"], {})
+        # 3 raised up-front, 1 rolled back on the failure; the informer
+        # observes the 2 real deletes
+        assert e.get(key).dels == 2
+        assert control.delete_pod_names == ["p-0", "p-2"]
+
+    def test_submit_deletes_rolls_back_all_on_batch_failure(self):
+        from pytorch_operator_tpu.runtime.controls import (
+            submit_deletes_with_expectations,
+        )
+
+        e = ControllerExpectations()
+        key = expectation_pods_key("ns/job", "worker")
+
+        def exploding(namespace, names, controller_obj):
+            raise RuntimeError("pool torn down mid-batch")
+
+        with pytest.raises(RuntimeError):
+            submit_deletes_with_expectations(
+                e, key, exploding, "ns", ["p-0", "p-1"], {})
+        assert e.satisfied(key)
+
+    def test_clean_pod_policy_all_batches_deletes(self):
+        """delete_pods_and_services rides delete_many: one batch per
+        replica type, deletion expectations raised."""
+        cluster, ctl = _policy_controller(enabled=False)
+        job = _gang_job(name="clean-batch")
+        job.spec.clean_pod_policy = constants.CLEAN_POD_POLICY_ALL
+        pods = _pods_for(job)
+        services = [dict(p) for p in pods]  # same labels/names shape
+        ctl.delete_pods_and_services(job, job.to_dict(), pods, services)
+        assert sorted(ctl.pod_control.delete_pod_names) == sorted(
+            p["metadata"]["name"] for p in pods)
+        assert sorted(ctl.service_control.delete_service_names) == sorted(
+            s["metadata"]["name"] for s in services)
+        assert ctl.expectations.get(
+            expectation_pods_key(job.key, "worker")).dels == 2
+        assert ctl.expectations.get(
+            expectation_services_key(job.key, "master")).dels == 1
+
+    def test_clean_pod_policy_running_skips_finished_pods(self):
+        cluster, ctl = _policy_controller(enabled=False)
+        job = _gang_job(name="clean-running")
+        job.spec.clean_pod_policy = constants.CLEAN_POD_POLICY_RUNNING
+        pods = _pods_for(job)
+        pods[0]["status"] = {"phase": "Succeeded"}
+        pods[1]["status"] = {"phase": "Running"}
+        pods[2]["status"] = {"phase": "Failed"}
+        ctl.delete_pods_and_services(job, job.to_dict(), pods, [])
+        assert ctl.pod_control.delete_pod_names == [
+            pods[1]["metadata"]["name"]]
+
+
+class TestHistogram:
+    def test_exposition_format(self):
+        registry = Registry()
+        h = registry.histogram("x_seconds", "help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = h.expose()
+        assert '# TYPE x_seconds histogram' in text
+        assert 'x_seconds_bucket{le="0.1"} 1' in text
+        assert 'x_seconds_bucket{le="1"} 2' in text
+        assert 'x_seconds_bucket{le="+Inf"} 3' in text
+        assert 'x_seconds_count 3' in text
+        assert h.count == 3 and h.sum == pytest.approx(5.55)
+        # rides the registry exposition beside counters/gauges
+        assert 'x_seconds_sum' in registry.expose()
+
+
+# ---------------------------------------------------------------------------
+# Sim tier: the acceptance chaos scenario.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chaos_world():
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(enable_disruption_handling=True),
+        registry=registry)
+    # pods run forever until the test flips the decision
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    yield cluster, ctl, registry, kubelet
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+
+
+def _running_pods(cluster):
+    return [p for p in cluster.pods.list()
+            if (p.get("status") or {}).get("phase") == "Running"]
+
+
+def _finish(cluster, kubelet):
+    """Flip the kubelet to success and nudge pods already parked
+    Running (their one-shot completion timer fired while decide said
+    'keep running')."""
+    kubelet.decide = lambda pod: ("Succeeded", 0)
+    for pod in _running_pods(cluster):
+        kubelet.complete_pod_now("default",
+                                 pod["metadata"]["name"])
+
+
+def test_chaos_one_preempted_worker_one_gang_restart(chaos_world):
+    """ISSUE 2 acceptance: taint one of 8 workers' nodes mid-run ->
+    exactly one proactive gang restart (single batched delete, a
+    TPUPreempted Restarting condition, no expectation leaks) -> the job
+    still reaches Succeeded."""
+    cluster, ctl, registry, kubelet = chaos_world
+    job = new_job(workers=8, name="chaos-job", tpu_chips=4)
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(_running_pods(cluster)) == 9), \
+        [p["status"] for p in cluster.pods.list()]
+    gen1 = {p["metadata"]["uid"] for p in cluster.pods.list()}
+
+    # record every job-status write so the transient Restarting
+    # condition is observable no matter how fast recovery is
+    seen_conditions = []
+    cluster.jobs.add_listener(
+        lambda et, obj: seen_conditions.extend(
+            (obj.get("status") or {}).get("conditions") or []))
+
+    victim = cluster.pods.get("default", "chaos-job-worker-3")
+    node = victim["spec"]["nodeName"]
+    assert node, "fake kubelet did not bind the pod to a node"
+    kubelet.inject_preemption(node, grace=0.5)
+
+    # exactly one proactive gang restart fires
+    assert wait_for(
+        lambda: ctl.preemption_gang_restarts_counter.value == 1)
+    # the whole gang is replaced: 9 fresh pods, all Running again
+    assert wait_for(lambda: (
+        len(_running_pods(cluster)) == 9
+        and not gen1 & {p["metadata"]["uid"] for p in cluster.pods.list()}
+    )), [p["metadata"]["name"] for p in cluster.pods.list()]
+
+    # restart budget consumed and persisted through the status machine
+    assert wait_for(lambda: cluster.jobs.get("default", "chaos-job")
+                    ["status"].get("preemptionRestarts") == 1)
+    # the Restarting condition carried the TPUPreempted reason
+    assert any(c.get("type") == constants.JOB_RESTARTING
+               and c.get("reason") == constants.TPU_PREEMPTED_REASON
+               for c in seen_conditions)
+
+    _finish(cluster, kubelet)
+    assert wait_for(lambda: job_condition(
+        cluster, "default", "chaos-job", constants.JOB_SUCCEEDED)), \
+        cluster.jobs.get("default", "chaos-job")["status"]
+
+    events = cluster.events.list()
+    # one disruption -> one TPUPreempted event, no failure/backoff cycle
+    assert len([e for e in events
+                if e["reason"] == constants.TPU_PREEMPTED_REASON]) == 1
+    assert not [e for e in events if e["reason"] == "PyTorchJobFailed"]
+    # single batched delete: exactly the 9 gang pods, nothing else
+    deletes = [e for e in events if e["reason"] == "SuccessfulDeletePod"]
+    assert len(deletes) == 9
+    # metric: detections attributed once, restart latency recorded
+    assert ctl.preemptions_detected_counter.value == 1
+    assert ctl.preemption_restart_latency.count == 1
+    # no expectation leaks
+    for rtype in ("master", "worker"):
+        assert ctl.expectations.satisfied(
+            expectation_pods_key("default/chaos-job", rtype))
+        assert ctl.expectations.satisfied(
+            expectation_services_key("default/chaos-job", rtype))
+
+
+@pytest.fixture
+def legacy_world():
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=registry)
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    yield cluster, ctl, registry, kubelet
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+
+
+def test_chaos_disabled_legacy_per_pod_path_unchanged(legacy_world):
+    """With --enable-disruption-handling off, a taint changes nothing
+    and a SIGTERM'd worker rides the legacy ExitCode retry: exactly one
+    pod deleted/recreated, no TPUPreempted anywhere, job Succeeds."""
+    cluster, ctl, registry, kubelet = legacy_world
+    assert ctl.node_informer is None and ctl.disruption_watcher is None
+    job = new_job(workers=2, name="legacy-job", tpu_chips=4)
+    job.spec.pytorch_replica_specs["Worker"].restart_policy = \
+        constants.RESTART_POLICY_EXIT_CODE
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(_running_pods(cluster)) == 3)
+
+    victim = cluster.pods.get("default", "legacy-job-worker-1")
+    gen1_uid = victim["metadata"]["uid"]
+    kubelet.taint_node(victim["spec"]["nodeName"])
+    time.sleep(0.3)  # nothing watches nodes: no proactive restart
+    assert ctl.preemption_gang_restarts_counter.value == 0
+    assert len(_running_pods(cluster)) == 3
+
+    # the preemption lands the old way: worker dies with SIGTERM (143)
+    kubelet.fail_pod("default", "legacy-job-worker-1", 143)
+    # legacy ExitCode path: that one pod is deleted and recreated
+    assert wait_for(lambda: (
+        len(_running_pods(cluster)) == 3
+        and cluster.pods.get("default", "legacy-job-worker-1")
+        ["metadata"]["uid"] != gen1_uid))
+    _finish(cluster, kubelet)
+    assert wait_for(lambda: job_condition(
+        cluster, "default", "legacy-job", constants.JOB_SUCCEEDED))
+
+    events = cluster.events.list()
+    assert not [e for e in events
+                if e["reason"] == constants.TPU_PREEMPTED_REASON]
+    deletes = [e for e in events if e["reason"] == "SuccessfulDeletePod"]
+    assert [True for _ in deletes] == [True]  # exactly the one victim
+    status = cluster.jobs.get("default", "legacy-job")["status"]
+    assert not status.get("preemptionRestarts")

@@ -22,7 +22,7 @@ from pytorch_operator_tpu.k8s.stub_server import StubApiServer
 from pytorch_operator_tpu.metrics.prometheus import Registry
 from pytorch_operator_tpu.runtime import JobControllerConfig
 
-from testutil import new_job
+from testutil import job_condition, new_job, wait_for
 
 
 @pytest.fixture
@@ -191,6 +191,43 @@ class TestRestWatch:
         finally:
             scoped.close()
 
+    def test_nodes_cluster_scoped_crud_and_watch(self, rest):
+        """Nodes ride /api/v1/nodes with no namespace segment; taints
+        round-trip through merge-patch and the watch stream sees the
+        transition — the wire the disruption watcher lives on."""
+        from pytorch_operator_tpu.k8s.fake_kubelet import new_tpu_node
+
+        rest.nodes.create("", new_tpu_node("n-0"))
+        got = rest.nodes.get("", "n-0")
+        assert got["status"]["capacity"]["google.com/tpu"] == "4"
+        events = []
+        rest.nodes.add_listener(
+            lambda et, obj: events.append(
+                (et, (obj.get("metadata") or {}).get("name"))))
+        taint = [{"key": "cloud.google.com/impending-node-termination",
+                  "effect": "NoSchedule"}]
+        rest.nodes.patch("", "n-0", {"spec": {"taints": taint}})
+        assert wait_for(lambda: ("MODIFIED", "n-0") in events)
+        assert rest.nodes.get("", "n-0")["spec"]["taints"][0]["key"] == \
+            "cloud.google.com/impending-node-termination"
+        rest.nodes.delete("", "n-0")
+        with pytest.raises(NotFoundError):
+            rest.nodes.get("", "n-0")
+
+    def test_namespaced_cluster_still_serves_nodes(self, stub):
+        """A --namespace-scoped operator must still see cluster-scoped
+        nodes (the namespace is dropped from node paths)."""
+        scoped = RestCluster(KubeConfig("127.0.0.1", stub.port),
+                             namespace="team-a")
+        try:
+            from pytorch_operator_tpu.k8s.fake_kubelet import new_tpu_node
+
+            scoped.nodes.create("", new_tpu_node("n-scoped"))
+            assert [n["metadata"]["name"] for n in scoped.nodes.list()] == \
+                ["n-scoped"]
+        finally:
+            scoped.close()
+
 
 class TestSdkOverHttp:
     def test_sdk_master_url_backend(self, stub):
@@ -217,6 +254,54 @@ class TestSdkOverHttp:
             stop.set()
             ctl.work_queue.shutdown()
             kubelet.stop()
+
+
+class TestDisruptionOverHttp:
+    def test_preemption_gang_restart_over_rest(self, stub):
+        """The disruption subsystem wired through the http tier: node
+        informer rides the REST watch, the taint fires the watcher, and
+        the gang restart's batched deletes cross real sockets."""
+        backing: FakeCluster = stub.cluster
+        kubelet = FakeKubelet(backing, decide=lambda pod: None)
+        kubelet.start()
+        rest = RestCluster(KubeConfig("127.0.0.1", stub.port))
+        ctl = PyTorchController(
+            rest,
+            config=JobControllerConfig(enable_disruption_handling=True),
+            registry=Registry())
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+
+        def running():
+            return [p for p in backing.pods.list()
+                    if (p.get("status") or {}).get("phase") == "Running"]
+
+        try:
+            backing.jobs.create("default", new_job(
+                workers=2, name="http-chaos", tpu_chips=4).to_dict())
+            assert wait_for(lambda: len(running()) == 3)
+            gen1 = {p["metadata"]["uid"] for p in backing.pods.list()}
+            node = backing.pods.get(
+                "default", "http-chaos-worker-0")["spec"]["nodeName"]
+            kubelet.inject_preemption(node, grace=0.5)
+            assert wait_for(
+                lambda: ctl.preemption_gang_restarts_counter.value == 1)
+            assert wait_for(lambda: (
+                len(running()) == 3
+                and not gen1 & {p["metadata"]["uid"]
+                                for p in backing.pods.list()}))
+            kubelet.decide = lambda pod: ("Succeeded", 0)
+            for p in running():
+                kubelet.complete_pod_now("default", p["metadata"]["name"])
+            assert wait_for(lambda: job_condition(
+                backing, "default", "http-chaos", "Succeeded"))
+            status = backing.jobs.get("default", "http-chaos")["status"]
+            assert status.get("preemptionRestarts") == 1
+        finally:
+            stop.set()
+            ctl.work_queue.shutdown()
+            kubelet.stop()
+            rest.close()
 
 
 class TestOperatorOverHttp:

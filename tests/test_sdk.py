@@ -107,9 +107,11 @@ class TestSdkLifecycle:
 
 
 class TestFollowLogs:
-    """get_logs(follow=True) — live tail (round-5 verdict item 3; the
-    reference passes follow through to read_namespaced_pod_log,
-    py_torch_job_client.py:359-386)."""
+    """stream_logs — live tail (round-5 verdict item 3; the reference
+    passes follow through to read_namespaced_pod_log,
+    py_torch_job_client.py:359-386, returning accumulated text —
+    get_logs(follow=True) keeps that dict contract, stream_logs exposes
+    the same streams incrementally)."""
 
     def _mk_running_pod(self, cluster, job, pod_name):
         import time
@@ -158,7 +160,7 @@ class TestFollowLogs:
         t = threading.Thread(target=writer, daemon=True)
         t.start()
         got = []
-        for pod_name, line in client.get_logs("tail-job", follow=True):
+        for pod_name, line in client.stream_logs("tail-job"):
             got.append((time.monotonic(), pod_name, line))
         t.join(timeout=5)
         lines = [l for _, _, l in got]
@@ -189,7 +191,7 @@ class TestFollowLogs:
 
         t = threading.Thread(target=writer, daemon=True)
         t.start()
-        it = client.get_logs("cc-job", master=False, follow=True)
+        it = client.stream_logs("cc-job", master=False)
         pod, line = next(it)
         # the worker's line arrives even though the master is still
         # Running with no output
@@ -220,7 +222,7 @@ class TestFollowLogs:
                                   {"phase": "Succeeded"})
 
         threading.Thread(target=writer, daemon=True).start()
-        lines = [l for _, l in client.get_logs("blank-job", follow=True)]
+        lines = [l for _, l in client.stream_logs("blank-job")]
         assert lines == ["a", "", "b"]
 
     def test_follow_on_terminal_pod_returns_all_and_ends(self, world,
@@ -229,9 +231,30 @@ class TestFollowLogs:
         client.create(job.to_dict())
         client.wait_for_job("tail-done-job", timeout_seconds=15,
                             polling_interval=0.05)
-        got = list(client.get_logs("tail-done-job", follow=True))
+        got = list(client.stream_logs("tail-done-job"))
         assert got, "no lines from a completed pod's follow stream"
         assert any("accuracy=" in line for _, line in got)
+
+    def test_get_logs_follow_returns_dict_contract(self, world, client):
+        """ADVICE round 5: get_logs(follow=True) must keep the reference
+        Dict[pod, text] contract — it accumulates the live stream and
+        returns once the pod terminates (the incremental iterator moved
+        to stream_logs)."""
+        import time
+
+        self._mk_running_pod(world, "dict-job", "dict-job-master-0")
+
+        def writer():
+            time.sleep(0.05)
+            world.pods.patch("default", "dict-job-master-0", {
+                "metadata": {"annotations":
+                             {"fake.kubelet/logs": "x\ny\n"}}})
+            world.pods.set_status("default", "dict-job-master-0",
+                                  {"phase": "Succeeded"})
+
+        threading.Thread(target=writer, daemon=True).start()
+        logs = client.get_logs("dict-job", follow=True)
+        assert logs == {"dict-job-master-0": "x\ny\n"}
 
 
 class TestEmitRowStaleReplay:

@@ -333,13 +333,16 @@ class TestKubeBackendRequestShaping:
                                          "epoch 1\nepoch 2\naccuracy=0.99\n"}},
             "spec": {"containers": [{"name": "pytorch", "image": "i"}]},
         })
-        got = list(client.get_logs("kb-job", namespace="default",
-                                   follow=True))
+        got = list(client.stream_logs("kb-job", namespace="default"))
         assert got == [("kb-job-master-0", "epoch 1"),
                        ("kb-job-master-0", "epoch 2"),
                        ("kb-job-master-0", "accuracy=0.99")]
         op = next(c for c in calls if c[0] == "read_log")
         assert op[3] is True, "follow flag not passed to the package"
+        # and the reference dict contract holds for follow=True
+        logs = client.get_logs("kb-job", namespace="default", follow=True)
+        assert logs == {"kb-job-master-0":
+                        "epoch 1\nepoch 2\naccuracy=0.99\n"}
 
     def test_wait_for_job_reaches_succeeded(self, kube_world):
         cluster, _calls, client = kube_world
