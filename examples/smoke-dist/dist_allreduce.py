@@ -42,6 +42,8 @@ def main() -> int:
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from pytorch_operator_tpu.utils.jax_compat import shard_map
+
     devices = jax.devices()
     n = len(devices)
     print(f"[worker {worker_id}/{world_size}] global devices: {n}", flush=True)
@@ -57,7 +59,7 @@ def main() -> int:
             (idx.astype(jnp.float32) ** 2)[None], "x", perm)
         return total[None], echoed
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=P("x"), out_specs=(P("x"), P("x")))
     totals, echoed = fn(jnp.zeros((n,)))
 

@@ -33,6 +33,7 @@ from pytorch_operator_tpu.k8s.fake import FakeCluster
 from pytorch_operator_tpu.metrics.prometheus import Registry
 from pytorch_operator_tpu.metrics.server import start_metrics_server
 from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime import tracing
 from pytorch_operator_tpu.runtime.leader_election import LeaderElector
 
 logger = logging.getLogger("pytorch-operator")
@@ -132,7 +133,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "pytorch.kubeflow.org/max-preemption-restarts "
                         "annotation)")
     p.add_argument("--monitoring-port", type=int, default=8443,
-                   help="port for the /metrics endpoint (0 = disabled)")
+                   help="port for the /metrics, /debug/traces, /healthz "
+                        "and /readyz endpoints (0 = disabled)")
+    p.add_argument("--trace-buffer-size", type=int, default=256,
+                   help="completed reconcile traces kept in memory and "
+                        "served from /debug/traces (0 keeps none; slow-"
+                        "reconcile logging still fires)")
+    p.add_argument("--slow-reconcile-threshold", default="1s",
+                   help="reconciles slower than this emit one structured "
+                        "warning log line with the per-stage span "
+                        "breakdown (duration string; 0 disables)")
     p.add_argument("--resync-period", "--resyc-period", dest="resync_period",
                    default="12h", help="informer resync period")
     p.add_argument("--init-container-image", default="alpine:3.10",
@@ -168,6 +178,10 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     """
     stop_event = stop_event or threading.Event()
 
+    registry = Registry()
+    is_leader_gauge = registry.gauge(
+        "pytorch_operator_is_leader", "Whether this instance is the leader")
+
     kubelet = None
     if args.fake_cluster:
         cluster = cluster if cluster is not None else FakeCluster()
@@ -192,7 +206,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
                 "no API server configured (%s); pass --master/--kubeconfig "
                 "or run with --fake-cluster", e)
             return 1
-        cluster = RestCluster(kube_config, namespace=args.namespace or None)
+        cluster = RestCluster(kube_config, namespace=args.namespace or None,
+                              registry=registry)
         # checkCRDExists (reference server.go:106-109): fail fast when the
         # CRD isn't installed
         if not cluster.check_crd_exists():
@@ -203,16 +218,6 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         logger.info("connected to API server %s:%d",
                     kube_config.host, kube_config.port)
 
-    registry = Registry()
-    is_leader_gauge = registry.gauge(
-        "pytorch_operator_is_leader", "Whether this instance is the leader")
-
-    metrics_server = None
-    if args.monitoring_port:
-        metrics_server = start_metrics_server(registry, args.monitoring_port)
-        logger.info("metrics on :%d/metrics",
-                    metrics_server.server_address[1])
-
     config = JobControllerConfig(
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
@@ -222,7 +227,43 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         enable_disruption_handling=args.enable_disruption_handling,
         max_preemption_restarts=args.max_preemption_restarts,
     )
-    controller = PyTorchController(cluster, config=config, registry=registry)
+    try:
+        slow_threshold = parse_duration(args.slow_reconcile_threshold)
+    except ValueError as e:
+        logger.error("invalid --slow-reconcile-threshold: %s", e)
+        return 1
+    tracer = tracing.Tracer(
+        buffer_size=args.trace_buffer_size,
+        slow_threshold=slow_threshold if slow_threshold > 0 else None)
+    controller = PyTorchController(cluster, config=config, registry=registry,
+                                   tracer=tracer)
+
+    # /healthz answers while the process is serving and not shutting
+    # down.  /readyz: a LEADING replica is ready once its informer
+    # caches completed their initial LISTs; a standby is ready as soon
+    # as it serves — readiness must NOT require holding the Lease, or a
+    # single-replica RollingUpdate wedges (the surged pod can never
+    # acquire the Lease the old pod keeps renewing, so it never turns
+    # Ready and the old pod is never terminated).  Leader state is still
+    # reported in both payloads and as pytorch_operator_is_leader.
+    leader_state = {"leading": False}
+
+    def healthz():
+        return not stop_event.is_set(), {"leader": leader_state["leading"]}
+
+    def readyz():
+        synced = controller.informers_synced()
+        leading = leader_state["leading"]
+        ok = not stop_event.is_set() and (synced if leading else True)
+        return ok, {"leader": leading, "informers_synced": synced}
+
+    metrics_server = None
+    if args.monitoring_port:
+        metrics_server = start_metrics_server(
+            registry, args.monitoring_port, tracer=tracer,
+            health_checks={"healthz": healthz, "readyz": readyz})
+        logger.info("metrics on :%d/metrics (traces on /debug/traces)",
+                    metrics_server.server_address[1])
 
     if args.fake_cluster_seed_job:
         with open(args.fake_cluster_seed_job) as f:
@@ -233,11 +274,13 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
 
     def on_started_leading():
         is_leader_gauge.set(1)
+        leader_state["leading"] = True
         logger.info("became leader, starting %d workers", args.threadiness)
         controller.run(threadiness=args.threadiness, stop_event=stop_event)
 
     def on_stopped_leading():
         is_leader_gauge.set(0)
+        leader_state["leading"] = False
         logger.warning("lost leadership, shutting down")
         stop_event.set()
 

@@ -27,6 +27,7 @@ from ..runtime.expectations import (
     expectation_pods_key,
     expectation_services_key,
 )
+from ..runtime import tracing
 from ..runtime.informer import Informer, split_meta_namespace_key
 from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
@@ -47,9 +48,18 @@ class PyTorchController(
         config: Optional[JobControllerConfig] = None,
         recorder=None,
         registry=None,
+        tracer=None,
     ):
-        super().__init__(cluster, config, recorder)
+        super().__init__(cluster, config, recorder,
+                         registry=registry or default_registry)
         self.logger = logging.getLogger(constants.CONTROLLER_NAME)
+        # Per-reconcile spans (expectations-check / pod diff / creates /
+        # status patch) land in this tracer's ring buffer; the operator
+        # process serves them from /debug/traces.  The default tracer
+        # keeps a modest ring and never logs slow reconciles — the CLI
+        # passes one configured from --trace-buffer-size /
+        # --slow-reconcile-threshold.
+        self.tracer = tracer or tracing.Tracer()
         # Reference parity: the unstructured job informer resyncs every 30s
         # (informer.go:24), factories every --resyc-period (options.go:24).
         # When resync is disabled (0, the unit-test default) the job
@@ -60,7 +70,9 @@ class PyTorchController(
         # detect expectations raised by a dead incarnation (see sync_job)
         self._synced_uid: dict = {}
         self.job_informer = Informer(cluster.jobs, resync_period=job_resync,
-                                     coalesce=self._coalesce_job_event)
+                                     coalesce=self._coalesce_job_event,
+                                     name="pytorchjobs",
+                                     registry=registry or default_registry)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
         )
@@ -88,6 +100,18 @@ class PyTorchController(
             "pytorch_operator_status_patch_conflicts_total",
             "Counts resourceVersion conflicts (409) hit while patching "
             "job status; each costs one base re-read and retry",
+        )
+        # One sync_job pass, labeled by how it ended: success (forget),
+        # error (requeued with backoff), requeue (retry without an
+        # error, e.g. an unparseable key).  The per-result split is what
+        # makes a hot-looping job visible: its error series climbs while
+        # success stays flat.
+        self.sync_duration = registry.histogram_vec(
+            "pytorch_operator_reconcile_duration_seconds",
+            "Wall time of one sync_job pass, by result",
+            ("result",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0),
         )
         # Disruption subsystem (metrics always registered; the watcher
         # only when --enable-disruption-handling built a node informer).
@@ -178,6 +202,11 @@ class PyTorchController(
         """
         namespace = job.metadata.namespace
         name = job.metadata.name
+        with tracing.span("status-patch", job=f"{namespace}/{name}"):
+            self._patch_job_status(job, namespace, name)
+
+    def _patch_job_status(self, job: PyTorchJob, namespace: str,
+                          name: str) -> None:
         # serialize only .status — this is the hottest write path, and
         # to_dict(job) would re-serde the full pod templates per patch
         new_status = serde.to_dict(job.status)
@@ -229,6 +258,17 @@ class PyTorchController(
         if self.node_informer is not None:
             self.node_informer.start()
 
+    def informers_synced(self) -> bool:
+        """True once every informer completed its initial LIST — the
+        readiness condition /readyz reports (a controller reconciling
+        from an unsynced cache would delete pods it simply hasn't seen
+        yet)."""
+        informers = [self.job_informer, self.pod_informer,
+                     self.service_informer]
+        if self.node_informer is not None:
+            informers.append(self.node_informer)
+        return all(i.has_synced() for i in informers)
+
     def run(self, threadiness: int = 1, stop_event: Optional[threading.Event] = None):
         """controller.go:185-213."""
         stop_event = stop_event or threading.Event()
@@ -253,7 +293,14 @@ class PyTorchController(
         if key is None:
             return True
         try:
-            forget, err = self.sync_job(key)
+            start = time.monotonic()
+            with self.tracer.trace("reconcile", key=key) as tspan:
+                forget, err = self.sync_job(key)
+                result = ("error" if err is not None
+                          else "success" if forget else "requeue")
+                tspan.set_attr("result", result)
+            self.sync_duration.labels(result=result).observe(
+                time.monotonic() - start)
             if err is None and forget:
                 self.work_queue.forget(key)
             elif err is not None:
@@ -315,7 +362,8 @@ class PyTorchController(
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
         self._synced_uid[key] = uid
-        job_needs_sync = self.satisfied_expectations(job)
+        with tracing.span("expectations-check"):
+            job_needs_sync = self.satisfied_expectations(job)
 
         err = None
         if job_needs_sync and not job.metadata.deletion_timestamp:
@@ -352,8 +400,11 @@ class PyTorchController(
         # replica template, so don't re-ask at each branch / created pod
         gang = self.gang_scheduling_enabled(job)
 
-        pods = self.get_pods_for_job(job_dict)
-        services = self.get_services_for_job(job_dict)
+        with tracing.span("pod-diff") as dspan:
+            pods = self.get_pods_for_job(job_dict)
+            services = self.get_services_for_job(job_dict)
+            dspan.set_attr("pods", len(pods))
+            dspan.set_attr("services", len(services))
 
         # Terminal: clean up and freeze status.
         if status_machine.is_succeeded(job.status) or status_machine.is_failed(job.status):

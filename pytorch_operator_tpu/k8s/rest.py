@@ -21,6 +21,7 @@ import os
 import ssl
 import tempfile
 import threading
+import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -317,6 +318,24 @@ class RestClient:
         raise ApiError(f"HTTP {status}: {msg}")
 
 
+class _ObserveOnExit:
+    """Observes elapsed wall time into a histogram on context exit,
+    success or error."""
+
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
 def _selector_query(selector: Optional[Dict[str, str]]) -> str:
     if not selector:
         return ""
@@ -334,6 +353,9 @@ class RestResourceStore:
         self.kind = plural
         self._prefix = _RESOURCE_PATHS.get(plural, "/api/v1")
         self._plural = plural
+        # per-(verb, resource) latency children minted lazily; failures
+        # are timed too (a slow 409 is still a slow round-trip)
+        self._latency: Dict[str, object] = {}
         # namespace-scoped mode: all lists/watches confined to one
         # namespace (operator --namespace flag; required for Role-only RBAC)
         self._namespace = namespace or None
@@ -360,13 +382,28 @@ class RestResourceStore:
 
     # -- CRUD (FakeResourceStore signature) --------------------------------
 
+    def _timed(self, verb: str):
+        """Context manager recording one request's latency under
+        {verb, resource} — the series that answers 'which verb against
+        which resource is slow' without a service mesh.  The one copy of
+        the timing protocol: errors are timed too (a slow 409 is still a
+        slow round-trip)."""
+        child = self._latency.get(verb)
+        if child is None:
+            child = self._cluster.request_latency.labels(
+                verb=verb, resource=self._plural)
+            self._latency[verb] = child
+        return _ObserveOnExit(child)
+
     def create(self, namespace: str, obj: dict) -> dict:
-        return self._client.request(
-            "POST", self._path(namespace or "default"), obj)
+        with self._timed("create"):
+            return self._client.request(
+                "POST", self._path(namespace or "default"), obj)
 
     def get(self, namespace: str, name: str) -> dict:
-        return self._client.request(
-            "GET", self._path(namespace or "default", name))
+        with self._timed("get"):
+            return self._client.request(
+                "GET", self._path(namespace or "default", name))
 
     def list(self, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[dict]:
@@ -374,27 +411,31 @@ class RestResourceStore:
         sel = _selector_query(label_selector)
         if sel:
             q = f"labelSelector={sel}"
-        res = self._client.request(
-            "GET", self._path(namespace or self._namespace, query=q))
+        with self._timed("list"):
+            res = self._client.request(
+                "GET", self._path(namespace or self._namespace, query=q))
         return res.get("items", [])
 
     def update(self, obj: dict, subresource: Optional[str] = None) -> dict:
         meta = obj.get("metadata") or {}
-        return self._client.request(
-            "PUT",
-            self._path(meta.get("namespace", "default"), meta.get("name"),
-                       subresource),
-            obj)
+        with self._timed("update"):
+            return self._client.request(
+                "PUT",
+                self._path(meta.get("namespace", "default"), meta.get("name"),
+                           subresource),
+                obj)
 
     def patch(self, namespace: str, name: str, patch: dict,
               subresource: Optional[str] = None) -> dict:
-        return self._client.request(
-            "PATCH", self._path(namespace or "default", name, subresource),
-            patch, content_type="application/merge-patch+json")
+        with self._timed("patch"):
+            return self._client.request(
+                "PATCH", self._path(namespace or "default", name, subresource),
+                patch, content_type="application/merge-patch+json")
 
     def delete(self, namespace: str, name: str) -> None:
-        self._client.request(
-            "DELETE", self._path(namespace or "default", name))
+        with self._timed("delete"):
+            self._client.request(
+                "DELETE", self._path(namespace or "default", name))
 
     def set_status(self, namespace: str, name: str, status: dict) -> dict:
         return self.patch(namespace, name, {"status": status},
@@ -544,13 +585,26 @@ class RestResourceStore:
 class RestCluster:
     """FakeCluster-shaped facade over a real API server."""
 
-    def __init__(self, config: KubeConfig, namespace: Optional[str] = None):
+    def __init__(self, config: KubeConfig, namespace: Optional[str] = None,
+                 registry=None):
         """``namespace`` scopes every store's lists/watches to one
-        namespace (the operator's --namespace flag); None = cluster-wide."""
+        namespace (the operator's --namespace flag); None = cluster-wide.
+        ``registry`` receives the per-verb/resource request-latency
+        histogram (shared default registry when None)."""
         self.client = RestClient(config)
         self.namespace = namespace or None
         self._stores: Dict[str, RestResourceStore] = {}
         self._lock = threading.Lock()
+        if registry is None:
+            from pytorch_operator_tpu.metrics import default_registry
+            registry = default_registry
+        self.request_latency = registry.histogram_vec(
+            "pytorch_operator_rest_request_duration_seconds",
+            "Kubernetes API request latency, by verb and resource "
+            "(failures timed too; watch streams excluded)",
+            ("verb", "resource"),
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0))
 
     def resource(self, plural: str) -> RestResourceStore:
         with self._lock:

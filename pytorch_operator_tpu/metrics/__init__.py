@@ -1,3 +1,21 @@
-from .prometheus import Counter, Gauge, Registry, default_registry
+from .prometheus import (
+    Counter,
+    CounterVec,
+    Gauge,
+    GaugeVec,
+    Histogram,
+    HistogramVec,
+    Registry,
+    default_registry,
+)
 
-__all__ = ["Counter", "Gauge", "Registry", "default_registry"]
+__all__ = [
+    "Counter",
+    "CounterVec",
+    "Gauge",
+    "GaugeVec",
+    "Histogram",
+    "HistogramVec",
+    "Registry",
+    "default_registry",
+]

@@ -1,16 +1,45 @@
-"""Minimal Prometheus client: counters, gauges, text exposition.
+"""Minimal Prometheus client: counters, gauges, histograms, labeled vecs.
 
 Replaces the reference's promauto/prometheus dependency
 (pkg/controller.v1/pytorch/{controller.go:60-70,job.go:26-33,status.go:47-59}
 and cmd/.../server.go:58-61).  The exposition format follows
 https://prometheus.io/docs/instrumenting/exposition_formats/ (text 0.0.4)
 so the scrape annotations in manifests/service.yaml keep working.
+
+Labeled metrics (``CounterVec``/``GaugeVec``/``HistogramVec``) carry the
+fleet-scale questions single series can't — which verb is slow, which
+queue is deep, which informer is hot: one vec owns the HELP/TYPE header
+(emitted even with zero series, so dashboards can discover the family
+before traffic exists) and hands out per-label-set children via
+``labels()``.  Label values are escaped per the exposition spec
+(``\\`` ``\"`` ``\n``) and series are emitted in a stable order (sorted
+label-value tuples) so scrapes diff cleanly.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (text 0.0.4)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    """Label values escape backslash, double-quote and newline."""
+    return (value.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_suffix(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
+    return "{" + body + "}"
 
 
 class _Metric:
@@ -20,18 +49,24 @@ class _Metric:
         self.type = metric_type
         self._value = 0.0
         self._lock = threading.Lock()
+        # set by a vec when this metric is a labeled child; standalone
+        # metrics expose bare series
+        self._label_pairs: List[Tuple[str, str]] = []
 
     @property
     def value(self) -> float:
         with self._lock:
             return self._value
 
+    def sample_lines(self) -> List[str]:
+        """The metric's series lines, labels included, no HELP/TYPE."""
+        suffix = _label_suffix(self._label_pairs)
+        return [f"{self.name}{suffix} {self._format(self.value)}"]
+
     def expose(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} {self.type}\n"
-            f"{self.name} {self._format(self.value)}\n"
-        )
+        header = (f"# HELP {self.name} {_escape_help(self.help)}\n"
+                  f"# TYPE {self.name} {self.type}\n")
+        return header + "\n".join(self.sample_lines()) + "\n"
 
     @staticmethod
     def _format(v: float) -> str:
@@ -50,6 +85,7 @@ class Counter(_Metric):
 class Gauge(_Metric):
     def __init__(self, name: str, help_text: str = ""):
         super().__init__(name, help_text, "gauge")
+        self._fn: Optional[Callable[[], float]] = None
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -63,11 +99,28 @@ class Gauge(_Metric):
         with self._lock:
             self._value -= amount
 
+    def set_function(self, fn: Optional[Callable[[], float]]) -> None:
+        """Compute the gauge at scrape time (client_golang's GaugeFunc):
+        the value is whatever ``fn()`` returns when the registry exposes
+        — the only honest way to export ''seconds since X'' or ''current
+        queue depth'' without a ticker thread.  ``fn`` runs outside the
+        metric lock and may take its own (e.g. a workqueue reading its
+        length); it must never call back into registry exposition."""
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            return float(fn())
+        with self._lock:
+            return self._value
+
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (text 0.0.4 ``_bucket``/``_sum``/
-    ``_count`` exposition) — carries the disruption subsystem's
-    restart-latency distribution, which a single counter can't."""
+    ``_count`` exposition) — carries the latency distributions
+    (restart, queue, sync, REST) a single counter can't."""
 
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                        1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
@@ -99,26 +152,112 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
-    def expose(self) -> str:
+    def sample_lines(self) -> List[str]:
+        base = list(self._label_pairs)
         with self._lock:
-            lines = [
-                f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} {self.type}",
-            ]
+            lines = []
             cumulative = 0
             for le, n in zip(self.buckets, self._bucket_counts):
                 cumulative += n
-                lines.append(
-                    f'{self.name}_bucket{{le="{self._format(le)}"}} {cumulative}')
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
-            lines.append(f"{self.name}_sum {self._format(self._sum)}")
-            lines.append(f"{self.name}_count {self._count}")
-            return "\n".join(lines) + "\n"
+                suffix = _label_suffix(base + [("le", self._format(le))])
+                lines.append(f"{self.name}_bucket{suffix} {cumulative}")
+            suffix = _label_suffix(base + [("le", "+Inf")])
+            lines.append(f"{self.name}_bucket{suffix} {self._count}")
+            plain = _label_suffix(base)
+            lines.append(f"{self.name}_sum{plain} {self._format(self._sum)}")
+            lines.append(f"{self.name}_count{plain} {self._count}")
+            return lines
+
+
+class _MetricVec:
+    """A named family of label-distinguished children.
+
+    ``labels(...)`` is the only way to mint a series; it is idempotent
+    and thread-safe (concurrent callers for the same label set get the
+    same child).  Exposition emits HELP/TYPE exactly once — including
+    for a vec with zero series — then every child's samples sorted by
+    label-value tuple, so series order is deterministic scrape-to-scrape.
+    """
+
+    def __init__(self, name: str, help_text: str, metric_type: str,
+                 label_names: Sequence[str],
+                 child_factory: Callable[[], _Metric]):
+        if not label_names:
+            raise ValueError(f"{name}: a vec needs at least one label")
+        self.name = name
+        self.help = help_text
+        self.type = metric_type
+        self.label_names = tuple(label_names)
+        self._child_factory = child_factory
+        self._children: Dict[Tuple[str, ...], _Metric] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values, **kw) -> _Metric:
+        if kw:
+            if values:
+                raise ValueError(
+                    f"{self.name}: pass labels positionally or by name, "
+                    f"not both")
+            try:
+                values = tuple(kw.pop(n) for n in self.label_names)
+            except KeyError as e:
+                raise ValueError(
+                    f"{self.name}: missing label {e.args[0]!r}") from None
+            if kw:
+                raise ValueError(
+                    f"{self.name}: unknown label(s) {sorted(kw)}")
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label "
+                f"value(s) {self.label_names}, got {len(key)}")
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child_factory()
+                child._label_pairs = list(zip(self.label_names, key))
+                self._children[key] = child
+            return child
+
+    def series(self) -> Dict[Tuple[str, ...], _Metric]:
+        with self._lock:
+            return dict(self._children)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for _, child in children:
+            lines.extend(child.sample_lines())
+        return "\n".join(lines) + "\n"
+
+
+class CounterVec(_MetricVec):
+    def __init__(self, name: str, help_text: str = "",
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, help_text, "counter", label_names,
+                         lambda: Counter(name, help_text))
+
+
+class GaugeVec(_MetricVec):
+    def __init__(self, name: str, help_text: str = "",
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, help_text, "gauge", label_names,
+                         lambda: Gauge(name, help_text))
+
+
+class HistogramVec(_MetricVec):
+    def __init__(self, name: str, help_text: str = "",
+                 label_names: Sequence[str] = (), buckets=None):
+        super().__init__(
+            name, help_text, "histogram", label_names,
+            lambda: Histogram(name, help_text, buckets=buckets))
 
 
 class Registry:
     def __init__(self):
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_text: str = "") -> Counter:
@@ -133,8 +272,25 @@ class Registry:
             name, help_text,
             lambda n, h: Histogram(n, h, buckets=buckets))
 
+    def counter_vec(self, name: str, help_text: str = "",
+                    label_names: Sequence[str] = ()) -> CounterVec:
+        return self._get_or_create(
+            name, help_text, lambda n, h: CounterVec(n, h, label_names))
+
+    def gauge_vec(self, name: str, help_text: str = "",
+                  label_names: Sequence[str] = ()) -> GaugeVec:
+        return self._get_or_create(
+            name, help_text, lambda n, h: GaugeVec(n, h, label_names))
+
+    def histogram_vec(self, name: str, help_text: str = "",
+                      label_names: Sequence[str] = (),
+                      buckets=None) -> HistogramVec:
+        return self._get_or_create(
+            name, help_text,
+            lambda n, h: HistogramVec(n, h, label_names, buckets=buckets))
+
     def _get_or_create(self, name, help_text, factory):
-        """``factory(name, help_text) -> _Metric``; metric classes
+        """``factory(name, help_text) -> metric or vec``; metric classes
         (Counter, Gauge) qualify directly."""
         with self._lock:
             metric = self._metrics.get(name)
@@ -145,7 +301,8 @@ class Registry:
 
     def expose(self) -> str:
         with self._lock:
-            metrics: List[_Metric] = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics: List = sorted(self._metrics.values(),
+                                   key=lambda m: m.name)
         return "".join(m.expose() for m in metrics)
 
 

@@ -1,32 +1,96 @@
-"""HTTP /metrics endpoint (the reference's startMonitoring,
-cmd/pytorch-operator.v1/main.go:31-40, promhttp on --monitoring-port)."""
+"""Operator HTTP surface: /metrics, /debug/traces, /healthz, /readyz.
+
+/metrics is the reference's startMonitoring
+(cmd/pytorch-operator.v1/main.go:31-40, promhttp on --monitoring-port).
+The rest is the observability layer's debug/ops surface:
+
+  * ``/debug/traces`` — the tracer's ring of completed reconcile traces
+    as JSON, newest first (``?limit=N`` truncates); 404 when the process
+    was started without a tracer.
+  * ``/healthz`` — liveness; 200 while the process serves, 503 once the
+    registered check fails (e.g. shutdown began).
+  * ``/readyz`` — readiness; reflects informer sync and leader state
+    through the registered check, so a replica that holds no lease (or
+    has not finished its initial LISTs) reports 503 and stays out of
+    rotation.
+
+Checks are callables returning ``(ok, detail_dict)``; endpoints without
+a registered check return 200 with ``{"status": "ok"}`` (bare liveness:
+answering IS the signal).
+"""
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
 
 from pytorch_operator_tpu.metrics.prometheus import Registry
 
+HealthCheck = Callable[[], Tuple[bool, dict]]
 
-def start_metrics_server(registry: Registry, port: int,
-                         host: str = "0.0.0.0") -> ThreadingHTTPServer:
-    """Serve text-format metrics on /metrics in a daemon thread.
+
+def start_metrics_server(
+    registry: Registry,
+    port: int,
+    host: str = "0.0.0.0",
+    tracer=None,
+    health_checks: Optional[Dict[str, HealthCheck]] = None,
+) -> ThreadingHTTPServer:
+    """Serve the operator HTTP surface in a daemon thread.
 
     Returns the server (use .shutdown() to stop); picks a free port when
-    ``port`` is 0 (server.server_address[1] tells which).
+    ``port`` is 0 (server.server_address[1] tells which).  ``tracer``
+    enables /debug/traces; ``health_checks`` maps ``"healthz"`` /
+    ``"readyz"`` to ``() -> (ok, detail)`` callables.
     """
 
     class Handler(BaseHTTPRequestHandler):
+        def _send(self, status: int, body: bytes, content_type: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, status: int, payload) -> None:
+            self._send(status, json.dumps(payload, indent=1).encode(),
+                       "application/json; charset=utf-8")
+
         def do_GET(self):
-            if self.path.rstrip("/") in ("", "/metrics"):
-                body = registry.expose().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            url = urllib.parse.urlparse(self.path)
+            path = url.path.rstrip("/")
+            if path in ("", "/metrics"):
+                self._send(
+                    200, registry.expose().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/debug/traces":
+                if tracer is None:
+                    self._send_json(404, {"error": "tracing not enabled"})
+                    return
+                limit = None
+                try:
+                    q = urllib.parse.parse_qs(url.query)
+                    if "limit" in q:
+                        limit = max(0, int(q["limit"][0]))
+                except ValueError:
+                    self._send_json(400, {"error": "limit must be an int"})
+                    return
+                self._send_json(200, {"traces": tracer.snapshot(limit)})
+            elif path in ("/healthz", "/readyz"):
+                check = (health_checks or {}).get(path.lstrip("/"))
+                if check is None:
+                    ok, detail = True, {}
+                else:
+                    try:
+                        ok, detail = check()
+                    except Exception as e:  # a broken check is unhealthy
+                        ok, detail = False, {"error": repr(e)}
+                payload = {"status": "ok" if ok else "unavailable"}
+                payload.update(detail)
+                self._send_json(200 if ok else 503, payload)
             else:
                 self.send_response(404)
                 self.end_headers()

@@ -234,10 +234,24 @@ class NativeWorkQueue:
             raise RuntimeError(f"native library unavailable: {_load_error}")
         self._lib = lib
         self._q = lib.wq_new(base_delay, max_delay)
+        self._metrics = None
+
+    def set_metrics(self, metrics) -> None:
+        """Attach a runtime.workqueue.WorkQueueMetrics.  Queue state
+        stays in C++ — depth is read live through ``wq_len`` at scrape
+        time — while add/get/done timestamps are stamped at this
+        wrapper, the last point the items cross the FFI.  Retry items
+        (``add_rate_limited``) and delayed timers surface via the retry
+        counter and depth only; their queue-duration sample is skipped
+        because the drain happens inside the C++ delaying heap."""
+        self._metrics = metrics
+        metrics.set_depth_function(self.__len__)
 
     def add(self, item: str) -> None:
         q = self._q
         if q:
+            if self._metrics is not None and not self.is_dirty(item):
+                self._metrics.on_add(item)
             self._lib.wq_add(q, item.encode())
 
     def add_after(self, item: str, delay: float) -> None:
@@ -248,6 +262,8 @@ class NativeWorkQueue:
     def add_rate_limited(self, item: str) -> None:
         q = self._q
         if q:
+            if self._metrics is not None:
+                self._metrics.on_retry(item)
             self._lib.wq_add_rate_limited(q, item.encode())
 
     def get(self, timeout: Optional[float] = None) -> Tuple[Optional[str], bool]:
@@ -263,7 +279,10 @@ class NativeWorkQueue:
             buf = ctypes.create_string_buffer(buflen)
             rc = self._lib.wq_get(q, t, buf, buflen)
             if rc == 1:
-                return buf.value.decode(), False
+                item = buf.value.decode()
+                if self._metrics is not None:
+                    self._metrics.on_get(item)
+                return item, False
             if rc == -1:
                 return None, True
             if rc == -2:
@@ -274,6 +293,8 @@ class NativeWorkQueue:
     def done(self, item: str) -> None:
         q = self._q
         if q:
+            if self._metrics is not None:
+                self._metrics.on_done(item)
             self._lib.wq_done(q, item.encode())
 
     def forget(self, item: str) -> None:

@@ -189,6 +189,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
+    from pytorch_operator_tpu.utils.jax_compat import tpu_compiler_params
+
     BH, T, D = q.shape
     group = BH // k.shape[0]
     grid = (BH, T // block_q, T // block_k)
@@ -223,7 +225,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
         name="flash_fwd",
@@ -438,6 +440,8 @@ def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
+    from pytorch_operator_tpu.utils.jax_compat import tpu_compiler_params
+
     BH, T, D = q.shape
     group = BH // k.shape[0]
     n_q, n_k = T // block_q, T // block_k
@@ -471,7 +475,7 @@ def _flash_bwd_fused(q, k, v, g, lse, delta, scale, causal,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
         name="flash_bwd_fused",
@@ -493,6 +497,8 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     """
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
+
+    from pytorch_operator_tpu.utils.jax_compat import tpu_compiler_params
 
     BH, T, D = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -522,7 +528,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
         name="flash_bwd_dq",
@@ -555,7 +561,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
         name="flash_bwd_dkv",

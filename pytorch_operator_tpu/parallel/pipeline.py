@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from pytorch_operator_tpu.utils.jax_compat import pvary, shard_map
+
 AXIS_PP = "pp"
 
 
@@ -52,8 +54,8 @@ def _pipeline_body(params_local, x_mb, *, stage_fn, axis_name):
 
     state0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
-    state0 = lax.pvary(state0, axis_name)
-    out0 = lax.pvary(out0, axis_name)
+    state0 = pvary(state0, axis_name)
+    out0 = pvary(out0, axis_name)
 
     def step(t, carry):
         state, outs = carry
@@ -111,7 +113,7 @@ def pipeline_apply(
             params_stacked,
         )
 
-    out_mb = jax.shard_map(
+    out_mb = shard_map(
         partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(params_spec, P()),
@@ -313,7 +315,7 @@ def pipeline_value_and_grad(
         )
     extra_spec = jax.tree.map(lambda _: P(), extra)
 
-    return jax.shard_map(
+    return shard_map(
         partial(_1f1b_body, first_fn=first_fn, stage_fn=stage_fn,
                 last_fn=last_fn, axis_name=axis_name,
                 n_stages=mesh.shape[axis_name]),

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from pytorch_operator_tpu.utils.jax_compat import pvary, shard_map
+
 NEG_INF = -1e30
 
 
@@ -144,9 +146,9 @@ def _acc_merge(acc, parts):
 def _acc_zero(B, H, T, Dh, axis_name):
     """Fresh (o, m, l) accumulator; pvary marks the constants
     device-varying so shard_map fori_loop carry types match."""
-    o = lax.pvary(jnp.zeros((B, H, T, Dh), jnp.float32), axis_name)
-    m = lax.pvary(jnp.full((B, H, T), NEG_INF, jnp.float32), axis_name)
-    l = lax.pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    o = pvary(jnp.zeros((B, H, T, Dh), jnp.float32), axis_name)
+    m = pvary(jnp.full((B, H, T), NEG_INF, jnp.float32), axis_name)
+    l = pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
     return o, m, l
 
 
@@ -328,7 +330,7 @@ def ring_attention(
             raise ValueError(f"layout={layout!r} exists to balance "
                              f"CAUSAL ring load; use the default layout "
                              f"for non-causal attention")
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(_ring_body_zigzag, axis_name=axis_name,
                     scale=Dh ** -0.5,
                     block=_exact_block(t_local // 2, Dh),
@@ -347,7 +349,7 @@ def ring_attention(
         return out[:, inv]
     if layout != "contiguous":
         raise ValueError(f"unknown ring layout {layout!r}")
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             _ring_body, axis_name=axis_name, causal=causal,
             scale=Dh ** -0.5, block=_exact_block(t_local, Dh),

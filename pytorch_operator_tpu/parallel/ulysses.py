@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from pytorch_operator_tpu.utils.jax_compat import shard_map
+
 
 def _local_attention(q, k, v, scale, causal, use_flash):
     """Plain full-sequence attention on the local head slice.
@@ -122,7 +124,7 @@ def ulysses_attention(
     # sharded over (the SP×FSDP composition); the all-to-alls move only
     # the ``axis_name`` shards, batch stays embarrassingly parallel
     spec = P(batch_axes or None, axis_name, head_axes or None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ulysses_body, axis_name=axis_name, causal=causal,
                 scale=Dh ** -0.5, use_flash=use_flash),
         mesh=mesh,

@@ -13,12 +13,14 @@ from __future__ import annotations
 import copy
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Tuple
 
 from ..k8s import serde
 from ..k8s.errors import ApiError
 from ..k8s.objects import OwnerReference, Pod, Service
+from . import tracing
 from .recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 
 SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
@@ -94,7 +96,17 @@ def run_batch(
                 results.append((None, e))
         return results
     pool = _fanout_pool_for(width)
-    futures = [pool.submit(fn, item) for item in items]
+    # The submitting sync's trace span is thread-local, which does not
+    # cross pool.submit on its own — capture it here and bind it in the
+    # workers so per-item create/delete spans parent under the reconcile
+    # that issued the batch.
+    parent_span = tracing.current_span()
+
+    def _traced(item):
+        with tracing.bind_parent(parent_span):
+            return fn(item)
+
+    futures = [pool.submit(_traced, item) for item in items]
     results = []
     for future in futures:
         try:
@@ -107,6 +119,26 @@ def run_batch(
 # Historical name (the create path landed first); tests and external
 # callers may still import it.
 run_create_batch = run_batch
+
+#: the fan-out overlaps sub-100ms API calls; finer buckets than the
+#: default histogram resolve where the batch time actually goes
+BATCH_DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                          0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _batch_histograms(registry, kind: str):
+    """(create, delete) batch-latency histogram children for one object
+    kind on ``registry`` (shared default when None)."""
+    if registry is None:
+        from ..metrics import default_registry
+        registry = default_registry
+    vec = registry.histogram_vec(
+        "pytorch_operator_batch_duration_seconds",
+        "Wall time of one bounded fan-out batch (create_many/"
+        "delete_many), by object kind and operation",
+        ("kind", "op"), buckets=BATCH_DURATION_BUCKETS)
+    return (vec.labels(kind=kind, op="create"),
+            vec.labels(kind=kind, op="delete"))
 
 
 def submit_creates_with_expectations(
@@ -124,7 +156,9 @@ def submit_creates_with_expectations(
     """
     expectations.expect_creations(key, len(objs))
     try:
-        results = create_many(namespace, objs, controller_obj, controller_ref)
+        with tracing.span("creates", key=key, count=len(objs)):
+            results = create_many(namespace, objs, controller_obj,
+                                  controller_ref)
     except Exception:
         for _ in objs:
             expectations.creation_observed(key)
@@ -152,7 +186,8 @@ def submit_deletes_with_expectations(
     expectation back — the ledger must never outlive the batch."""
     expectations.expect_deletions(key, len(names))
     try:
-        results = delete_many(namespace, names, controller_obj)
+        with tracing.span("deletes", key=key, count=len(names)):
+            results = delete_many(namespace, names, controller_obj)
     except Exception:
         for _ in names:
             expectations.deletion_observed(key)
@@ -168,9 +203,11 @@ def submit_deletes_with_expectations(
 
 
 class PodControl:
-    def __init__(self, pods_client, recorder):
+    def __init__(self, pods_client, recorder, registry=None):
         self._pods = pods_client
         self._recorder = recorder
+        self._create_batch_hist, self._delete_batch_hist = (
+            _batch_histograms(registry, "pod"))
 
     def create_pod_with_controller_ref(
         self, namespace: str, pod: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -180,7 +217,8 @@ class PodControl:
         refs = meta.setdefault("ownerReferences", [])
         refs.append(_owner_ref_dict(controller_ref))
         try:
-            created = self._pods.create(namespace, pod)
+            with tracing.span("create-pod", pod=meta.get("name", "")):
+                created = self._pods.create(namespace, pod)
         except ApiError as e:
             self._recorder.eventf(
                 controller_obj,
@@ -211,16 +249,21 @@ class PodControl:
         sequential path records them; the aligned result list carries one
         error per failed create so expectations can be rolled back
         per-failure without aborting the rest of the batch."""
-        return run_create_batch(
-            lambda pod: self.create_pod_with_controller_ref(
-                namespace, pod, controller_obj, controller_ref
-            ),
-            pods,
-        )
+        t0 = time.perf_counter()
+        try:
+            return run_create_batch(
+                lambda pod: self.create_pod_with_controller_ref(
+                    namespace, pod, controller_obj, controller_ref
+                ),
+                pods,
+            )
+        finally:
+            self._create_batch_hist.observe(time.perf_counter() - t0)
 
     def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
-            self._pods.delete(namespace, name)
+            with tracing.span("delete-pod", pod=name):
+                self._pods.delete(namespace, name)
         except ApiError as e:
             self._recorder.eventf(
                 controller_obj, EVENT_TYPE_WARNING, FAILED_DELETE_POD_REASON,
@@ -246,16 +289,22 @@ class PodControl:
             self.delete_pod(namespace, name, controller_obj)
             return name
 
-        return run_batch(_one, names)
+        t0 = time.perf_counter()
+        try:
+            return run_batch(_one, names)
+        finally:
+            self._delete_batch_hist.observe(time.perf_counter() - t0)
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
         return self._pods.patch(namespace, name, patch)
 
 
 class ServiceControl:
-    def __init__(self, services_client, recorder):
+    def __init__(self, services_client, recorder, registry=None):
         self._services = services_client
         self._recorder = recorder
+        self._create_batch_hist, self._delete_batch_hist = (
+            _batch_histograms(registry, "service"))
 
     def create_service_with_controller_ref(
         self, namespace: str, service: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -265,7 +314,8 @@ class ServiceControl:
         refs = meta.setdefault("ownerReferences", [])
         refs.append(_owner_ref_dict(controller_ref))
         try:
-            created = self._services.create(namespace, service)
+            with tracing.span("create-service", service=meta.get("name", "")):
+                created = self._services.create(namespace, service)
         except ApiError as e:
             self._recorder.eventf(
                 controller_obj, EVENT_TYPE_WARNING, FAILED_CREATE_SERVICE_REASON,
@@ -286,16 +336,21 @@ class ServiceControl:
         controller_ref: OwnerReference,
     ) -> List[Tuple[Optional[dict], Optional[Exception]]]:
         """Bounded-fan-out batch create; see PodControl.create_many."""
-        return run_create_batch(
-            lambda service: self.create_service_with_controller_ref(
-                namespace, service, controller_obj, controller_ref
-            ),
-            services,
-        )
+        t0 = time.perf_counter()
+        try:
+            return run_create_batch(
+                lambda service: self.create_service_with_controller_ref(
+                    namespace, service, controller_obj, controller_ref
+                ),
+                services,
+            )
+        finally:
+            self._create_batch_hist.observe(time.perf_counter() - t0)
 
     def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
-            self._services.delete(namespace, name)
+            with tracing.span("delete-service", service=name):
+                self._services.delete(namespace, name)
         except ApiError as e:
             self._recorder.eventf(
                 controller_obj, EVENT_TYPE_WARNING, FAILED_DELETE_SERVICE_REASON,
@@ -316,7 +371,11 @@ class ServiceControl:
             self.delete_service(namespace, name, controller_obj)
             return name
 
-        return run_batch(_one, names)
+        t0 = time.perf_counter()
+        try:
+            return run_batch(_one, names)
+        finally:
+            self._delete_batch_hist.observe(time.perf_counter() - t0)
 
     def patch_service(self, namespace: str, name: str, patch: dict) -> dict:
         return self._services.patch(namespace, name, patch)

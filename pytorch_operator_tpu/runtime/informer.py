@@ -19,9 +19,49 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 _log = logging.getLogger(__name__)
+
+
+class InformerMetrics:
+    """Per-informer series answering "which informer is hot / stale":
+    events delivered to handlers by type, MODIFIED bursts absorbed by
+    coalescing (delivered vs coalesced is the coalescer's win rate),
+    completed resyncs, and two scrape-time gauges — seconds since the
+    last live watch event (watch lag; -1 before the first event) and
+    the store's object count."""
+
+    def __init__(self, registry, name: str, informer: "Informer"):
+        events = registry.counter_vec(
+            "pytorch_operator_informer_events_total",
+            "Watch/list/resync events delivered to handlers, by informer "
+            "and event type",
+            ("informer", "type"))
+        self.added = events.labels(informer=name, type="added")
+        self.modified = events.labels(informer=name, type="modified")
+        self.deleted = events.labels(informer=name, type="deleted")
+        self.coalesced = registry.counter_vec(
+            "pytorch_operator_informer_events_coalesced_total",
+            "MODIFIED events absorbed by burst coalescing (store updated, "
+            "handler dispatch skipped)",
+            ("informer",)).labels(informer=name)
+        self.resyncs = registry.counter_vec(
+            "pytorch_operator_informer_resyncs_total",
+            "Completed relist-and-diff resyncs",
+            ("informer",)).labels(informer=name)
+        watch_lag = registry.gauge_vec(
+            "pytorch_operator_informer_watch_lag_seconds",
+            "Seconds since the informer last observed a live watch event "
+            "(-1 before the first)",
+            ("informer",)).labels(informer=name)
+        watch_lag.set_function(informer._seconds_since_last_event)
+        store_objects = registry.gauge_vec(
+            "pytorch_operator_informer_store_objects",
+            "Objects currently held in the informer's local store",
+            ("informer",)).labels(informer=name)
+        store_objects.set_function(lambda: len(informer.store.keys()))
 
 
 def meta_namespace_key(obj: dict) -> str:
@@ -104,9 +144,21 @@ class Informer:
     handlers, matching client-go resync semantics (this is what gives the
     reference its periodic reconcile, controller.go:129)."""
 
-    def __init__(self, source, resync_period: float = 0.0, coalesce=None):
+    def __init__(self, source, resync_period: float = 0.0, coalesce=None,
+                 name: Optional[str] = None, registry=None):
         self._source = source
         self.store = _make_store()
+        # ``name`` opts into per-informer metrics (events by type,
+        # coalesced count, resyncs, watch lag, store size) on
+        # ``registry`` (the shared default when None) — unnamed
+        # informers (ad-hoc test doubles) stay unmetered.
+        self._metrics: Optional[InformerMetrics] = None
+        self._last_event_mono: Optional[float] = None
+        if name:
+            if registry is None:
+                from pytorch_operator_tpu.metrics import default_registry
+                registry = default_registry
+            self._metrics = InformerMetrics(registry, name, self)
         # ``coalesce(key, old, new) -> bool``: burst coalescing for
         # MODIFIED events (live and resync-synthesized).  When it returns
         # True the store is still updated but the update handlers are NOT
@@ -173,6 +225,8 @@ class Informer:
             if self.store.contains(meta_namespace_key(obj)):
                 continue
             self.store.add(obj)
+            if self._metrics is not None:
+                self._metrics.added.inc()
             for fn in self._handlers.add_funcs:
                 fn(obj)
         self._synced = True
@@ -190,6 +244,12 @@ class Informer:
 
     def has_synced(self) -> bool:
         return self._synced
+
+    def _seconds_since_last_event(self) -> float:
+        last = self._last_event_mono
+        if last is None:
+            return -1.0
+        return round(time.monotonic() - last, 6)
 
     # -- resync ------------------------------------------------------------
     def _resync_loop(self) -> None:
@@ -233,21 +293,31 @@ class Informer:
                     cur = self.store.get_by_key(key)
                     if cur is None:
                         self.store.add(obj)
+                        if self._metrics is not None:
+                            self._metrics.added.inc()
                         for fn in self._handlers.add_funcs:
                             fn(obj)
                     else:
                         self.store.update(obj)
                         if (self._coalesce is not None
                                 and self._coalesce(key, cur, obj)):
+                            if self._metrics is not None:
+                                self._metrics.coalesced.inc()
                             continue  # already dirty: pending sync covers it
+                        if self._metrics is not None:
+                            self._metrics.modified.inc()
                         for fn in self._handlers.update_funcs:
                             fn(cur, obj)
                 for key in stale_keys:
                     cur = self.store.get_by_key(key)
                     if cur is not None:
                         self.store.delete(cur)
+                        if self._metrics is not None:
+                            self._metrics.deleted.inc()
                         for fn in self._handlers.delete_funcs:
                             fn(cur)
+                if self._metrics is not None:
+                    self._metrics.resyncs.inc()
                 return
         # busy stream all 3 attempts: the watch is clearly alive, so the
         # cache is converging through events anyway; next tick retries
@@ -261,6 +331,7 @@ class Informer:
                 self.resync()
             return
         key = meta_namespace_key(obj)
+        self._last_event_mono = time.monotonic()
         with self._apply_lock:
             self._mutation_seq += 1
             if event_type == "ADDED":
@@ -270,6 +341,8 @@ class Informer:
                 ) == (obj.get("metadata") or {}).get("resourceVersion"):
                     return  # already delivered via the initial list
                 self.store.add(obj)
+                if self._metrics is not None:
+                    self._metrics.added.inc()
                 for fn in self._handlers.add_funcs:
                     fn(obj)
             elif event_type == "MODIFIED":
@@ -277,10 +350,16 @@ class Informer:
                 self.store.update(obj)
                 if (self._coalesce is not None and old is not None
                         and self._coalesce(key, old, obj)):
+                    if self._metrics is not None:
+                        self._metrics.coalesced.inc()
                     return  # burst coalesced: store fresh, dispatch skipped
+                if self._metrics is not None:
+                    self._metrics.modified.inc()
                 for fn in self._handlers.update_funcs:
                     fn(old if old is not None else obj, obj)
             elif event_type == "DELETED":
                 self.store.delete(obj)
+                if self._metrics is not None:
+                    self._metrics.deleted.inc()
                 for fn in self._handlers.delete_funcs:
                     fn(obj)

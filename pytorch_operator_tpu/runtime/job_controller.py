@@ -36,7 +36,7 @@ from .expectations import (
 )
 from .informer import Informer, meta_namespace_key
 from .recorder import EventRecorder
-from .workqueue import WorkQueue
+from .workqueue import WorkQueue, WorkQueueMetrics
 
 
 def gen_general_name(job_name: str, rtype: str, index) -> str:
@@ -106,19 +106,35 @@ class JobController:
     CONTROLLER_NAME = constants.CONTROLLER_NAME
     GROUP_NAME = constants.GROUP_NAME
 
-    def __init__(self, cluster, config: Optional[JobControllerConfig] = None, recorder=None):
+    def __init__(self, cluster, config: Optional[JobControllerConfig] = None,
+                 recorder=None, registry=None):
         """``cluster`` is any object exposing resource clients as
         attributes: .pods .services .events .podgroups plus the job kind —
-        both FakeCluster and the real client qualify."""
+        both FakeCluster and the real client qualify.  ``registry``
+        receives the runtime's instrumentation (workqueue, informer and
+        batch-latency series); the shared default registry when None."""
         self.cluster = cluster
         self.config = config or JobControllerConfig()
+        if registry is None:
+            from ..metrics import default_registry
+            registry = default_registry
+        self.registry = registry
         self.recorder = recorder or EventRecorder(cluster.events, self.CONTROLLER_NAME)
-        self.pod_control = PodControl(cluster.pods, self.recorder)
-        self.service_control = ServiceControl(cluster.services, self.recorder)
+        self.pod_control = PodControl(cluster.pods, self.recorder,
+                                      registry=registry)
+        self.service_control = ServiceControl(cluster.services, self.recorder,
+                                              registry=registry)
         self.expectations, self.work_queue = _make_runtime_core()
+        # client-go workqueue metric families for the one sync queue;
+        # both the Python and the native C++ queue take the same hooks.
+        self.work_queue_metrics = WorkQueueMetrics(registry, "pytorchjob")
+        self.work_queue.set_metrics(self.work_queue_metrics)
         resync = self.config.resync_period_seconds
-        self.pod_informer = Informer(cluster.pods, resync_period=resync)
-        self.service_informer = Informer(cluster.services, resync_period=resync)
+        self.pod_informer = Informer(cluster.pods, resync_period=resync,
+                                     name="pods", registry=registry)
+        self.service_informer = Informer(cluster.services,
+                                         resync_period=resync,
+                                         name="services", registry=registry)
         # Node informer: only materialized when disruption handling is on
         # and the cluster backend models Nodes (FakeCluster/RestCluster
         # both do; bare test doubles may not).  The concrete controller's
@@ -127,7 +143,9 @@ class JobController:
         if self.config.enable_disruption_handling:
             nodes = getattr(cluster, "nodes", None)
             if nodes is not None:
-                self.node_informer = Informer(nodes, resync_period=resync)
+                self.node_informer = Informer(nodes, resync_period=resync,
+                                              name="nodes",
+                                              registry=registry)
         self._stop = threading.Event()
 
         self.pod_informer.add_event_handler(

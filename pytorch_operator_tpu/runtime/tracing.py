@@ -1,0 +1,220 @@
+"""In-process reconcile tracing: Dapper-style spans in a bounded ring.
+
+The reference operator has no per-request visibility at all — when a
+reconcile is slow you get a log line with a total and no idea whether
+the time went to the expectations check, the pod diff, the create
+fan-out or the status patch.  This module is the lightweight answer:
+
+  * a reconcile opens a root :class:`Span` (``Tracer.trace``); the
+    stages underneath open child spans (module-level :func:`span`) that
+    attach to whatever span is current on the thread;
+  * the fan-out executor propagates the caller's span into its worker
+    threads via :func:`bind_parent` (``threading.local`` context does
+    not cross ``ThreadPoolExecutor.submit`` on its own), so per-item
+    create/delete spans parent correctly;
+  * completed ROOT spans land in a bounded ring buffer
+    (``--trace-buffer-size``) served as JSON from the metrics server's
+    ``/debug/traces`` endpoint — newest first, whole tree per trace;
+  * a root slower than ``slow_threshold`` seconds
+    (``--slow-reconcile-threshold``) additionally emits ONE structured
+    warning line through :mod:`runtime.logger` with the per-child
+    breakdown, so fleet log search finds slow reconciles without
+    scraping the debug endpoint.
+
+Instrumented code never checks "is tracing on": with no current span,
+:func:`span` yields a shared no-op and costs one thread-local read.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from .logger import with_fields
+
+_local = threading.local()
+
+_id_lock = threading.Lock()
+_next_id = 0
+
+
+def _new_id() -> str:
+    global _next_id
+    with _id_lock:
+        _next_id += 1
+        return f"{_next_id:08x}"
+
+
+def current_span() -> Optional["Span"]:
+    """The span the calling thread is currently inside (None outside
+    any trace)."""
+    return getattr(_local, "span", None)
+
+
+class Span:
+    """One timed operation; children nest under it.
+
+    Mutation of ``children`` happens under the owning tracer's lock —
+    fan-out workers append concurrently."""
+
+    __slots__ = ("tracer", "name", "span_id", "parent", "attrs",
+                 "children", "start_time", "_start_mono", "duration",
+                 "error")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional["Span"] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.tracer = tracer
+        self.name = name
+        self.span_id = _new_id()
+        self.parent = parent
+        self.attrs = dict(attrs or {})
+        self.children: List["Span"] = []
+        self.start_time = time.time()
+        self._start_mono = time.monotonic()
+        self.duration: Optional[float] = None
+        self.error: Optional[str] = None
+        if parent is not None:
+            with tracer._lock:
+                parent.children.append(self)
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def end(self) -> None:
+        if self.duration is not None:
+            return
+        self.duration = time.monotonic() - self._start_mono
+        if self.parent is None:
+            self.tracer._finish_root(self)
+
+    def to_dict(self) -> dict:
+        duration = (self.duration if self.duration is not None
+                    else time.monotonic() - self._start_mono)
+        d: dict = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "start": round(self.start_time, 6),
+            "duration_ms": round(duration * 1e3, 3),
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.error is not None:
+            d["error"] = self.error
+        with self.tracer._lock:
+            children = list(self.children)
+        if children:
+            d["children"] = [c.to_dict() for c in children]
+        return d
+
+
+class _NoopSpan:
+    """Shared do-nothing span handed out when no trace is active."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Owns the completed-trace ring buffer and the slow-trace policy.
+
+    ``buffer_size`` 0 keeps nothing (``/debug/traces`` serves an empty
+    list) while slow-trace logging still fires; ``slow_threshold`` None
+    or <= 0 disables the slow log line."""
+
+    def __init__(self, buffer_size: int = 256,
+                 slow_threshold: Optional[float] = None,
+                 logger: Optional[logging.Logger] = None):
+        self._buf: deque = deque(maxlen=max(0, int(buffer_size)))
+        self._lock = threading.RLock()
+        self.slow_threshold = slow_threshold
+        self.logger = logger or logging.getLogger("pytorch-operator.trace")
+
+    @contextmanager
+    def trace(self, name: str, **attrs):
+        """Open a root span and make it the thread's current span."""
+        root = Span(self, name, parent=None, attrs=attrs)
+        prev = current_span()
+        _local.span = root
+        try:
+            yield root
+        except BaseException as e:
+            root.error = repr(e)
+            raise
+        finally:
+            _local.span = prev
+            root.end()
+
+    def snapshot(self, limit: Optional[int] = None) -> List[dict]:
+        """Completed traces as JSON-ready dicts, newest first."""
+        with self._lock:
+            roots = list(self._buf)
+        roots.reverse()
+        if limit is not None and limit >= 0:
+            roots = roots[:limit]
+        return [r.to_dict() for r in roots]
+
+    def _finish_root(self, root: Span) -> None:
+        with self._lock:
+            self._buf.append(root)
+        threshold = self.slow_threshold
+        if (threshold is not None and threshold > 0
+                and root.duration is not None
+                and root.duration > threshold):
+            with self._lock:
+                breakdown = {
+                    c.name: round((c.duration or 0.0) * 1e3, 1)
+                    for c in root.children
+                }
+            fields = dict(root.attrs)
+            fields["trace"] = root.span_id
+            with_fields(self.logger, **fields).warning(
+                "slow reconcile: %s took %.3fs (threshold %.3fs), "
+                "children ms: %s",
+                root.name, root.duration, threshold, breakdown,
+            )
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Open a child span under the thread's current span; a no-op when
+    no trace is active, so library code can instrument unconditionally."""
+    parent = current_span()
+    if parent is None:
+        yield NOOP_SPAN
+        return
+    s = Span(parent.tracer, name, parent=parent, attrs=attrs)
+    _local.span = s
+    try:
+        yield s
+    except BaseException as e:
+        s.error = repr(e)
+        raise
+    finally:
+        _local.span = parent
+        s.end()
+
+
+@contextmanager
+def bind_parent(parent: Optional[Span]):
+    """Make a span captured on another thread current on this one (the
+    fan-out executor's workers run submitted items under the submitting
+    sync's span so per-item spans attach to the right reconcile)."""
+    prev = current_span()
+    _local.span = parent
+    try:
+        yield
+    finally:
+        _local.span = prev

@@ -15,6 +15,110 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
+class WorkQueueMetrics:
+    """client-go's util/workqueue metrics provider for ONE named queue.
+
+    Registers the upstream metric family names (``workqueue_depth``,
+    ``workqueue_adds_total``, ``workqueue_queue_duration_seconds``,
+    ``workqueue_work_duration_seconds``, ``workqueue_retries_total``,
+    ``workqueue_unfinished_work_seconds``,
+    ``workqueue_longest_running_processor_seconds``) labeled by queue
+    ``name``, so any dashboard built for a Go controller-runtime
+    operator reads this one unchanged.
+
+    Attach with ``queue.set_metrics(metrics)`` — works for both the
+    Python :class:`WorkQueue` and the native C++ queue's wrapper.  For
+    the native queue the queue STATE stays in ``workqueue.cc`` (depth is
+    read live through ``wq_len`` via the gauge's scrape-time function);
+    the wrapper only stamps the add/get/done timestamps this side of the
+    FFI, which is where the wall-clock is observed anyway.
+    """
+
+    #: client-go uses exponential 10ns..~10s buckets; sub-microsecond
+    #: resolution is noise for a Python control loop, so start at 10us.
+    DURATION_BUCKETS = (1e-05, 1e-04, 1e-03, 0.01, 0.1, 1.0, 10.0, 30.0)
+
+    def __init__(self, registry, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._added_at: Dict[Any, float] = {}
+        self._started_at: Dict[Any, float] = {}
+        label = {"name": name}
+        self.adds = registry.counter_vec(
+            "workqueue_adds_total",
+            "Total number of adds handled by workqueue",
+            ("name",)).labels(**label)
+        self.depth = registry.gauge_vec(
+            "workqueue_depth",
+            "Current depth of workqueue",
+            ("name",)).labels(**label)
+        self.queue_duration = registry.histogram_vec(
+            "workqueue_queue_duration_seconds",
+            "How long in seconds an item stays in workqueue before being "
+            "requested",
+            ("name",), buckets=self.DURATION_BUCKETS).labels(**label)
+        self.work_duration = registry.histogram_vec(
+            "workqueue_work_duration_seconds",
+            "How long in seconds processing an item from workqueue takes",
+            ("name",), buckets=self.DURATION_BUCKETS).labels(**label)
+        self.retries = registry.counter_vec(
+            "workqueue_retries_total",
+            "Total number of retries handled by workqueue",
+            ("name",)).labels(**label)
+        unfinished = registry.gauge_vec(
+            "workqueue_unfinished_work_seconds",
+            "How many seconds of work has been done that is in progress "
+            "and hasn't been observed by work_duration",
+            ("name",)).labels(**label)
+        unfinished.set_function(self._unfinished_seconds)
+        longest = registry.gauge_vec(
+            "workqueue_longest_running_processor_seconds",
+            "How many seconds has the longest running processor for "
+            "workqueue been running",
+            ("name",)).labels(**label)
+        longest.set_function(self._longest_running_seconds)
+
+    # -- queue hooks --------------------------------------------------------
+    def set_depth_function(self, fn) -> None:
+        self.depth.set_function(fn)
+
+    def on_add(self, item: Any) -> None:
+        self.adds.inc()
+        with self._lock:
+            self._added_at.setdefault(item, time.monotonic())
+
+    def on_get(self, item: Any) -> None:
+        now = time.monotonic()
+        with self._lock:
+            added = self._added_at.pop(item, None)
+            self._started_at[item] = now
+        if added is not None:
+            self.queue_duration.observe(now - added)
+
+    def on_done(self, item: Any) -> None:
+        now = time.monotonic()
+        with self._lock:
+            started = self._started_at.pop(item, None)
+        if started is not None:
+            self.work_duration.observe(now - started)
+
+    def on_retry(self, item: Any) -> None:
+        self.retries.inc()
+
+    # -- scrape-time gauges -------------------------------------------------
+    def _unfinished_seconds(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            return round(sum(now - t for t in self._started_at.values()), 6)
+
+    def _longest_running_seconds(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            if not self._started_at:
+                return 0.0
+            return round(now - min(self._started_at.values()), 6)
+
+
 class RateLimiter:
     """Per-item exponential backoff: base * 2^failures, capped.
 
@@ -62,12 +166,22 @@ class WorkQueue:
         # cancelled by forget() and is dropped on drain
         self._pending_retry: Dict[Any, int] = {}
         self.rate_limiter = rate_limiter or RateLimiter()
+        self._metrics: Optional[WorkQueueMetrics] = None
+
+    def set_metrics(self, metrics: WorkQueueMetrics) -> None:
+        """Attach a :class:`WorkQueueMetrics`; hook placement mirrors
+        client-go (adds counted after the dirty dedupe, queue duration
+        measured add->get, work duration get->done)."""
+        self._metrics = metrics
+        metrics.set_depth_function(self.__len__)
 
     # -- core queue --------------------------------------------------------
     def add(self, item: Any) -> None:
         with self._lock:
             if self._shutdown or item in self._dirty:
                 return
+            if self._metrics is not None:
+                self._metrics.on_add(item)
             self._dirty.add(item)
             if item in self._processing:
                 return
@@ -84,6 +198,8 @@ class WorkQueue:
                     item = self._queue.pop(0)
                     self._processing.add(item)
                     self._dirty.discard(item)
+                    if self._metrics is not None:
+                        self._metrics.on_get(item)
                     return item, False
                 if self._shutdown:
                     return None, True
@@ -115,12 +231,16 @@ class WorkQueue:
             # Same dedupe semantics as add().
             if item in self._dirty:
                 continue
+            if self._metrics is not None:
+                self._metrics.on_add(item)
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
 
     def done(self, item: Any) -> None:
         with self._lock:
+            if self._metrics is not None and item in self._processing:
+                self._metrics.on_done(item)
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
@@ -170,6 +290,8 @@ class WorkQueue:
         with self._lock:
             if self._shutdown:
                 return
+            if self._metrics is not None:
+                self._metrics.on_retry(item)
             if item in self._dirty:
                 return
             self._seq += 1

@@ -1,0 +1,94 @@
+"""jax API-drift shims.
+
+This image's jax (0.4.37) predates top-level ``jax.shard_map``; its
+supported spelling is ``jax.experimental.shard_map.shard_map`` with the
+older keyword surface (``check_rep`` instead of ``check_vma``, ``auto``
+— the set of axes that stay automatic — instead of the partial-manual
+``axis_names``).  Every shard_map call site in the repo imports
+:func:`shard_map` from here and writes against the MODERN surface; this
+one resolver translates for whichever jax is installed (ROADMAP
+"highest-leverage next fix": the drift broke every data-plane test and
+dryrun that shard_maps).
+
+Resolution is lazy (first call) so importing this module never imports
+jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names: Optional[Set[Any]] = None):
+    """``jax.shard_map`` when the installed jax has it, else the
+    ``jax.experimental.shard_map`` fallback with the kwargs translated.
+
+    ``axis_names`` (partial-manual: only these mesh axes are manual
+    inside the body) maps onto the experimental API's ``auto`` — the
+    complement over the mesh's axes.  ``check_vma`` maps onto the
+    experimental ``check_rep`` (same meaning, renamed upstream).
+    """
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return native(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as experimental
+
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    if not check_vma:
+        return experimental(f, check_rep=False, **kwargs)
+    # check_rep on: unlike the modern vma checker this is NOT purely a
+    # validator — its rewrite machinery inserts the pbroadcasts that
+    # make transposes of psum-style collectives correct, so it must stay
+    # on where the caller asked.  But it predates several modern
+    # primitives (checkpoint_name, pallas_call outputs have no
+    # replication rule) and hard-fails VALID programs with
+    # NotImplementedError at trace time — for exactly those, fall back
+    # to an unchecked build, which is what upstream's own error message
+    # prescribes ("as a workaround, pass check_rep=False").
+    checked = experimental(f, check_rep=True, **kwargs)
+    unchecked = None  # built (and kept) on the first checker failure
+
+    def _with_fallback(*args, **kw):
+        nonlocal unchecked
+        if unchecked is not None:
+            return unchecked(*args, **kw)
+        try:
+            return checked(*args, **kw)
+        except NotImplementedError:
+            unchecked = experimental(f, check_rep=False, **kwargs)
+            return unchecked(*args, **kw)
+
+    return _with_fallback
+
+
+def tpu_compiler_params(**kwargs):
+    """``pallas.tpu.CompilerParams(**kwargs)`` under whichever name the
+    installed jax spells it (renamed from ``TPUCompilerParams``)."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def pvary(x, axis_name):
+    """``jax.lax.pvary`` (marks a value device-varying over ``axis_name``
+    so the vma checker accepts shard_map carry types) — an identity on
+    pre-vma jax, where values carry no varying-axes metadata at all and
+    the type-matching problem pvary solves cannot arise."""
+    import jax
+
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_name)
+    return x

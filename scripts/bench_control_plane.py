@@ -31,7 +31,18 @@ and workqueue drain.
 One JSON line per tier/variant goes to stdout; --out writes the
 committed markdown artifact.
 
+``--chaos`` runs the preemption-storm tier STANDALONE (ROADMAP item):
+J gang jobs brought to Running on the fake kubelet, then a
+``disruption.PreemptionStorm`` sweeps one node per job.  The proactive
+variant (--enable-disruption-handling semantics) reports the
+``pytorch_operator_preemption_restart_latency_seconds`` histogram
+(detection -> batched gang delete) plus recovery wall; the legacy
+variant (handling off, ExitCode per-pod retries) reports recovery wall
+only — the apples-to-apples number is the recovery wall, the histogram
+is the proactive path's internal latency.  One JSON line per variant.
+
 Run:  python scripts/bench_control_plane.py --out BENCH_CONTROL_PLANE.md
+      python scripts/bench_control_plane.py --chaos
 """
 
 from __future__ import annotations
@@ -354,6 +365,114 @@ def run_storm_rounds(jobs: int, workers: int, *, rounds: int = 5,
                                        for r in runs]
         out[f"storm_{variant}"] = agg
     return out
+
+
+def new_chaos_job(name: str, workers: int) -> dict:
+    """A TPU-requesting gang job whose pods retry preemption exits the
+    legacy way (ExitCode), so both chaos variants recover without the
+    job failing outright."""
+    tmpl = {"spec": {"containers": [{
+        "name": "pytorch", "image": "img:1",
+        "resources": {"limits": {"google.com/tpu": "4"}}}]}}
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"pytorchReplicaSpecs": {
+            "Master": {"replicas": 1, "restartPolicy": "ExitCode",
+                       "template": tmpl},
+            "Worker": {"replicas": workers, "restartPolicy": "ExitCode",
+                       "template": tmpl},
+        }},
+    }
+
+
+def run_chaos(jobs: int, workers: int, proactive: bool,
+              timeout: float = 120.0) -> dict:
+    """One preemption-storm round: all jobs Running, then one node per
+    job preempted (staggered sweep), measured to full re-convergence
+    (every victim pod replaced, every pod Running again)."""
+    from pytorch_operator_tpu.disruption.chaos import PreemptionStorm
+
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(enable_disruption_handling=proactive),
+        registry=registry)
+    # pods run until the bench flips the decision at the end
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=4, stop_event=stop)
+    expected = jobs * (workers + 1)
+    out: dict = {"variant": "proactive" if proactive else "legacy",
+                 "jobs": jobs, "workers": workers, "pods": expected}
+
+    def running_pods():
+        return [p for p in cluster.pods.list("default")
+                if (p.get("status") or {}).get("phase") == "Running"]
+
+    try:
+        for j in range(jobs):
+            cluster.jobs.create("default",
+                                new_chaos_job(f"chaos-{j}", workers))
+        deadline = time.perf_counter() + timeout
+        while len(running_pods()) < expected:
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = (f"only {len(running_pods())}/{expected} "
+                                f"pods Running before the storm")
+                return out
+            time.sleep(0.01)
+
+        # one victim node per job: the node hosting worker-0
+        victims, victim_uids = [], set()
+        for j in range(jobs):
+            pod = cluster.pods.get("default", f"chaos-{j}-worker-0")
+            victims.append(pod["spec"]["nodeName"])
+            victim_uids.add(pod["metadata"]["uid"])
+
+        t0 = time.perf_counter()
+        storm = PreemptionStorm(kubelet).sweep(
+            victims, stagger=0.05, grace=0.3).start()
+        deadline = t0 + timeout
+        while True:
+            pods = running_pods()
+            uids = {p["metadata"]["uid"] for p in pods}
+            if len(pods) >= expected and not (victim_uids & uids):
+                break
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = (f"{len(pods)}/{expected} Running, "
+                                f"{len(victim_uids & uids)} victim pods "
+                                f"still alive at timeout")
+                storm.cancel()
+                return out
+            time.sleep(0.01)
+        out["converged"] = True
+        out["recovery_wall_s"] = round(time.perf_counter() - t0, 3)
+        out["preemptions_detected"] = ctl.preemptions_detected_counter.value
+        out["gang_restarts"] = ctl.preemption_gang_restarts_counter.value
+        hist = ctl.preemption_restart_latency
+        out["restart_latency"] = {
+            "count": hist.count,
+            "sum_s": round(hist.sum, 4),
+            "mean_ms": (round(hist.sum / hist.count * 1e3, 1)
+                        if hist.count else None),
+        }
+        return out
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+
+
+def run_chaos_ab(jobs: int, workers: int) -> dict:
+    """Proactive (disruption subsystem on) vs legacy (per-pod ExitCode
+    retries) under the identical storm shape."""
+    return {"chaos_proactive": run_chaos(jobs, workers, proactive=True),
+            "chaos_legacy": run_chaos(jobs, workers, proactive=False)}
 
 
 def run_churn(jobs: int, workers: int, threadiness: int = 4,
@@ -749,8 +868,23 @@ def main() -> None:
     ap.add_argument("--io-workers", type=int, default=7,
                     help="worker count for the reconcile-I/O A/B tier "
                          "(ISSUE 1 shape: 1 Master + 7 Workers)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run ONLY the preemption-storm tier (proactive "
+                         "vs legacy recovery) and print one JSON line "
+                         "per variant")
+    ap.add_argument("--chaos-jobs", type=int, default=8)
+    ap.add_argument("--chaos-workers", type=int, default=3)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.chaos:
+        print(f"[bench_cp] chaos ({args.chaos_jobs} jobs x "
+              f"(1+{args.chaos_workers}), one preempted node per job)...",
+              file=sys.stderr)
+        for tier, res in run_chaos_ab(args.chaos_jobs,
+                                      args.chaos_workers).items():
+            print(json.dumps({"tier": tier, **res}))
+        return
 
     saved = os.environ.get("PYTORCH_OPERATOR_NATIVE")
     saved_io = os.environ.get("PYTORCH_OPERATOR_CREATE_FANOUT")

@@ -1,0 +1,165 @@
+"""Throughput trend over the committed BENCH_r*.json rounds.
+
+The driver's per-round bench records land as ``BENCH_rNN.json``
+(``{"n", "cmd", "rc", "tail", "parsed": {...}|null}``).  Since BENCH_r05
+the bench emits a machine-readable ``{"skipped": true, "reason": ...}``
+record when no TPU backend is live instead of crashing — a skipped round
+carries NO throughput signal, so trending must not read it as a
+regression (ROADMAP: "wire the driver to distinguish skipped from
+regressed runs when trending throughput").
+
+Round classification:
+
+  * ``measured`` — ``parsed.value`` present; enters the trend;
+  * ``skipped`` — ``parsed.skipped`` true; reported, never compared;
+  * ``failed``  — no parsable record (legacy rc!=0 crash rounds);
+    reported, never compared.
+
+The verdict compares the LATEST measured round against the reference
+(``--against previous`` measured round, or ``best``); a drop beyond
+``--tolerance`` exits 1.  A latest round that is skipped/failed exits 0
+with an explicit "no comparison" note — absence of evidence, not
+regression.
+
+Run:  python scripts/bench_trend.py            # BENCH_r*.json in repo root
+      python scripts/bench_trend.py --json     # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_round(path: str) -> dict:
+    with open(path) as f:
+        record = json.load(f)
+    record.setdefault("n", _round_number(path))
+    record["path"] = path
+    return record
+
+
+def _round_number(path: str) -> Optional[int]:
+    base = os.path.basename(path)
+    digits = "".join(c for c in base if c.isdigit())
+    return int(digits) if digits else None
+
+
+def classify(record: dict) -> dict:
+    """One round -> {"n", "status", "value"|None, "unit", "reason"}."""
+    parsed = record.get("parsed") or {}
+    out = {"n": record.get("n"), "path": record.get("path", ""),
+           "unit": parsed.get("unit", ""), "value": None, "reason": ""}
+    if parsed.get("skipped"):
+        out["status"] = "skipped"
+        out["reason"] = parsed.get("reason", "")
+    elif isinstance(parsed.get("value"), (int, float)):
+        out["status"] = "measured"
+        out["value"] = float(parsed["value"])
+    else:
+        out["status"] = "failed"
+        out["reason"] = f"no parsable bench record (rc={record.get('rc')})"
+    return out
+
+
+def trend(rounds: List[dict], tolerance: float = 0.2,
+          against: str = "previous") -> dict:
+    """Compare the latest measured round against the reference one.
+
+    ``rounds`` are classify() outputs in round order.  Returns the
+    verdict dict; ``regressed`` is only ever True when BOTH endpoints
+    are measured — skipped/failed rounds never regress."""
+    measured = [r for r in rounds if r["status"] == "measured"]
+    verdict = {
+        "rounds": rounds,
+        "tolerance": tolerance,
+        "against": against,
+        "regressed": False,
+        "comparable": False,
+        "note": "",
+    }
+    if not rounds:
+        verdict["note"] = "no rounds found"
+        return verdict
+    latest = rounds[-1]
+    if latest["status"] != "measured":
+        verdict["note"] = (
+            f"latest round r{latest['n']} is {latest['status']}"
+            f" ({latest['reason']}) — no throughput signal, not a "
+            f"regression; last measured round is "
+            + (f"r{measured[-1]['n']}" if measured else "none"))
+        return verdict
+    prior = [r for r in measured if r is not latest]
+    if not prior:
+        verdict["note"] = (f"r{latest['n']} is the only measured round — "
+                           f"nothing to compare against")
+        return verdict
+    ref = (max(prior, key=lambda r: r["value"]) if against == "best"
+           else prior[-1])
+    ratio = latest["value"] / ref["value"] if ref["value"] else float("inf")
+    verdict.update({
+        "comparable": True,
+        "latest": {"n": latest["n"], "value": latest["value"]},
+        "reference": {"n": ref["n"], "value": ref["value"]},
+        "ratio": round(ratio, 4),
+        "regressed": ratio < 1.0 - tolerance,
+    })
+    skipped_between = [r["n"] for r in rounds
+                       if r["status"] != "measured"
+                       and ref["n"] is not None and latest["n"] is not None
+                       and ref["n"] < (r["n"] or -1) < latest["n"]]
+    note = (f"r{latest['n']} {latest['value']:.1f} vs "
+            f"{against} r{ref['n']} {ref['value']:.1f} "
+            f"({ratio:.2f}x, tolerance -{tolerance:.0%})")
+    if skipped_between:
+        note += (f"; rounds {skipped_between} between them carried no "
+                 f"signal (skipped/failed) and were excluded")
+    verdict["note"] = note
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Trend BENCH_r*.json throughput; skipped rounds are "
+                    "reported, never treated as regressions")
+    ap.add_argument("files", nargs="*",
+                    help="round files (default: BENCH_r*.json in the "
+                         "repo root, sorted)")
+    ap.add_argument("--tolerance", type=float, default=0.2,
+                    help="allowed fractional drop before the verdict is "
+                         "'regressed' (default 0.2 = 20%%; shared-chip "
+                         "throughput is noisy)")
+    ap.add_argument("--against", choices=("previous", "best"),
+                    default="previous")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict as one JSON document")
+    args = ap.parse_args(argv)
+
+    files = args.files or sorted(
+        glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    rounds = [classify(load_round(f)) for f in files]
+    rounds.sort(key=lambda r: (r["n"] is None, r["n"]))
+    verdict = trend(rounds, tolerance=args.tolerance, against=args.against)
+
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        for r in rounds:
+            if r["status"] == "measured":
+                line = f"r{r['n']}: {r['value']:.1f} {r['unit']}"
+            else:
+                line = f"r{r['n']}: {r['status'].upper()} — {r['reason']}"
+            print(line)
+        print(f"verdict: {'REGRESSED' if verdict['regressed'] else 'ok'} "
+              f"— {verdict['note']}")
+    return 1 if verdict["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

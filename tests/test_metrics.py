@@ -1,0 +1,502 @@
+"""Labeled-metric exposition, workqueue/informer instrumentation,
+tracing, and the metric-name doc-drift guard (ISSUE 3 satellites)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.metrics.prometheus import (
+    CounterVec,
+    GaugeVec,
+    HistogramVec,
+    Registry,
+)
+from pytorch_operator_tpu.runtime import tracing
+from pytorch_operator_tpu.runtime.workqueue import WorkQueue, WorkQueueMetrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Labeled exposition (text 0.0.4)
+# ---------------------------------------------------------------------------
+
+
+class TestLabeledExposition:
+    def test_counter_vec_series(self):
+        registry = Registry()
+        vec = registry.counter_vec("req_total", "requests",
+                                   ("verb", "resource"))
+        vec.labels(verb="get", resource="pods").inc(3)
+        vec.labels("list", "pods").inc()
+        text = vec.expose()
+        assert text.count("# HELP req_total requests") == 1
+        assert text.count("# TYPE req_total counter") == 1
+        assert 'req_total{verb="get",resource="pods"} 3' in text
+        assert 'req_total{verb="list",resource="pods"} 1' in text
+
+    def test_labels_idempotent_and_keyword_order_free(self):
+        vec = CounterVec("x_total", "", ("a", "b"))
+        child = vec.labels(a="1", b="2")
+        assert vec.labels(b="2", a="1") is child
+        assert vec.labels("1", "2") is child
+
+    def test_labels_validation(self):
+        vec = CounterVec("x_total", "", ("a", "b"))
+        with pytest.raises(ValueError):
+            vec.labels("only-one")
+        with pytest.raises(ValueError):
+            vec.labels(a="1")  # missing b
+        with pytest.raises(ValueError):
+            vec.labels(a="1", b="2", c="3")
+        with pytest.raises(ValueError):
+            vec.labels("1", b="2")  # mixed positional/keyword
+
+    def test_label_escaping(self):
+        """Backslash, double-quote and newline escape per the exposition
+        spec — the satellite's exact cases."""
+        vec = CounterVec("esc_total", "", ("name",))
+        vec.labels(name='back\\slash "quote"\nnewline').inc()
+        text = vec.expose()
+        assert ('esc_total{name="back\\\\slash \\"quote\\"\\nnewline"} 1'
+                in text)
+        # single line: the raw newline must NOT survive into the body
+        sample = [l for l in text.splitlines() if l.startswith("esc_total{")]
+        assert len(sample) == 1
+
+    def test_help_escaping(self):
+        vec = CounterVec("h_total", "line1\nline2 \\ slash", ("a",))
+        text = vec.expose()
+        assert "# HELP h_total line1\\nline2 \\\\ slash" in text
+
+    def test_deterministic_series_ordering(self):
+        vec = CounterVec("ord_total", "", ("k",))
+        for k in ("zebra", "alpha", "middle"):
+            vec.labels(k=k).inc()
+        lines = [l for l in vec.expose().splitlines()
+                 if l.startswith("ord_total{")]
+        assert lines == sorted(lines)
+        assert vec.expose() == vec.expose()  # stable scrape-to-scrape
+
+    def test_zero_series_vec_emits_help_and_type(self):
+        registry = Registry()
+        registry.histogram_vec("empty_seconds", "no traffic yet", ("a",))
+        text = registry.expose()
+        assert "# HELP empty_seconds no traffic yet" in text
+        assert "# TYPE empty_seconds histogram" in text
+        assert "empty_seconds_bucket" not in text
+
+    def test_histogram_vec_buckets_merge_labels_with_le(self):
+        vec = HistogramVec("lat_seconds", "", ("name",), buckets=(0.1, 1.0))
+        vec.labels(name="q").observe(0.05)
+        vec.labels(name="q").observe(0.5)
+        text = vec.expose()
+        assert 'lat_seconds_bucket{name="q",le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{name="q",le="1"} 2' in text
+        assert 'lat_seconds_bucket{name="q",le="+Inf"} 2' in text
+        assert 'lat_seconds_sum{name="q"}' in text
+        assert 'lat_seconds_count{name="q"} 2' in text
+
+    def test_gauge_vec_scrape_time_function(self):
+        vec = GaugeVec("depth", "", ("name",))
+        state = {"v": 1}
+        vec.labels(name="q").set_function(lambda: state["v"])
+        assert 'depth{name="q"} 1' in vec.expose()
+        state["v"] = 7
+        assert 'depth{name="q"} 7' in vec.expose()
+
+    def test_concurrent_labels_access(self):
+        """N threads hammering labels()+inc on overlapping label sets:
+        exact final counts, one child per label set, no exceptions."""
+        vec = CounterVec("conc_total", "", ("worker",))
+        threads = 8
+        increments = 200
+        errors = []
+
+        def worker(i):
+            try:
+                for n in range(increments):
+                    vec.labels(worker="shared").inc()
+                    vec.labels(worker=f"own-{i % 4}").inc()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        assert vec.labels(worker="shared").value == threads * increments
+        total_own = sum(vec.labels(worker=f"own-{j}").value
+                        for j in range(4))
+        assert total_own == threads * increments
+        assert len(vec.series()) == 5
+
+    def test_registry_returns_same_vec(self):
+        registry = Registry()
+        a = registry.counter_vec("same_total", "", ("x",))
+        b = registry.counter_vec("same_total", "", ("x",))
+        assert a is b
+
+    def test_plain_metrics_unchanged(self):
+        """The pre-existing unlabeled exposition survives the refactor."""
+        registry = Registry()
+        c = registry.counter("plain_total", "help")
+        c.inc(2)
+        assert c.expose() == ("# HELP plain_total help\n"
+                              "# TYPE plain_total counter\n"
+                              "plain_total 2\n")
+
+
+# ---------------------------------------------------------------------------
+# Workqueue instrumentation (client-go metric names)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkQueueMetrics:
+    def _queue(self):
+        registry = Registry()
+        q = WorkQueue()
+        q.set_metrics(WorkQueueMetrics(registry, "testq"))
+        return registry, q
+
+    def test_add_get_done_lifecycle(self):
+        registry, q = self._queue()
+        q.add("k1")
+        q.add("k1")  # deduped: counts once (client-go hook placement)
+        text = registry.expose()
+        assert 'workqueue_adds_total{name="testq"} 1' in text
+        assert 'workqueue_depth{name="testq"} 1' in text
+        item, _ = q.get(timeout=1)
+        assert item == "k1"
+        text = registry.expose()
+        assert 'workqueue_depth{name="testq"} 0' in text
+        assert ('workqueue_queue_duration_seconds_count{name="testq"} 1'
+                in text)
+        # in-flight: unfinished work is visible before done()
+        m = re.search(
+            r'workqueue_unfinished_work_seconds\{name="testq"\} (\S+)', text)
+        assert m and float(m.group(1)) >= 0
+        q.done("k1")
+        text = registry.expose()
+        assert ('workqueue_work_duration_seconds_count{name="testq"} 1'
+                in text)
+        assert 'workqueue_unfinished_work_seconds{name="testq"} 0' in text
+
+    def test_retries_counted(self):
+        registry, q = self._queue()
+        q.add_rate_limited("k1")
+        q.add_rate_limited("k1")
+        assert ('workqueue_retries_total{name="testq"} 2'
+                in registry.expose())
+
+    def test_longest_running_processor(self):
+        registry, q = self._queue()
+        q.add("slow")
+        q.get(timeout=1)
+        time.sleep(0.02)
+        m = re.search(
+            r'workqueue_longest_running_processor_seconds\{name="testq"\} '
+            r'(\S+)', registry.expose())
+        assert m and float(m.group(1)) >= 0.02
+        q.done("slow")
+
+    def test_drained_delayed_add_counts(self):
+        registry, q = self._queue()
+        q.add_after("later", 0.01)
+        item, _ = q.get(timeout=2)
+        assert item == "later"
+        text = registry.expose()
+        assert 'workqueue_adds_total{name="testq"} 1' in text
+        assert ('workqueue_queue_duration_seconds_count{name="testq"} 1'
+                in text)
+
+
+def test_native_workqueue_metrics_parity():
+    """The C++ queue takes the same hooks; depth reads live via wq_len."""
+    from pytorch_operator_tpu import native
+
+    if not native.native_available():
+        pytest.skip(f"native library unavailable: {native.load_error()}")
+    registry = Registry()
+    q = native.NativeWorkQueue()
+    q.set_metrics(WorkQueueMetrics(registry, "nativeq"))
+    try:
+        q.add("k1")
+        q.add("k1")
+        text = registry.expose()
+        assert 'workqueue_adds_total{name="nativeq"} 1' in text
+        assert 'workqueue_depth{name="nativeq"} 1' in text
+        item, _ = q.get(timeout=1)
+        assert item == "k1"
+        q.done("k1")
+        q.add_rate_limited("k1")
+        text = registry.expose()
+        assert ('workqueue_work_duration_seconds_count{name="nativeq"} 1'
+                in text)
+        assert 'workqueue_retries_total{name="nativeq"} 1' in text
+        assert 'workqueue_depth{name="nativeq"} 0' in text
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# Informer instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestInformerMetrics:
+    def test_events_by_type_and_store_gauge(self):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime.informer import Informer
+
+        cluster = FakeCluster()
+        registry = Registry()
+        informer = Informer(cluster.services, name="services",
+                            registry=registry)
+        informer.start()
+        cluster.services.create("default", {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "s1", "namespace": "default"},
+            "spec": {}})
+        cluster.services.patch("default", "s1",
+                               {"metadata": {"labels": {"x": "1"}}})
+        cluster.services.delete("default", "s1")
+        text = registry.expose()
+        assert ('pytorch_operator_informer_events_total'
+                '{informer="services",type="added"} 1') in text
+        assert ('pytorch_operator_informer_events_total'
+                '{informer="services",type="modified"} 1') in text
+        assert ('pytorch_operator_informer_events_total'
+                '{informer="services",type="deleted"} 1') in text
+        assert ('pytorch_operator_informer_store_objects'
+                '{informer="services"} 0') in text
+        # a live event was seen: lag is a small non-negative number
+        m = re.search(r'pytorch_operator_informer_watch_lag_seconds'
+                      r'\{informer="services"\} (\S+)', text)
+        assert m and float(m.group(1)) >= 0
+
+    def test_watch_lag_is_minus_one_before_first_event(self):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime.informer import Informer
+
+        registry = Registry()
+        Informer(FakeCluster().services, name="idle", registry=registry)
+        assert ('pytorch_operator_informer_watch_lag_seconds'
+                '{informer="idle"} -1') in registry.expose()
+
+    def test_coalesced_counted(self):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime.informer import Informer
+
+        cluster = FakeCluster()
+        registry = Registry()
+        informer = Informer(cluster.services, name="svc",
+                            coalesce=lambda key, old, new: True,
+                            registry=registry)
+        informer.start()
+        cluster.services.create("default", {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "s1", "namespace": "default"}, "spec": {}})
+        cluster.services.patch("default", "s1",
+                               {"metadata": {"labels": {"x": "1"}}})
+        text = registry.expose()
+        assert ('pytorch_operator_informer_events_coalesced_total'
+                '{informer="svc"} 1') in text
+        # the coalesced MODIFIED was NOT delivered to handlers
+        assert ('pytorch_operator_informer_events_total'
+                '{informer="svc",type="modified"} 0') in text
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_nested_spans_and_snapshot(self):
+        tracer = tracing.Tracer(buffer_size=8)
+        with tracer.trace("reconcile", key="default/j1") as root:
+            with tracing.span("expectations-check"):
+                pass
+            with tracing.span("creates", count=2):
+                with tracing.span("create-pod", pod="p0"):
+                    pass
+            root.set_attr("result", "success")
+        traces = tracer.snapshot()
+        assert len(traces) == 1
+        t = traces[0]
+        assert t["name"] == "reconcile"
+        assert t["attrs"]["result"] == "success"
+        names = [c["name"] for c in t["children"]]
+        assert names == ["expectations-check", "creates"]
+        assert t["children"][1]["children"][0]["name"] == "create-pod"
+        assert t["duration_ms"] >= 0
+        json.dumps(traces)  # serializable end to end
+
+    def test_span_without_active_trace_is_noop(self):
+        with tracing.span("orphan") as s:
+            assert s is tracing.NOOP_SPAN
+            s.set_attr("ignored", 1)
+
+    def test_ring_buffer_bound_and_order(self):
+        tracer = tracing.Tracer(buffer_size=3)
+        for i in range(5):
+            with tracer.trace("reconcile", n=i):
+                pass
+        traces = tracer.snapshot()
+        assert [t["attrs"]["n"] for t in traces] == [4, 3, 2]  # newest first
+        assert tracer.snapshot(limit=1)[0]["attrs"]["n"] == 4
+
+    def test_zero_buffer_keeps_nothing(self):
+        tracer = tracing.Tracer(buffer_size=0)
+        with tracer.trace("reconcile"):
+            pass
+        assert tracer.snapshot() == []
+
+    def test_bind_parent_propagates_across_threads(self):
+        tracer = tracing.Tracer()
+        with tracer.trace("reconcile") as root:
+            captured = tracing.current_span()
+
+            def worker():
+                with tracing.bind_parent(captured):
+                    with tracing.span("create-pod", pod="p1"):
+                        pass
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        trace = tracer.snapshot()[0]
+        assert [c["name"] for c in trace["children"]] == ["create-pod"]
+
+    def test_fanout_batch_propagates_span(self):
+        from pytorch_operator_tpu.runtime.controls import run_batch
+
+        tracer = tracing.Tracer()
+
+        def item_fn(i):
+            with tracing.span("item", i=i):
+                return i
+
+        with tracer.trace("reconcile"):
+            results = run_batch(item_fn, list(range(4)), width=4)
+        assert all(err is None for _, err in results)
+        trace = tracer.snapshot()[0]
+        assert sorted(c["attrs"]["i"] for c in trace["children"]) == [0, 1,
+                                                                      2, 3]
+
+    def test_error_recorded_on_span(self):
+        tracer = tracing.Tracer()
+        with pytest.raises(RuntimeError):
+            with tracer.trace("reconcile"):
+                with pytest.raises(RuntimeError):
+                    with tracing.span("creates"):
+                        raise RuntimeError("boom")
+                raise RuntimeError("outer")
+        t = tracer.snapshot()[0]
+        assert "outer" in t["error"]
+        assert "boom" in t["children"][0]["error"]
+
+    def test_slow_reconcile_emits_one_structured_log_line(self, caplog):
+        tracer = tracing.Tracer(
+            buffer_size=4, slow_threshold=0.001,
+            logger=logging.getLogger("test.slow"))
+        with caplog.at_level(logging.WARNING, logger="test.slow"):
+            with tracer.trace("reconcile", key="default/slow-job"):
+                with tracing.span("creates"):
+                    time.sleep(0.005)
+        slow = [r for r in caplog.records if "slow reconcile" in r.message]
+        assert len(slow) == 1
+        fields = getattr(slow[0], "structured_fields", {})
+        assert fields.get("key") == "default/slow-job"
+        assert "creates" in slow[0].getMessage()
+
+    def test_fast_reconcile_logs_nothing(self, caplog):
+        tracer = tracer = tracing.Tracer(
+            slow_threshold=10.0, logger=logging.getLogger("test.slow2"))
+        with caplog.at_level(logging.WARNING, logger="test.slow2"):
+            with tracer.trace("reconcile"):
+                pass
+        assert not caplog.records
+
+
+# ---------------------------------------------------------------------------
+# Doc drift: every registered metric name appears in the monitoring doc
+# and vice versa.
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME = re.compile(
+    r'["`\']((?:pytorch_operator_(?!tpu)|workqueue_)[a-z0-9_]+)["`\']')
+
+
+def _code_metric_names() -> set:
+    names = set()
+    pkg = os.path.join(REPO_ROOT, "pytorch_operator_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                names.update(_METRIC_NAME.findall(f.read()))
+    return names
+
+
+def _doc_metric_names() -> set:
+    with open(os.path.join(REPO_ROOT, "docs", "monitoring",
+                           "README.md")) as f:
+        return set(_METRIC_NAME.findall(f.read()))
+
+
+def test_metric_docs_drift():
+    """CI satellite: the docs/monitoring table and the names registered
+    in code must cover each other exactly (both directions)."""
+    code = _code_metric_names()
+    docs = _doc_metric_names()
+    assert code, "metric-name scan found nothing — the regex rotted"
+    undocumented = code - docs
+    assert not undocumented, (
+        f"metrics registered in code but missing from "
+        f"docs/monitoring/README.md: {sorted(undocumented)}")
+    phantom = docs - code
+    assert not phantom, (
+        f"metrics documented but never registered in code: "
+        f"{sorted(phantom)}")
+
+
+def test_rest_request_latency_by_verb_and_resource():
+    """RestResourceStore times every CRUD verb into the
+    {verb, resource} histogram on the cluster's registry."""
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+    from pytorch_operator_tpu.k8s.stub_server import StubApiServer
+
+    srv = StubApiServer().start()
+    registry = Registry()
+    cluster = RestCluster(KubeConfig("127.0.0.1", srv.port),
+                          registry=registry)
+    try:
+        cluster.pods.create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p1", "namespace": "default"},
+            "spec": {}})
+        cluster.pods.get("default", "p1")
+        cluster.pods.list("default")
+        cluster.pods.patch("default", "p1",
+                           {"metadata": {"labels": {"x": "1"}}})
+        cluster.pods.delete("default", "p1")
+        text = registry.expose()
+        for verb in ("create", "get", "list", "patch", "delete"):
+            assert (f'pytorch_operator_rest_request_duration_seconds_count'
+                    f'{{verb="{verb}",resource="pods"}} 1') in text, verb
+    finally:
+        cluster.close()
+        srv.stop()

@@ -1,0 +1,163 @@
+"""Sim-e2e acceptance for the observability layer (ISSUE 3): a job run
+to Succeeded on the fake cluster exposes labeled workqueue and
+sync-duration series on /metrics, /debug/traces returns a complete
+reconcile trace whose child spans cover the creates and the status
+patch, and /healthz /readyz reflect the registered checks."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime.tracing import Tracer
+from testutil import new_job, wait_for
+
+
+def _get(port: int, path: str):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                  timeout=5)
+
+
+def _series_value(text: str, series: str) -> float:
+    m = re.search(rf"^{re.escape(series)} (\S+)$", text, re.M)
+    assert m, f"series {series!r} not found in exposition"
+    return float(m.group(1))
+
+
+@pytest.fixture
+def world():
+    cluster = FakeCluster()
+    registry = Registry()
+    tracer = Tracer(buffer_size=64)
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=registry, tracer=tracer)
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    server = start_metrics_server(
+        registry, 0, host="127.0.0.1", tracer=tracer,
+        health_checks={
+            "healthz": lambda: (not stop.is_set(), {}),
+            "readyz": lambda: (ctl.informers_synced(),
+                               {"informers_synced": ctl.informers_synced()}),
+        })
+    yield cluster, ctl, registry, kubelet, server.server_address[1]
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+    server.shutdown()
+
+
+def _job_succeeded(cluster, name: str) -> bool:
+    job = cluster.jobs.get("default", name)
+    return any(c.get("type") == "Succeeded" and c.get("status") == "True"
+               for c in (job.get("status") or {}).get("conditions") or [])
+
+
+def test_sim_e2e_labeled_metrics_and_traces(world):
+    cluster, ctl, registry, kubelet, port = world
+    cluster.jobs.create("default", new_job(workers=2, name="obs-job")
+                        .to_dict())
+    assert wait_for(lambda: _job_succeeded(cluster, "obs-job"), timeout=30)
+
+    text = _get(port, "/metrics").read().decode()
+    # labeled workqueue depth/latency series (client-go names)
+    assert _series_value(text, 'workqueue_depth{name="pytorchjob"}') >= 0
+    assert _series_value(
+        text, 'workqueue_adds_total{name="pytorchjob"}') > 0
+    assert _series_value(
+        text,
+        'workqueue_queue_duration_seconds_count{name="pytorchjob"}') > 0
+    assert _series_value(
+        text,
+        'workqueue_work_duration_seconds_count{name="pytorchjob"}') > 0
+    # sync-duration histogram labeled by result
+    assert _series_value(
+        text,
+        'pytorch_operator_reconcile_duration_seconds_count'
+        '{result="success"}') > 0
+    # informer + fan-out batch series rode along
+    assert _series_value(
+        text, 'pytorch_operator_informer_events_total'
+              '{informer="pods",type="added"}') >= 3
+    assert _series_value(
+        text, 'pytorch_operator_batch_duration_seconds_count'
+              '{kind="pod",op="create"}') > 0
+
+    # at least one complete reconcile trace covering creates + status patch
+    traces = json.loads(_get(port, "/debug/traces").read())["traces"]
+    assert traces
+
+    def names(trace, acc):
+        acc.add(trace["name"])
+        for child in trace.get("children", []):
+            names(child, acc)
+        return acc
+
+    covering = [t for t in traces
+                if t["name"] == "reconcile"
+                and {"creates", "status-patch"} <= names(t, set())]
+    assert covering, [sorted(names(t, set())) for t in traces]
+    trace = covering[0]
+    assert trace["attrs"]["key"] == "default/obs-job"
+    assert trace["duration_ms"] >= 0
+    # per-item create spans propagated through the fan-out executor
+    all_names = names(trace, set())
+    assert "create-pod" in all_names
+
+    # ?limit honored
+    limited = json.loads(
+        _get(port, "/debug/traces?limit=1").read())["traces"]
+    assert len(limited) == 1
+
+
+def test_health_endpoints(world):
+    _cluster, _ctl, _registry, _kubelet, port = world
+    assert _get(port, "/healthz").status == 200
+    body = json.loads(_get(port, "/readyz").read())
+    assert body["status"] == "ok"
+    assert body["informers_synced"] is True
+
+
+def test_readyz_reports_503_when_not_ready():
+    registry = Registry()
+    server = start_metrics_server(
+        registry, 0, host="127.0.0.1",
+        health_checks={"readyz": lambda: (False, {"leader": False})})
+    port = server.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, "/readyz")
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "unavailable"
+        # healthz has no registered check: bare liveness is 200
+        assert _get(port, "/healthz").status == 200
+        # no tracer configured: the debug endpoint 404s
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, "/debug/traces")
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_operator_flags_exist():
+    """--trace-buffer-size / --slow-reconcile-threshold parse."""
+    from pytorch_operator_tpu.cmd.operator import build_parser
+
+    args = build_parser().parse_args(
+        ["--trace-buffer-size", "16",
+         "--slow-reconcile-threshold", "250ms"])
+    assert args.trace_buffer_size == 16
+    assert args.slow_reconcile_threshold == "250ms"

@@ -94,3 +94,68 @@ def test_shellcheck_clean():
     proc = subprocess.run(["shellcheck", "--severity=warning", *scripts],
                           capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout
+
+
+def test_bench_trend_skipped_rounds_are_not_regressions(tmp_path,
+                                                        monkeypatch):
+    """ROADMAP item: a `"skipped": true` bench round carries no
+    throughput signal — the trend driver must report it as skipped and
+    never as a regression, and must compare across it."""
+    import json as _json
+
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_trend
+
+    def round_file(n, parsed, rc=0):
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(_json.dumps(
+            {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+             "parsed": parsed}))
+        return str(path)
+
+    measured_1 = round_file(1, {"value": 1000.0, "unit": "img/s"})
+    crashed_2 = round_file(2, None, rc=1)  # legacy crash round
+    skipped_3 = round_file(3, {"skipped": True, "reason": "no TPU"})
+    measured_4 = round_file(4, {"value": 950.0, "unit": "img/s"})
+
+    rounds = [bench_trend.classify(bench_trend.load_round(p))
+              for p in (measured_1, crashed_2, skipped_3, measured_4)]
+    assert [r["status"] for r in rounds] == [
+        "measured", "failed", "skipped", "measured"]
+
+    # 950 vs 1000 is inside the 20% tolerance; the skipped/failed rounds
+    # in between are excluded, not read as zeros
+    verdict = bench_trend.trend(rounds, tolerance=0.2)
+    assert verdict["comparable"] and not verdict["regressed"]
+    assert verdict["reference"]["n"] == 1 and verdict["latest"]["n"] == 4
+
+    # a genuine drop beyond tolerance IS a regression
+    bad = rounds[:-1] + [bench_trend.classify(bench_trend.load_round(
+        round_file(5, {"value": 100.0, "unit": "img/s"})))]
+    assert bench_trend.trend(bad, tolerance=0.2)["regressed"]
+
+    # latest round skipped: explicitly not comparable, not regressed
+    tail_skipped = rounds + [bench_trend.classify(bench_trend.load_round(
+        round_file(6, {"skipped": True, "reason": "no TPU"})))]
+    verdict = bench_trend.trend(tail_skipped)
+    assert not verdict["regressed"] and not verdict["comparable"]
+    assert "skipped" in verdict["note"]
+
+    # CLI: exit 0 on the healthy set, table mentions the skip reason
+    assert bench_trend.main([measured_1, skipped_3, measured_4]) == 0
+
+
+def test_bench_chaos_tier_smoke(monkeypatch):
+    """The --chaos tier (ROADMAP item) must run end to end: proactive
+    variant fires gang restarts and populates the restart-latency
+    histogram; both variants reconverge."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE",
+                       os.environ.get("PYTORCH_OPERATOR_NATIVE", ""))
+    import bench_control_plane as bcp
+
+    res = bcp.run_chaos(jobs=2, workers=1, proactive=True, timeout=60.0)
+    assert res["converged"], res
+    assert res["gang_restarts"] == 2
+    assert res["restart_latency"]["count"] == 2
+    assert res["recovery_wall_s"] > 0
