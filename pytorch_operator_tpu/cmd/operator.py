@@ -133,8 +133,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "pytorch.kubeflow.org/max-preemption-restarts "
                         "annotation)")
     p.add_argument("--monitoring-port", type=int, default=8443,
-                   help="port for the /metrics, /debug/traces, /healthz "
-                        "and /readyz endpoints (0 = disabled)")
+                   help="port for the /metrics, /push/v1/metrics, "
+                        "/debug/traces, /healthz and /readyz endpoints "
+                        "(0 = disabled)")
+    p.add_argument("--enable-push-ingestion",
+                   type=lambda s: s.lower() != "false",
+                   default=True, nargs="?", const=True,
+                   help="accept POST /push/v1/metrics from job pods and "
+                        "re-export the samples as job-labeled series "
+                        "(=false disables the endpoint)")
+    p.add_argument("--push-series-budget", type=int, default=256,
+                   help="max label sets per pushed metric family; "
+                        "over-budget sets are counted in "
+                        "pytorch_operator_metrics_dropped_series_total "
+                        "instead of exported (the cardinality guard that "
+                        "makes the job label safe at fleet scale)")
     p.add_argument("--trace-buffer-size", type=int, default=256,
                    help="completed reconcile traces kept in memory and "
                         "served from /debug/traces (0 keeps none; slow-"
@@ -259,11 +272,24 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
 
     metrics_server = None
     if args.monitoring_port:
+        push_gateway = None
+        if args.enable_push_ingestion:
+            from pytorch_operator_tpu.telemetry import PushGateway
+
+            push_gateway = PushGateway(
+                registry, series_budget=args.push_series_budget)
         metrics_server = start_metrics_server(
             registry, args.monitoring_port, tracer=tracer,
-            health_checks={"healthz": healthz, "readyz": readyz})
-        logger.info("metrics on :%d/metrics (traces on /debug/traces)",
-                    metrics_server.server_address[1])
+            health_checks={"healthz": healthz, "readyz": readyz},
+            push_gateway=push_gateway)
+        port = metrics_server.server_address[1]
+        logger.info("metrics on :%d/metrics (traces on /debug/traces%s)",
+                    port,
+                    ", push on /push/v1/metrics" if push_gateway else "")
+        if kubelet is not None and push_gateway is not None:
+            # the sim tier's job pods (played by the fake kubelet) push
+            # their step series to this very process
+            kubelet.telemetry_url = f"http://127.0.0.1:{port}"
 
     if args.fake_cluster_seed_job:
         with open(args.fake_cluster_seed_job) as f:

@@ -299,8 +299,12 @@ class PyTorchController(
                 result = ("error" if err is not None
                           else "success" if forget else "requeue")
                 tspan.set_attr("result", result)
+            # exemplar: the duration sample remembers which trace filled
+            # its bucket, so a slow bucket on an OpenMetrics scrape
+            # resolves directly to its /debug/traces entry
             self.sync_duration.labels(result=result).observe(
-                time.monotonic() - start)
+                time.monotonic() - start,
+                exemplar={"trace_id": tspan.trace_id})
             if err is None and forget:
                 self.work_queue.forget(key)
             elif err is not None:

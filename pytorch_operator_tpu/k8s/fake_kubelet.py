@@ -80,10 +80,21 @@ class FakeKubelet:
         # tests rely on (tainting one node hits exactly one replica).
         # An int N round-robins pods over at most N healthy nodes.
         max_nodes: Optional[int] = None,
+        # Base URL of the operator's metrics server (http://host:port).
+        # When set, each completing pod plays the trainer's telemetry
+        # side: it POSTs `push_steps` per-step samples for its owning
+        # job to /push/v1/metrics (telemetry/push.py), so the sim tier
+        # exercises the full job-pushes -> operator-exports loop.
+        # Assignable after construction (the operator wires it once the
+        # server has a port).
+        telemetry_url: Optional[str] = None,
+        push_steps: int = 3,
     ):
         self.cluster = cluster
         self.run_delay = run_delay
         self.complete_delay = complete_delay
+        self.telemetry_url = telemetry_url
+        self.push_steps = push_steps
         self.decide = decide or (lambda pod: ("Succeeded", 0))
         self.logs = logs or (
             lambda pod, phase, code:
@@ -314,6 +325,7 @@ class FakeKubelet:
                 }
             ],
         }
+        self._push_telemetry(pod)
         try:
             # logs BEFORE the terminal status: a process writes its
             # output and then exits, and follow-mode log streams close
@@ -326,6 +338,32 @@ class FakeKubelet:
                 })
             self.cluster.pods.set_status(ns, name, status)
         except NotFoundError:
+            pass
+
+    def _push_telemetry(self, pod: dict) -> None:
+        """Push synthetic per-step samples for the pod's owning job —
+        the trainer's side of the telemetry loop, played by the sim
+        tier.  Best-effort by design: a missing or dead metrics server
+        must not change pod lifecycle."""
+        url = self.telemetry_url
+        if not url:
+            return
+        meta = pod.get("metadata") or {}
+        job_name = (meta.get("labels") or {}).get(
+            _api_constants.LABEL_JOB_NAME)
+        if not job_name:
+            return
+        job = f"{meta.get('namespace', 'default')}/{job_name}"
+        try:
+            from pytorch_operator_tpu.telemetry.push import push_job_steps
+
+            # fixed synthetic step shape: complete_delay spread over
+            # push_steps steps, nominal sim-tier throughput figures
+            step = max(self.complete_delay / max(1, self.push_steps), 1e-4)
+            push_job_steps(url, job, [step] * self.push_steps,
+                           tokens_per_sec=round(4096.0 / step, 1),
+                           mfu=0.5, timeout=2.0)
+        except Exception:
             pass
 
     def _set_phase(self, ns: str, name: str, phase: str) -> None:

@@ -1,4 +1,6 @@
 from .prometheus import (
+    OPENMETRICS_CONTENT_TYPE,
+    TEXT_CONTENT_TYPE,
     Counter,
     CounterVec,
     Gauge,
@@ -16,6 +18,8 @@ __all__ = [
     "GaugeVec",
     "Histogram",
     "HistogramVec",
+    "OPENMETRICS_CONTENT_TYPE",
     "Registry",
+    "TEXT_CONTENT_TYPE",
     "default_registry",
 ]

@@ -14,12 +14,40 @@ before traffic exists) and hands out per-label-set children via
 ``labels()``.  Label values are escaped per the exposition spec
 (``\\`` ``\"`` ``\n``) and series are emitted in a stable order (sorted
 label-value tuples) so scrapes diff cleanly.
+
+Fleet-scale guardrails added for the data-plane telemetry layer:
+
+  * **Series budget** — ``vec.with_budget(n)`` caps a family at ``n``
+    label sets.  Label sets past the cap are never minted: the sample
+    lands in the shared ``pytorch_operator_metrics_dropped_series_total``
+    counter instead, so an adversarial label value (a ``job`` name per
+    pod, say) costs one counter increment, not an unbounded exposition.
+  * **Exemplars** — ``Histogram.observe(v, exemplar={...})`` remembers
+    the most recent exemplar per bucket and emits it only in OpenMetrics
+    exposition (``expose(openmetrics=True)``); the text-0.0.4 scrape is
+    byte-identical with or without exemplars attached.
+  * **Scrape isolation** — a ``Gauge.set_function`` callback that raises
+    poisons only its own family: ``Registry.expose`` serves every other
+    family, emits the broken family's HELP/TYPE header only, and counts
+    the failure in ``pytorch_operator_scrape_errors_total``.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: Shared counter absorbing samples whose label set exceeded a vec's
+#: series budget (one per registry; see ``_MetricVec.with_budget``).
+DROPPED_SERIES_NAME = "pytorch_operator_metrics_dropped_series_total"
+#: Families whose scrape-time callbacks raised during exposition.
+SCRAPE_ERRORS_NAME = "pytorch_operator_scrape_errors_total"
+
+#: The two exposition content types the metrics server negotiates.
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
 
 
 def _escape_help(text: str) -> str:
@@ -32,6 +60,17 @@ def _escape_label_value(value: str) -> str:
     return (value.replace("\\", "\\\\")
             .replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _family_name(name: str, metric_type: str, openmetrics: bool) -> str:
+    """HELP/TYPE family name for the exposition flavor.  OpenMetrics
+    counter FAMILY names must not carry the ``_total`` suffix (only the
+    samples do) — strict OM parsers reject the whole scrape otherwise;
+    text 0.0.4 keeps the suffix everywhere, as before."""
+    if (openmetrics and metric_type == "counter"
+            and name.endswith("_total")):
+        return name[:-len("_total")]
+    return name
 
 
 def _label_suffix(pairs: Sequence[Tuple[str, str]]) -> str:
@@ -58,15 +97,19 @@ class _Metric:
         with self._lock:
             return self._value
 
-    def sample_lines(self) -> List[str]:
+    def sample_lines(self, openmetrics: bool = False) -> List[str]:
         """The metric's series lines, labels included, no HELP/TYPE."""
         suffix = _label_suffix(self._label_pairs)
         return [f"{self.name}{suffix} {self._format(self.value)}"]
 
-    def expose(self) -> str:
-        header = (f"# HELP {self.name} {_escape_help(self.help)}\n"
-                  f"# TYPE {self.name} {self.type}\n")
-        return header + "\n".join(self.sample_lines()) + "\n"
+    def header(self, openmetrics: bool = False) -> str:
+        name = _family_name(self.name, self.type, openmetrics)
+        return (f"# HELP {name} {_escape_help(self.help)}\n"
+                f"# TYPE {name} {self.type}\n")
+
+    def expose(self, openmetrics: bool = False) -> str:
+        return (self.header(openmetrics)
+                + "\n".join(self.sample_lines(openmetrics)) + "\n")
 
     @staticmethod
     def _format(v: float) -> str:
@@ -129,18 +172,32 @@ class Histogram(_Metric):
         super().__init__(name, help_text, "histogram")
         self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
         self._bucket_counts = [0] * len(self.buckets)
+        # latest exemplar per bucket (index len(buckets) = +Inf):
+        # (label_pairs, value, unix_ts) or None.  Only OpenMetrics
+        # exposition renders these; text 0.0.4 never sees them.
+        self._exemplars: List[Optional[tuple]] = (
+            [None] * (len(self.buckets) + 1))
         self._sum = 0.0
         self._count = 0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        """Record ``value``; ``exemplar`` (e.g. ``{"trace_id": ...}``)
+        is remembered as the bucket's most recent exemplar so a slow
+        bucket links to the trace that filled it."""
         with self._lock:
             self._sum += value
             self._count += 1
             # per-bucket (non-cumulative) storage; exposition cumulates
+            idx = len(self.buckets)  # +Inf unless a bucket matches
             for i, le in enumerate(self.buckets):
                 if value <= le:
                     self._bucket_counts[i] += 1
+                    idx = i
                     break
+            if exemplar:
+                pairs = sorted((str(k), str(v)) for k, v in exemplar.items())
+                self._exemplars[idx] = (pairs, float(value), time.time())
 
     @property
     def count(self) -> int:
@@ -152,17 +209,35 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
-    def sample_lines(self) -> List[str]:
+    def _exemplar_suffix(self, idx: int) -> str:
+        """OpenMetrics exemplar clause for bucket ``idx`` ('' if none):
+        ``# {trace_id="ab12"} 1.7 1712345678.9`` appended to the bucket
+        sample the observation landed in."""
+        ex = self._exemplars[idx]
+        if ex is None:
+            return ""
+        pairs, value, ts = ex
+        return (f" # {_label_suffix(pairs) or '{}'} "
+                f"{self._format(value)} {round(ts, 3)}")
+
+    def sample_lines(self, openmetrics: bool = False) -> List[str]:
         base = list(self._label_pairs)
         with self._lock:
             lines = []
             cumulative = 0
-            for le, n in zip(self.buckets, self._bucket_counts):
+            for i, (le, n) in enumerate(zip(self.buckets,
+                                            self._bucket_counts)):
                 cumulative += n
                 suffix = _label_suffix(base + [("le", self._format(le))])
-                lines.append(f"{self.name}_bucket{suffix} {cumulative}")
+                line = f"{self.name}_bucket{suffix} {cumulative}"
+                if openmetrics:
+                    line += self._exemplar_suffix(i)
+                lines.append(line)
             suffix = _label_suffix(base + [("le", "+Inf")])
-            lines.append(f"{self.name}_bucket{suffix} {self._count}")
+            line = f"{self.name}_bucket{suffix} {self._count}"
+            if openmetrics:
+                line += self._exemplar_suffix(len(self.buckets))
+            lines.append(line)
             plain = _label_suffix(base)
             lines.append(f"{self.name}_sum{plain} {self._format(self._sum)}")
             lines.append(f"{self.name}_count{plain} {self._count}")
@@ -177,6 +252,13 @@ class _MetricVec:
     same child).  Exposition emits HELP/TYPE exactly once — including
     for a vec with zero series — then every child's samples sorted by
     label-value tuple, so series order is deterministic scrape-to-scrape.
+
+    ``with_budget(n)`` arms the cardinality guard: once ``n`` distinct
+    label sets exist, further label sets get a shared DETACHED child —
+    writes to it are accepted and discarded, the attempt is counted in
+    the dropped-series counter, and the exposition never grows past the
+    budget.  Existing series keep working; the guard only refuses to
+    mint NEW ones.
     """
 
     def __init__(self, name: str, help_text: str, metric_type: str,
@@ -191,6 +273,37 @@ class _MetricVec:
         self._child_factory = child_factory
         self._children: Dict[Tuple[str, ...], _Metric] = {}
         self._lock = threading.Lock()
+        self._budget: Optional[int] = None
+        self._dropped: Optional[Counter] = None
+        self._overflow_child: Optional[_Metric] = None
+        self._registry: Optional["Registry"] = None  # set by Registry
+
+    def with_budget(self, budget: int,
+                    dropped: Optional[Counter] = None) -> "_MetricVec":
+        """Cap this family at ``budget`` label sets (the per-registry
+        cardinality guard that makes a ``job`` label safe at fleet
+        scale).  ``dropped`` overrides the counter absorbing rejected
+        sets; by default the owning registry's shared
+        ``pytorch_operator_metrics_dropped_series_total`` is used (a
+        private counter when the vec was built standalone).  Returns
+        self so registration chains:
+        ``registry.gauge_vec(...).with_budget(64)``."""
+        with self._lock:
+            self._budget = max(0, int(budget))
+            if dropped is not None:
+                self._dropped = dropped
+            elif self._dropped is None:
+                if self._registry is not None:
+                    self._dropped = self._registry.dropped_series_counter()
+                else:
+                    self._dropped = Counter(DROPPED_SERIES_NAME)
+        return self
+
+    @property
+    def dropped_series(self) -> Optional[Counter]:
+        """The counter absorbing over-budget label sets (None until
+        ``with_budget`` armed the guard)."""
+        return self._dropped
 
     def labels(self, *values, **kw) -> _Metric:
         if kw:
@@ -214,6 +327,15 @@ class _MetricVec:
         with self._lock:
             child = self._children.get(key)
             if child is None:
+                if (self._budget is not None
+                        and len(self._children) >= self._budget):
+                    # over budget: count the drop and hand back a shared
+                    # child that is never exposed — the caller's write
+                    # succeeds, the series explosion doesn't happen
+                    self._dropped.inc()
+                    if self._overflow_child is None:
+                        self._overflow_child = self._child_factory()
+                    return self._overflow_child
                 child = self._child_factory()
                 child._label_pairs = list(zip(self.label_names, key))
                 self._children[key] = child
@@ -223,13 +345,19 @@ class _MetricVec:
         with self._lock:
             return dict(self._children)
 
-    def expose(self) -> str:
-        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
-                 f"# TYPE {self.name} {self.type}"]
+    def header(self, openmetrics: bool = False) -> str:
+        name = _family_name(self.name, self.type, openmetrics)
+        return (f"# HELP {name} {_escape_help(self.help)}\n"
+                f"# TYPE {name} {self.type}\n")
+
+    def expose(self, openmetrics: bool = False) -> str:
+        name = _family_name(self.name, self.type, openmetrics)
+        lines = [f"# HELP {name} {_escape_help(self.help)}",
+                 f"# TYPE {name} {self.type}"]
         with self._lock:
             children = sorted(self._children.items())
         for _, child in children:
-            lines.extend(child.sample_lines())
+            lines.extend(child.sample_lines(openmetrics))
         return "\n".join(lines) + "\n"
 
 
@@ -259,6 +387,14 @@ class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # Always registered: a scrape must be able to report its own
+        # partial failures (a set_function callback raising must not
+        # take the whole /metrics response down — see expose()).
+        self.scrape_errors = self.counter(
+            SCRAPE_ERRORS_NAME,
+            "Metric families skipped during exposition because a "
+            "scrape-time callback raised; the rest of the scrape is "
+            "served")
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         return self._get_or_create(name, help_text, Counter)
@@ -289,6 +425,15 @@ class Registry:
             name, help_text,
             lambda n, h: HistogramVec(n, h, label_names, buckets=buckets))
 
+    def dropped_series_counter(self) -> Counter:
+        """The registry's single over-budget sink (see
+        ``_MetricVec.with_budget``); registered on first use so
+        registries that never arm a budget don't expose it."""
+        return self.counter(
+            DROPPED_SERIES_NAME,
+            "Samples dropped because their label set would exceed a "
+            "metric family's series budget")
+
     def _get_or_create(self, name, help_text, factory):
         """``factory(name, help_text) -> metric or vec``; metric classes
         (Counter, Gauge) qualify directly."""
@@ -296,14 +441,35 @@ class Registry:
             metric = self._metrics.get(name)
             if metric is None:
                 metric = factory(name, help_text)
+                if isinstance(metric, _MetricVec):
+                    metric._registry = self
                 self._metrics[name] = metric
             return metric
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
+        """Render every family.  ``openmetrics=True`` adds exemplars and
+        the ``# EOF`` terminator (the OpenMetrics scrape the server
+        negotiates via Accept); the default text-0.0.4 output is
+        byte-identical whether or not exemplars are attached.
+
+        A family whose scrape-time callback raises (a broken
+        ``Gauge.set_function``) is degraded to its HELP/TYPE header and
+        counted in ``pytorch_operator_scrape_errors_total`` — one bad
+        callback must not poison the whole response."""
         with self._lock:
             metrics: List = sorted(self._metrics.values(),
                                    key=lambda m: m.name)
-        return "".join(m.expose() for m in metrics)
+        parts = []
+        for m in metrics:
+            try:
+                parts.append(m.expose(openmetrics))
+            except Exception:
+                self.scrape_errors.inc()
+                parts.append(m.header(openmetrics))
+        out = "".join(parts)
+        if openmetrics:
+            out += "# EOF\n"
+        return out
 
 
 default_registry = Registry()

@@ -1,7 +1,18 @@
-"""Operator HTTP surface: /metrics, /debug/traces, /healthz, /readyz.
+"""Operator HTTP surface: /metrics, /push/v1/metrics, /debug/traces,
+/healthz, /readyz.
 
 /metrics is the reference's startMonitoring
 (cmd/pytorch-operator.v1/main.go:31-40, promhttp on --monitoring-port).
+It negotiates the exposition format: a scrape whose Accept header asks
+for ``application/openmetrics-text`` gets OpenMetrics output (exemplars
+included, ``# EOF`` terminated); everything else gets text 0.0.4,
+byte-identical to the pre-exemplar exposition.
+
+``POST /push/v1/metrics`` is the data-plane ingestion door (telemetry/
+push.py): job pods push per-step samples as JSON and the gateway
+re-exports them as ``job``-labeled families under the series budget.
+404 when the process runs without a gateway.
+
 The rest is the observability layer's debug/ops surface:
 
   * ``/debug/traces`` — the tracer's ring of completed reconcile traces
@@ -27,7 +38,11 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
-from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.prometheus import (
+    OPENMETRICS_CONTENT_TYPE,
+    Registry,
+    TEXT_CONTENT_TYPE,
+)
 
 HealthCheck = Callable[[], Tuple[bool, dict]]
 
@@ -38,13 +53,15 @@ def start_metrics_server(
     host: str = "0.0.0.0",
     tracer=None,
     health_checks: Optional[Dict[str, HealthCheck]] = None,
+    push_gateway=None,
 ) -> ThreadingHTTPServer:
     """Serve the operator HTTP surface in a daemon thread.
 
     Returns the server (use .shutdown() to stop); picks a free port when
     ``port`` is 0 (server.server_address[1] tells which).  ``tracer``
     enables /debug/traces; ``health_checks`` maps ``"healthz"`` /
-    ``"readyz"`` to ``() -> (ok, detail)`` callables.
+    ``"readyz"`` to ``() -> (ok, detail)`` callables; ``push_gateway``
+    (telemetry.PushGateway) enables ``POST /push/v1/metrics``.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -63,9 +80,17 @@ def start_metrics_server(
             url = urllib.parse.urlparse(self.path)
             path = url.path.rstrip("/")
             if path in ("", "/metrics"):
-                self._send(
-                    200, registry.expose().encode(),
-                    "text/plain; version=0.0.4; charset=utf-8")
+                # content negotiation: only an explicit OpenMetrics
+                # Accept gets exemplars; Prometheus < 2.43 and curl
+                # keep receiving the unchanged text 0.0.4 bytes
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    self._send(200,
+                               registry.expose(openmetrics=True).encode(),
+                               OPENMETRICS_CONTENT_TYPE)
+                else:
+                    self._send(200, registry.expose().encode(),
+                               TEXT_CONTENT_TYPE)
             elif path == "/debug/traces":
                 if tracer is None:
                     self._send_json(404, {"error": "tracing not enabled"})
@@ -94,6 +119,33 @@ def start_metrics_server(
             else:
                 self.send_response(404)
                 self.end_headers()
+
+        def do_POST(self):
+            url = urllib.parse.urlparse(self.path)
+            if url.path.rstrip("/") != "/push/v1/metrics":
+                self._send_json(404, {"error": "not found"})
+                return
+            if push_gateway is None:
+                self._send_json(404, {"error": "push ingestion not enabled"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length <= 0 or length > 4 << 20:  # 4 MiB: plenty of steps
+                self._send_json(400, {"error": "bad Content-Length"})
+                return
+            try:
+                payload = json.loads(self.rfile.read(length).decode())
+            except (ValueError, UnicodeDecodeError):
+                self._send_json(400, {"error": "body must be JSON"})
+                return
+            try:
+                result = push_gateway.ingest(payload)
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            self._send_json(200, result)
 
         def log_message(self, *args):  # quiet
             pass

@@ -338,3 +338,35 @@ def _make_1f1b_step(
         in_shardings=(None, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
+
+
+def with_step_profiler(
+    step_fn: Callable,
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    *,
+    batch: int,
+    seq_len: int,
+    job: str = "",
+    jsonl_path: str | None = None,
+    on_record: Callable | None = None,
+    window: int = 32,
+):
+    """Instrument any ``make_*_train_step`` product with telemetry.
+
+    Returns ``(profiled_step, profiler)``: the wrapped step is a
+    drop-in replacement (same signature/return, blocked on
+    ``block_until_ready`` so timings cover device execution); the
+    profiler exposes compile-vs-steady split, rolling tokens/sec and
+    the analytic MFU estimate sized from ``cfg``/``mesh``
+    (telemetry/step_timer.py).  ``jsonl_path`` appends one structured
+    line per step for ``scripts/bench_trend.py``; ``on_record`` is the
+    push hook (``telemetry.PushClient(...).on_record`` sends each step
+    to the operator's /push/v1/metrics).
+    """
+    from pytorch_operator_tpu.telemetry import StepProfiler
+
+    profiler = StepProfiler.for_llama(
+        cfg, mesh, batch=batch, seq_len=seq_len, job=job,
+        jsonl_path=jsonl_path, on_record=on_record, window=window)
+    return profiler.wrap(step_fn), profiler

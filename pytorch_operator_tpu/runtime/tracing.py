@@ -55,15 +55,23 @@ def current_span() -> Optional["Span"]:
     return getattr(_local, "span", None)
 
 
+def current_trace_id() -> Optional[str]:
+    """The active trace's id (the root span id), or None outside any
+    trace — the value instrumented code attaches as a histogram
+    exemplar so a slow bucket links to its /debug/traces entry."""
+    span = current_span()
+    return None if span is None else span.trace_id
+
+
 class Span:
     """One timed operation; children nest under it.
 
     Mutation of ``children`` happens under the owning tracer's lock —
     fan-out workers append concurrently."""
 
-    __slots__ = ("tracer", "name", "span_id", "parent", "attrs",
-                 "children", "start_time", "_start_mono", "duration",
-                 "error")
+    __slots__ = ("tracer", "name", "span_id", "trace_id", "parent",
+                 "attrs", "children", "start_time", "_start_mono",
+                 "duration", "error")
 
     def __init__(self, tracer: "Tracer", name: str,
                  parent: Optional["Span"] = None,
@@ -71,6 +79,10 @@ class Span:
         self.tracer = tracer
         self.name = name
         self.span_id = _new_id()
+        # the root's span_id, shared by the whole tree — what an
+        # exemplar carries so a slow histogram bucket resolves to its
+        # /debug/traces entry
+        self.trace_id = parent.trace_id if parent is not None else self.span_id
         self.parent = parent
         self.attrs = dict(attrs or {})
         self.children: List["Span"] = []
@@ -165,6 +177,16 @@ class Tracer:
         if limit is not None and limit >= 0:
             roots = roots[:limit]
         return [r.to_dict() for r in roots]
+
+    def find(self, trace_id: str) -> Optional[dict]:
+        """The completed trace whose root span id is ``trace_id`` (what
+        an exemplar's ``trace_id`` label resolves to), or None if it
+        was never kept / already evicted from the ring."""
+        with self._lock:
+            for root in self._buf:
+                if root.span_id == trace_id:
+                    return root.to_dict()
+        return None
 
     def _finish_root(self, root: Span) -> None:
         with self._lock:

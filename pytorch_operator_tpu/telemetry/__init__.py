@@ -1,0 +1,27 @@
+"""Data-plane telemetry: step profiling, throughput/MFU, push ingestion.
+
+The control plane (metrics/, runtime/tracing.py) answers "is the
+operator healthy"; this package answers "is the JOB healthy" — the
+per-step timing, tokens/sec and MFU signals the reference operator
+could only approximate by grepping pod logs:
+
+  * :mod:`step_timer` — ``StepProfiler`` wraps any jitted
+    ``make_*_train_step`` product: first-call compile time vs
+    steady-state step time, rolling tokens/sec, analytic MFU, and a
+    structured JSONL step log ``scripts/bench_trend.py`` can trend;
+  * :mod:`push` — the pushgateway-style ingestion path: job pods (and
+    the sim tier's fake kubelet) POST per-step samples to the
+    operator's ``/push/v1/metrics``; the ``PushGateway`` re-exports
+    them as ``job``-labeled families under a series budget, so one
+    misbehaving fleet cannot explode the operator's exposition.
+"""
+
+from .push import PushClient, PushGateway  # noqa: F401
+from .step_timer import (  # noqa: F401
+    PEAK_FLOPS_PER_CHIP,
+    StepProfiler,
+    StepRecord,
+    peak_flops_per_chip,
+    read_step_log,
+    train_step_flops,
+)

@@ -1,0 +1,239 @@
+"""Push ingestion: job pods POST per-step samples, the operator
+re-exports them as ``job``-labeled families under a series budget.
+
+The reference operator had no data-plane telemetry path at all — job
+health was pod logs.  Prometheus' answer for ephemeral workloads is the
+pushgateway; this module is the operator-native version of it:
+
+  * :class:`PushClient` — what a training pod (or the sim tier's fake
+    kubelet) uses: ``POST {base}/push/v1/metrics`` with a JSON body of
+    samples.  Failures are swallowed after counting: telemetry must
+    never take a training step down.
+  * :class:`PushGateway` — the operator side: validates each sample
+    against a FIXED family schema (arbitrary pushed names would defeat
+    both the cardinality budget and the metric-docs drift test) and
+    applies it to ``job``-labeled vecs on the operator registry, every
+    one of them armed with ``with_budget`` so a hostile or buggy fleet
+    ends up in ``pytorch_operator_metrics_dropped_series_total``, not
+    in an unbounded ``/metrics`` response.
+
+Wire format (one POST, any number of samples)::
+
+    {"job": "default/train-1",
+     "samples": [
+       {"name": "pytorch_operator_job_step_duration_seconds",
+        "op": "observe", "value": 0.42},
+       {"name": "pytorch_operator_job_tokens_per_second",
+        "op": "set", "value": 15234.5}]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Dict, List, Optional
+
+from pytorch_operator_tpu.metrics.prometheus import Registry
+
+from .step_timer import StepRecord
+
+#: Default cap on ``job``-labeled series per pushed family; one slice
+#: fleet is tens of jobs, so hundreds means something is minting label
+#: values it shouldn't (pod names, uuids) and the budget is doing its job.
+DEFAULT_SERIES_BUDGET = 256
+
+STEP_DURATION = "pytorch_operator_job_step_duration_seconds"
+TOKENS_PER_SEC = "pytorch_operator_job_tokens_per_second"
+MFU = "pytorch_operator_job_mfu"
+STEPS_TOTAL = "pytorch_operator_job_steps_total"
+COMPILE_TIME = "pytorch_operator_job_compile_time_seconds"
+LOSS = "pytorch_operator_job_loss"
+
+#: family name -> (vec kind, allowed op, help text)
+_FAMILIES = {
+    STEP_DURATION: (
+        "histogram", "observe",
+        "Distribution of one training step's wall time, pushed per "
+        "step by the job"),
+    TOKENS_PER_SEC: (
+        "gauge", "set",
+        "Rolling training throughput pushed by the job"),
+    MFU: (
+        "gauge", "set",
+        "Analytic model-FLOPs utilisation estimate pushed by the job "
+        "(6*N*B*T against the chip's peak)"),
+    STEPS_TOTAL: (
+        "counter", "inc",
+        "Training steps the job has pushed"),
+    COMPILE_TIME: (
+        "gauge", "set",
+        "First-step compile+execute wall time pushed by the job"),
+    LOSS: (
+        "gauge", "set",
+        "Most recent training loss pushed by the job"),
+}
+
+#: histogram buckets for step duration: sub-ms sim steps up to
+#: multi-minute pathological steps
+_STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class PushGateway:
+    """Validates pushed samples and applies them to budget-guarded
+    ``job``-labeled families on ``registry``."""
+
+    def __init__(self, registry: Registry,
+                 series_budget: int = DEFAULT_SERIES_BUDGET):
+        self.registry = registry
+        self.series_budget = series_budget
+        dropped = registry.dropped_series_counter()
+        self.rejected = registry.counter(
+            "pytorch_operator_push_rejected_total",
+            "Pushed samples refused at ingestion (unknown family, "
+            "op/family mismatch, non-numeric value, missing job)")
+        self.accepted = registry.counter(
+            "pytorch_operator_push_samples_total",
+            "Pushed samples applied to a job-labeled family")
+        self._vecs = {}
+        for name, (kind, _op, help_text) in _FAMILIES.items():
+            if kind == "histogram":
+                vec = registry.histogram_vec(name, help_text, ("job",),
+                                             buckets=_STEP_BUCKETS)
+            elif kind == "gauge":
+                vec = registry.gauge_vec(name, help_text, ("job",))
+            else:
+                vec = registry.counter_vec(name, help_text, ("job",))
+            self._vecs[name] = vec.with_budget(series_budget, dropped)
+        self._dropped = dropped
+        self._lock = threading.Lock()
+
+    def ingest(self, payload: dict) -> dict:
+        """Apply one POST body; returns per-request accounting
+        ``{"accepted", "rejected", "dropped"}`` (dropped = samples the
+        series budget swallowed).  Malformed payloads raise ValueError
+        — the HTTP layer turns that into a 400."""
+        if not isinstance(payload, dict):
+            raise ValueError("payload must be a JSON object")
+        job = payload.get("job")
+        samples = payload.get("samples")
+        if not isinstance(job, str) or not job:
+            raise ValueError("payload needs a non-empty string 'job'")
+        if not isinstance(samples, list):
+            raise ValueError("payload needs a 'samples' list")
+        accepted = rejected = 0
+        with self._lock:
+            dropped_before = self._dropped.value
+            for sample in samples:
+                if self._apply(job, sample):
+                    accepted += 1
+                else:
+                    rejected += 1
+            dropped = self._dropped.value - dropped_before
+        if accepted:
+            self.accepted.inc(accepted)
+        if rejected:
+            self.rejected.inc(rejected)
+        return {"accepted": accepted, "rejected": rejected,
+                "dropped": int(dropped)}
+
+    def _apply(self, job: str, sample) -> bool:
+        if not isinstance(sample, dict):
+            return False
+        name = sample.get("name")
+        family = _FAMILIES.get(name)
+        if family is None:
+            return False
+        kind, allowed_op, _help = family
+        op = sample.get("op", allowed_op)
+        if op != allowed_op:
+            return False
+        value = sample.get("value", 1.0 if kind == "counter" else None)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            return False
+        if kind == "counter" and value < 0:
+            return False  # counters only go up
+        # every validation happens BEFORE labels(): a rejected sample
+        # must not mint a series (or burn a budget slot) for its job
+        child = self._vecs[name].labels(job=job)
+        if kind == "histogram":
+            child.observe(float(value))
+        elif kind == "gauge":
+            child.set(float(value))
+        else:
+            child.inc(float(value))
+        return True
+
+
+def step_record_samples(record: StepRecord) -> List[dict]:
+    """Translate one StepProfiler record into push samples — the shared
+    vocabulary between the trainer side and the gateway schema."""
+    if record.compile:
+        return [{"name": COMPILE_TIME, "op": "set",
+                 "value": record.step_time_s}]
+    samples = [
+        {"name": STEP_DURATION, "op": "observe",
+         "value": record.step_time_s},
+        {"name": STEPS_TOTAL, "op": "inc", "value": 1},
+    ]
+    if record.tokens_per_sec is not None:
+        samples.append({"name": TOKENS_PER_SEC, "op": "set",
+                        "value": record.tokens_per_sec})
+    if record.mfu is not None:
+        samples.append({"name": MFU, "op": "set", "value": record.mfu})
+    if record.loss is not None:
+        samples.append({"name": LOSS, "op": "set", "value": record.loss})
+    return samples
+
+
+class PushClient:
+    """Trainer-side push: best-effort POSTs to the operator's
+    ``/push/v1/metrics``.
+
+    ``on_record`` plugs straight into ``StepProfiler(on_record=...)``;
+    network failures increment ``errors`` and are otherwise swallowed —
+    a dead operator must not fail a training step."""
+
+    def __init__(self, base_url: str, job: str, timeout: float = 2.0):
+        self.url = base_url.rstrip("/") + "/push/v1/metrics"
+        self.job = job
+        self.timeout = timeout
+        self.errors = 0
+        self.pushed = 0
+
+    def push_samples(self, samples: List[dict]) -> Optional[dict]:
+        body = json.dumps({"job": self.job, "samples": samples}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read().decode() or "{}")
+        except Exception:
+            self.errors += 1
+            return None
+        self.pushed += len(samples)
+        return out
+
+    def on_record(self, record: StepRecord) -> None:
+        self.push_samples(step_record_samples(record))
+
+
+def push_job_steps(base_url: str, job: str,
+                   step_times: List[float],
+                   tokens_per_sec: Optional[float] = None,
+                   mfu: Optional[float] = None,
+                   timeout: float = 2.0) -> Optional[dict]:
+    """One-shot convenience used by the fake kubelet: push a batch of
+    step durations (plus optional throughput gauges) for ``job``."""
+    samples: List[Dict] = []
+    for t in step_times:
+        samples.append({"name": STEP_DURATION, "op": "observe", "value": t})
+        samples.append({"name": STEPS_TOTAL, "op": "inc", "value": 1})
+    if tokens_per_sec is not None:
+        samples.append({"name": TOKENS_PER_SEC, "op": "set",
+                        "value": tokens_per_sec})
+    if mfu is not None:
+        samples.append({"name": MFU, "op": "set", "value": mfu})
+    return PushClient(base_url, job, timeout=timeout).push_samples(samples)

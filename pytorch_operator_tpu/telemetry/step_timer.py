@@ -1,0 +1,315 @@
+"""StepProfiler: per-step timing, throughput and MFU for jitted steps.
+
+Wraps any ``make_*_train_step`` product (parallel/train.py) without
+touching its compiled body: each call is timed around
+``jax.block_until_ready`` so the measurement covers device execution,
+not just dispatch.  The FIRST call is recorded as compile time (trace +
+XLA compile + execute — the number that explains a 90-second silent
+startup); later calls feed a rolling window of steady-state step times
+from which tokens/sec and an analytic MFU estimate are derived.
+
+MFU uses the standard 6·N·B·T decoder-transformer approximation
+(forward 2·N·B·T + backward 4·N·B·T, attention FLOPs excluded) against
+a per-chip peak-FLOPs table, so the number is comparable across runs
+and roughly comparable to published MFU figures; it is an ESTIMATE —
+kernel-level truth lives in scripts/bench_detail.py.
+
+Every step appends one JSON line to an optional step log.  The record
+uses the runtime/logger field vocabulary (``job``, ``step``, ...) so
+the same line is greppable next to operator logs, and
+``scripts/bench_trend.py`` can classify a whole log into the
+measured/skipped/failed trend machinery via :func:`read_step_log`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, IO, Optional
+
+#: Peak dense-matmul FLOPs per chip (bf16), from the public TPU/GPU
+#: spec sheets.  Keys match ``jax.devices()[0].device_kind`` prefixes
+#: (lowercased); ``cpu`` is a nominal figure so the sim tier produces
+#: finite MFU numbers instead of dividing by an unknown.
+PEAK_FLOPS_PER_CHIP: Dict[str, float] = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v5": 459e12,
+    "tpu v6e": 918e12,
+    "tpu v6": 918e12,
+    "cpu": 1e11,
+}
+
+
+def peak_flops_per_chip(device_kind: str) -> float:
+    """Longest-prefix lookup into the peak-FLOPs table (device kinds
+    come back as e.g. ``"TPU v5p chip"``); unknown kinds fall back to
+    the cpu figure rather than crashing the training loop."""
+    kind = (device_kind or "").lower()
+    best = ""
+    for prefix in PEAK_FLOPS_PER_CHIP:
+        if kind.startswith(prefix) and len(prefix) > len(best):
+            best = prefix
+    return PEAK_FLOPS_PER_CHIP[best or "cpu"]
+
+
+def train_step_flops(n_params: int, batch: int, seq_len: int) -> float:
+    """Analytic FLOPs of one optimizer step: 6·N per trained token
+    (2 forward + 4 backward), the PaLM-paper MFU convention."""
+    return 6.0 * float(n_params) * float(batch) * float(seq_len)
+
+
+@dataclass
+class StepRecord:
+    """One JSONL line of the step log."""
+
+    job: str
+    step: int
+    step_time_s: float
+    compile: bool
+    tokens_per_sec: Optional[float]
+    mfu: Optional[float]
+    loss: Optional[float] = None
+
+    def to_json(self) -> str:
+        d = {k: v for k, v in asdict(self).items() if v is not None}
+        return json.dumps(d, sort_keys=True)
+
+
+class StepProfiler:
+    """Times a jitted train step and derives throughput/MFU.
+
+    ``wrap(step_fn)`` returns a drop-in replacement for the step — same
+    signature, same return value — that records a :class:`StepRecord`
+    per call.  Records go to the rolling in-memory window, the optional
+    JSONL sink, and the optional ``on_record`` callback (how the push
+    path forwards steps to the operator without the trainer knowing
+    about HTTP).
+    """
+
+    def __init__(
+        self,
+        *,
+        job: str = "",
+        n_params: int = 0,
+        batch: int = 0,
+        seq_len: int = 0,
+        n_chips: int = 1,
+        peak_flops: Optional[float] = None,
+        window: int = 32,
+        jsonl_path: Optional[str] = None,
+        jsonl_file: Optional[IO[str]] = None,
+        on_record: Optional[Callable[[StepRecord], None]] = None,
+        loss_key: str = "loss",
+    ):
+        self.job = job
+        self.n_params = int(n_params)
+        self.batch = int(batch)
+        self.seq_len = int(seq_len)
+        self.n_chips = max(1, int(n_chips))
+        # resolve the chip's peak lazily: importing jax at construction
+        # would drag the backend up in processes that only push metrics
+        self._peak_flops = peak_flops
+        self._window: deque = deque(maxlen=max(1, int(window)))
+        self._jsonl_path = jsonl_path
+        self._file: Optional[IO[str]] = jsonl_file
+        self._on_record = on_record
+        self._loss_key = loss_key
+        self._lock = threading.Lock()
+        self.step_count = 0
+        self.compile_time_s: Optional[float] = None
+        # bounded: million-step runs must not accumulate a record per
+        # step in process memory — the JSONL sink is the full archive,
+        # this keeps only a recent tail for summary()/debugging
+        self.records: deque = deque(maxlen=max(int(window), 256))
+
+    # -- construction helpers ---------------------------------------------
+    @classmethod
+    def for_llama(cls, cfg, mesh, *, batch: int, seq_len: int,
+                  job: str = "", **kw) -> "StepProfiler":
+        """Profiler sized from a LlamaConfig + mesh: params via
+        llama.n_params, chip count from the mesh, peak FLOPs from the
+        first device's kind."""
+        from pytorch_operator_tpu.models import llama
+
+        devices = mesh.devices.reshape(-1)
+        kind = getattr(devices[0], "device_kind", "cpu")
+        return cls(job=job, n_params=llama.n_params(cfg), batch=batch,
+                   seq_len=seq_len, n_chips=devices.size,
+                   peak_flops=peak_flops_per_chip(kind), **kw)
+
+    # -- derived numbers ---------------------------------------------------
+    @property
+    def peak_flops(self) -> float:
+        if self._peak_flops is None:
+            import jax
+
+            self._peak_flops = peak_flops_per_chip(
+                getattr(jax.devices()[0], "device_kind", "cpu"))
+        return self._peak_flops
+
+    def _throughput(self, step_time: float):
+        """(tokens/sec, mfu) for one steady-state step; (None, None)
+        when the model shape wasn't provided."""
+        if step_time <= 0 or not (self.batch and self.seq_len):
+            return None, None
+        tokens = self.batch * self.seq_len
+        tps = tokens / step_time
+        mfu = None
+        if self.n_params:
+            achieved = train_step_flops(
+                self.n_params, self.batch, self.seq_len) / step_time
+            mfu = achieved / (self.peak_flops * self.n_chips)
+        return tps, mfu
+
+    def mean_step_time(self) -> Optional[float]:
+        """Mean over the rolling window of steady-state steps (compile
+        excluded); None before the second step."""
+        with self._lock:
+            if not self._window:
+                return None
+            return sum(self._window) / len(self._window)
+
+    def tokens_per_sec(self) -> Optional[float]:
+        mean = self.mean_step_time()
+        return self._throughput(mean)[0] if mean else None
+
+    def mfu(self) -> Optional[float]:
+        mean = self.mean_step_time()
+        return self._throughput(mean)[1] if mean else None
+
+    # -- recording ---------------------------------------------------------
+    def observe(self, step_time: float,
+                loss: Optional[float] = None) -> StepRecord:
+        """Record one already-timed step (wrap() calls this; tests and
+        replay tools can call it directly)."""
+        with self._lock:
+            is_compile = self.compile_time_s is None
+            if is_compile:
+                # first call = trace + compile + execute; steady-state
+                # stats must not be polluted by it
+                self.compile_time_s = step_time
+            else:
+                self._window.append(step_time)
+            self.step_count += 1
+            step = self.step_count
+        tps, mfu = (None, None) if is_compile else self._throughput(step_time)
+        record = StepRecord(
+            job=self.job, step=step, step_time_s=round(step_time, 6),
+            compile=is_compile,
+            tokens_per_sec=round(tps, 3) if tps is not None else None,
+            mfu=round(mfu, 6) if mfu is not None else None,
+            loss=loss)
+        with self._lock:
+            self.records.append(record)
+            self._write(record)
+        if self._on_record is not None:
+            try:
+                self._on_record(record)
+            except Exception:
+                pass  # telemetry must never kill the training loop
+        return record
+
+    def _write(self, record: StepRecord) -> None:
+        if self._file is None and self._jsonl_path:
+            self._file = open(self._jsonl_path, "a")
+        if self._file is not None:
+            self._file.write(record.to_json() + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None and self._jsonl_path:
+                self._file.close()
+                self._file = None
+
+    # -- the wrapper -------------------------------------------------------
+    def wrap(self, step_fn: Callable) -> Callable:
+        """Instrument ``step_fn(state, batch, ...)``: identical
+        signature and return; the result is blocked on so the timing
+        covers device execution (async dispatch would otherwise credit
+        every step with ~0)."""
+        import jax
+
+        def profiled_step(*args, **kw):
+            t0 = time.monotonic()
+            out = step_fn(*args, **kw)
+            out = jax.block_until_ready(out)
+            elapsed = time.monotonic() - t0
+            loss = self._extract_loss(out)
+            self.observe(elapsed, loss=loss)
+            return out
+
+        profiled_step.profiler = self
+        return profiled_step
+
+    def _extract_loss(self, out: Any) -> Optional[float]:
+        """Pull the scalar loss out of the step's ``(state, metrics)``
+        return shape when present; never raises."""
+        try:
+            if isinstance(out, tuple) and len(out) == 2:
+                metrics = out[1]
+                if isinstance(metrics, dict) and self._loss_key in metrics:
+                    return float(metrics[self._loss_key])
+        except Exception:
+            pass
+        return None
+
+    def summary(self) -> dict:
+        """One dict for logs/benches: compile split, steady-state mean,
+        throughput and MFU."""
+        mean = self.mean_step_time()
+        tps, mfu = self._throughput(mean) if mean else (None, None)
+        return {
+            "job": self.job,
+            "steps": self.step_count,
+            "compile_time_s": (round(self.compile_time_s, 6)
+                               if self.compile_time_s is not None else None),
+            "mean_step_time_s": round(mean, 6) if mean else None,
+            "tokens_per_sec": round(tps, 3) if tps is not None else None,
+            "mfu": round(mfu, 6) if mfu is not None else None,
+        }
+
+
+def read_step_log(path: str) -> dict:
+    """Aggregate a StepProfiler JSONL log into a bench-trend ``parsed``
+    record: mean steady-state step time and tokens/sec over the
+    non-compile lines.  A log with no steady-state steps classifies as
+    skipped (no throughput signal — same contract as a no-TPU bench
+    round)."""
+    steps = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and not rec.get("compile"):
+                if isinstance(rec.get("step_time_s"), (int, float)):
+                    steps.append(rec)
+    if not steps:
+        return {"skipped": True,
+                "reason": "step log holds no steady-state steps"}
+    mean_time = sum(r["step_time_s"] for r in steps) / len(steps)
+    tps = [r["tokens_per_sec"] for r in steps
+           if isinstance(r.get("tokens_per_sec"), (int, float))]
+    if not tps:
+        # step time alone trends the wrong way (lower is better); a log
+        # recorded without a model shape carries no throughput signal
+        return {"skipped": True, "mean_step_time_s": round(mean_time, 6),
+                "reason": "step log has no tokens/sec (profiler was "
+                          "built without batch/seq_len)"}
+    return {
+        "unit": "tok/s",
+        "value": round(sum(tps) / len(tps), 3),
+        "mean_step_time_s": round(mean_time, 6),
+        "steps": len(steps),
+    }

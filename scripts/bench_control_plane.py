@@ -41,8 +41,20 @@ variant (handling off, ExitCode per-pod retries) reports recovery wall
 only — the apples-to-apples number is the recovery wall, the histogram
 is the proactive path's internal latency.  One JSON line per variant.
 
+``--churn-pods`` runs the pod-informer MODIFIED-burst tier STANDALONE
+(ROADMAP question: "does the pod informer justify a safe coalesce
+variant?").  J jobs are brought to Running, then every pod's status is
+patched B times (kubelet status churn); a counting probe on the pod
+informer's coalesce hook classifies each delivered MODIFIED as
+coalescible (the owning job's key was already dirty in the workqueue
+and neither spec nor deletionTimestamp changed — the exact safety rule
+the job informer's coalescer uses) WITHOUT changing behavior.  The
+coalescible fraction is the measured upper bound on what a safe pod
+coalesce variant could skip.
+
 Run:  python scripts/bench_control_plane.py --out BENCH_CONTROL_PLANE.md
       python scripts/bench_control_plane.py --chaos
+      python scripts/bench_control_plane.py --churn-pods
 """
 
 from __future__ import annotations
@@ -475,6 +487,112 @@ def run_chaos_ab(jobs: int, workers: int) -> dict:
             "chaos_legacy": run_chaos(jobs, workers, proactive=False)}
 
 
+def run_churn_pods(jobs: int, workers: int, bursts: int = 20,
+                   threadiness: int = 4, timeout: float = 60.0) -> dict:
+    """Pod-informer MODIFIED-burst measurement: delivered vs coalescible.
+
+    The probe rides the informer's coalesce hook but ALWAYS returns
+    False, so expectations bookkeeping and dispatch are exactly the
+    shipped behavior — only the classification is recorded.  A MODIFIED
+    is counted coalescible when the job informer's safety rules would
+    have allowed skipping the dispatch: owning job already dirty in the
+    workqueue, no spec change, no deletionTimestamp change.  (The
+    informer's delivered-modified metric can exceed the probe's count:
+    a MODIFIED arriving before its pod's ADDED has been applied — the
+    kubelet's nested bind patch — is delivered with old=None and never
+    consults the hook.)"""
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=registry)
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None)  # park Running
+    kubelet.start()
+
+    counts = {"modified": 0, "coalescible": 0, "dirty": 0,
+              "rv_unchanged": 0}
+    lock = threading.Lock()
+
+    def probe(_key, old, new):
+        """Classify, never coalesce (returning False keeps dispatch)."""
+        with lock:
+            counts["modified"] += 1
+            if ((old.get("metadata") or {}).get("resourceVersion")
+                    == (new.get("metadata") or {}).get("resourceVersion")):
+                counts["rv_unchanged"] += 1
+                return False
+            if old.get("spec") != new.get("spec"):
+                return False
+            if ((old.get("metadata") or {}).get("deletionTimestamp")
+                    != (new.get("metadata") or {}).get("deletionTimestamp")):
+                return False
+            refs = (new.get("metadata") or {}).get("ownerReferences") or []
+            ref = next((r for r in refs if r.get("controller")), None)
+            if ref is None:
+                return False
+            job_key = (f"{(new.get('metadata') or {}).get('namespace', '')}"
+                       f"/{ref.get('name', '')}")
+            if ctl.work_queue.is_dirty(job_key):
+                counts["dirty"] += 1
+                counts["coalescible"] += 1
+        return False
+
+    ctl.pod_informer._coalesce = probe
+    stop = threading.Event()
+    ctl.run(threadiness=threadiness, stop_event=stop)
+    expected = jobs * (workers + 1)
+    out: dict = {"jobs": jobs, "workers": workers, "pods": expected,
+                 "bursts": bursts, "threadiness": threadiness}
+
+    def running_pods():
+        return [p for p in cluster.pods.list("default")
+                if (p.get("status") or {}).get("phase") == "Running"]
+
+    try:
+        for j in range(jobs):
+            cluster.jobs.create("default", new_job(f"churnp-{j}", workers))
+        deadline = time.perf_counter() + timeout
+        while len(running_pods()) < expected:
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                return out
+            time.sleep(0.01)
+        out["converged"] = True
+
+        # the burst: B status-churn patches per pod, the kubelet-
+        # heartbeat regime (condition timestamps move, nothing a
+        # reconcile outcome depends on changes)
+        t0 = time.perf_counter()
+        pods = [p["metadata"]["name"] for p in cluster.pods.list("default")]
+        for b in range(bursts):
+            for name in pods:
+                try:
+                    cluster.pods.set_status("default", name, {
+                        "conditions": [{"type": "Ready", "status": "True",
+                                        "heartbeat": f"{b}"}]})
+                except NotFoundError:
+                    pass
+        # drain: every patch above was delivered synchronously by the
+        # fake store's listeners, so the counters are already final
+        out["burst_wall_s"] = round(time.perf_counter() - t0, 3)
+        with lock:
+            out.update(counts)
+        out["burst_events"] = bursts * len(pods)
+        out["coalescible_fraction"] = (
+            round(counts["coalescible"] / counts["modified"], 4)
+            if counts["modified"] else None)
+        text = registry.expose()
+        import re as _re
+
+        m = _re.search(r'pytorch_operator_informer_events_total'
+                       r'\{informer="pods",type="modified"\} (\d+)', text)
+        out["informer_delivered_modified"] = int(m.group(1)) if m else None
+        return out
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+
+
 def run_churn(jobs: int, workers: int, threadiness: int = 4,
               variant: str = "native", timeout: float = 300.0) -> dict:
     """Convergence under load: `jobs` jobs with interleaved
@@ -874,8 +992,24 @@ def main() -> None:
                          "per variant")
     ap.add_argument("--chaos-jobs", type=int, default=8)
     ap.add_argument("--chaos-workers", type=int, default=3)
+    ap.add_argument("--churn-pods", action="store_true",
+                    help="run ONLY the pod-informer MODIFIED-burst "
+                         "measurement (delivered vs coalescible) and "
+                         "print one JSON line")
+    ap.add_argument("--churn-pods-jobs", type=int, default=12)
+    ap.add_argument("--churn-pods-workers", type=int, default=3)
+    ap.add_argument("--churn-pods-bursts", type=int, default=20)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.churn_pods:
+        print(f"[bench_cp] churn-pods ({args.churn_pods_jobs} jobs x "
+              f"(1+{args.churn_pods_workers}), {args.churn_pods_bursts} "
+              f"status bursts/pod)...", file=sys.stderr)
+        res = run_churn_pods(args.churn_pods_jobs, args.churn_pods_workers,
+                             bursts=args.churn_pods_bursts)
+        print(json.dumps({"tier": "churn_pods", **res}))
+        return
 
     if args.chaos:
         print(f"[bench_cp] chaos ({args.chaos_jobs} jobs x "

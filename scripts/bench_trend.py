@@ -15,6 +15,13 @@ Round classification:
   * ``failed``  — no parsable record (legacy rc!=0 crash rounds);
     reported, never compared.
 
+Data-plane rounds ride the same machinery: a ``*.jsonl`` file is read
+as a StepProfiler step log (telemetry/step_timer.py) and aggregated —
+per-round mean steady-state step time + tokens/sec — into the same
+``parsed`` shape, so train-step telemetry trends exactly like the
+control-plane benches (a log with no steady-state steps or no
+throughput figure classifies as skipped, never as a regression).
+
 The verdict compares the LATEST measured round against the reference
 (``--against previous`` measured round, or ``best``); a drop beyond
 ``--tolerance`` exits 1.  A latest round that is skipped/failed exits 0
@@ -35,14 +42,36 @@ import sys
 from typing import List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)  # pytorch_operator_tpu for step-log rounds
 
 
 def load_round(path: str) -> dict:
+    if path.endswith(".jsonl"):
+        return load_step_log_round(path)
     with open(path) as f:
         record = json.load(f)
     record.setdefault("n", _round_number(path))
     record["path"] = path
     return record
+
+
+def load_step_log_round(path: str) -> dict:
+    """A StepProfiler JSONL step log as one trend round: parsed value =
+    mean tokens/sec over the steady-state (non-compile) steps."""
+    from pytorch_operator_tpu.telemetry.step_timer import read_step_log
+
+    try:
+        parsed = read_step_log(path)
+    except (OSError, UnicodeDecodeError, ValueError) as e:
+        # a missing, truncated or binary-garbage log is a FAILED round
+        # (reported, never compared) — not a trend-tool crash
+        parsed = None
+        tail = repr(e)
+    else:
+        tail = ""
+    return {"n": _round_number(path), "path": path,
+            "cmd": f"step-log {os.path.basename(path)}", "rc": 0,
+            "tail": tail, "parsed": parsed}
 
 
 def _round_number(path: str) -> Optional[int]:

@@ -29,8 +29,28 @@ cd "$(dirname "$0")/.."
 
 MODE="${MODE:-local}"
 DRYRUN="${DRYRUN:-0}"
+# Failure flight recorder: the conftest e2e_artifacts fixture scrapes a
+# failing sim-e2e test's /metrics and /debug/traces into this dir; the
+# workflow bundles whatever landed there so the evidence outlives the
+# run (the reference's Argo pipeline uploaded pod logs the same way).
+ARTIFACTS_DIR="${E2E_ARTIFACTS_DIR:-$PWD/test-artifacts}"
+export E2E_ARTIFACTS_DIR="$ARTIFACTS_DIR"
+# fresh dir per run: a bundle must hold THIS run's evidence only, not
+# stale scrapes from a previously-failing test (DRYRUN stays
+# side-effect-free)
+if [ "$DRYRUN" != "1" ]; then
+  rm -rf "${ARTIFACTS_DIR:?}" "$ARTIFACTS_DIR.tgz"
+fi
 
 step() { echo; echo "=== [$MODE] $1 ==="; }
+
+upload_artifacts() {  # bundle + surface captured telemetry, if any
+  if [ -d "$ARTIFACTS_DIR" ] && [ -n "$(ls -A "$ARTIFACTS_DIR" 2>/dev/null)" ]; then
+    tar -czf "$ARTIFACTS_DIR.tgz" -C "$(dirname "$ARTIFACTS_DIR")" \
+      "$(basename "$ARTIFACTS_DIR")"
+    echo "e2e artifacts captured: $ARTIFACTS_DIR.tgz ($(ls "$ARTIFACTS_DIR" | wc -l) file(s))"
+  fi
+}
 
 run() {  # execute, or print one plan line under DRYRUN=1
   if [ "$DRYRUN" = "1" ]; then
@@ -54,7 +74,10 @@ if [ "$MODE" = "local" ]; then
   make -C native
 
   step "unit + tier-2 suites (virtual 8-device CPU mesh)"
-  python -m pytest tests/ -q
+  # on failure, bundle whatever the e2e artifact fixture scraped
+  # (operator /metrics + /debug/traces of the failing sim worlds)
+  # before propagating the failure
+  python -m pytest tests/ -q || { upload_artifacts; exit 1; }
 
   step "e2e: defaults flow (stub API server + simulated kubelet)"
   scripts/v1/run-defaults.sh
@@ -88,6 +111,13 @@ NAMESPACE="${NAMESPACE:-kubeflow}"
 KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
 
 teardown() {
+  step "capture operator telemetry artifacts"
+  # scrape the live operator's flight recorder before the cluster goes
+  # away — same endpoints the sim-tier conftest fixture captures
+  run_sh "mkdir -p \"$ARTIFACTS_DIR\" && kubectl -n $NAMESPACE exec deploy/pytorch-operator -- wget -qO- http://127.0.0.1:8443/metrics > \"$ARTIFACTS_DIR/operator-metrics.txt\" || true"
+  run_sh "kubectl -n $NAMESPACE exec deploy/pytorch-operator -- wget -qO- http://127.0.0.1:8443/debug/traces > \"$ARTIFACTS_DIR/operator-traces.json\" || true"
+  if [ "$DRYRUN" != "1" ]; then upload_artifacts; fi
+
   step "teardown"
   run kubectl delete -f manifests/ --ignore-not-found || true
   if [ "$KEEP_CLUSTER" != "1" ]; then

@@ -3,6 +3,13 @@
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
 tests run without TPU hardware (the driver separately dry-runs the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Also provides the e2e artifact-capture fixture: sim-e2e tests that run
+an operator metrics server register its port with ``e2e_artifacts``;
+when such a test FAILS, the fixture scrapes ``/metrics`` and
+``/debug/traces`` into ``$E2E_ARTIFACTS_DIR`` (default
+``test-artifacts/``) so the flight recorder survives the world's
+teardown — the post-mortem the ROADMAP observability item asked for.
 """
 
 import os
@@ -23,3 +30,84 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _artifact_dir() -> str:
+    return os.environ.get(
+        "E2E_ARTIFACTS_DIR", os.path.join(_REPO_ROOT, "test-artifacts"))
+
+
+def _capture_e2e_artifacts(item, reg) -> None:
+    """Scrape the registered operator endpoints into the artifact dir.
+    Runs from the makereport hook — the world fixture's server is still
+    alive here (fixture teardown hasn't started)."""
+    import re
+
+    out_dir = _artifact_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    # nodeid, not bare name: same-named tests in different modules must
+    # not clobber each other's captured evidence
+    base = os.path.join(
+        out_dir, re.sub(r"[^A-Za-z0-9_.-]+", "_", item.nodeid))
+    captured = []
+    if reg.get("port"):
+        import urllib.request
+
+        for path, suffix in (("/metrics", "metrics.txt"),
+                             ("/debug/traces", "traces.json")):
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{reg['port']}{path}",
+                    timeout=5).read()
+                with open(f"{base}.{suffix}", "wb") as f:
+                    f.write(body)
+                captured.append(f"{base}.{suffix}")
+            except Exception as e:  # dead server: record why, keep going
+                with open(f"{base}.{suffix}.error", "w") as f:
+                    f.write(repr(e) + "\n")
+                captured.append(f"{base}.{suffix}.error")
+    for name, text in reg.get("extra", {}).items():
+        path = f"{base}.{name}"
+        with open(path, "w") as f:
+            f.write(text)
+        captured.append(path)
+    if captured:
+        sys.stderr.write(
+            f"\n[e2e-artifacts] captured {len(captured)} file(s) under "
+            f"{out_dir} for failed test {item.name}\n")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Stash the call-phase report on the item (standard pytest recipe)
+    and, when a test that registered e2e endpoints fails, capture its
+    /metrics and /debug/traces BEFORE fixtures tear the world down."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, f"rep_{rep.when}", rep)
+    reg = getattr(item, "_e2e_capture", None)
+    if rep.when == "call" and rep.failed and reg is not None:
+        try:
+            _capture_e2e_artifacts(item, reg)
+        except Exception as e:  # never let capture mask the real failure
+            sys.stderr.write(f"\n[e2e-artifacts] capture failed: {e!r}\n")
+
+
+@pytest.fixture
+def e2e_artifacts(request):
+    """Failure flight recorder for sim-e2e tests.
+
+    A test (or its world fixture) sets ``e2e_artifacts["port"]`` to the
+    operator metrics server's port (and may add ``extra``: filename ->
+    text).  If the test body fails, the makereport hook scrapes
+    ``/metrics`` and ``/debug/traces`` from that port into
+    ``$E2E_ARTIFACTS_DIR/<test-name>.*`` (default ``test-artifacts/``)
+    while the server is still up.
+    """
+    reg = {"port": None, "extra": {}}
+    request.node._e2e_capture = reg
+    return reg

@@ -158,6 +158,266 @@ class TestLabeledExposition:
 
 
 # ---------------------------------------------------------------------------
+# Series budget (ISSUE 4: the cardinality guard)
+# ---------------------------------------------------------------------------
+
+
+class TestSeriesBudget:
+    def test_over_budget_label_sets_are_dropped_not_minted(self):
+        registry = Registry()
+        vec = registry.counter_vec("b_total", "", ("job",)).with_budget(2)
+        vec.labels(job="a").inc()
+        vec.labels(job="b").inc()
+        vec.labels(job="c").inc()  # accepted, discarded, counted
+        vec.labels(job="d").inc()
+        text = registry.expose()
+        assert 'b_total{job="a"} 1' in text
+        assert 'b_total{job="b"} 1' in text
+        assert 'job="c"' not in text and 'job="d"' not in text
+        assert ('pytorch_operator_metrics_dropped_series_total 2'
+                in text)
+        assert len(vec.series()) == 2
+
+    def test_existing_series_unaffected_at_budget(self):
+        vec = CounterVec("b_total", "", ("job",)).with_budget(1)
+        child = vec.labels(job="a")
+        child.inc(5)
+        vec.labels(job="overflow").inc()
+        assert vec.labels(job="a") is child  # idempotent past the cap
+        assert child.value == 5
+        assert vec.dropped_series.value == 1
+
+    def test_standalone_vec_gets_private_dropped_counter(self):
+        vec = HistogramVec("h_seconds", "", ("job",)).with_budget(0)
+        vec.labels(job="any").observe(1.0)
+        assert vec.dropped_series.value == 1
+        assert vec.series() == {}
+
+    def test_budget_shares_one_registry_counter(self):
+        registry = Registry()
+        a = registry.counter_vec("a_total", "", ("x",)).with_budget(0)
+        b = registry.gauge_vec("b_gauge", "", ("x",)).with_budget(0)
+        a.labels(x="1").inc()
+        b.labels(x="1").set(2)
+        assert a.dropped_series is b.dropped_series
+        assert ('pytorch_operator_metrics_dropped_series_total 2'
+                in registry.expose())
+
+
+# ---------------------------------------------------------------------------
+# Exemplars + OpenMetrics content negotiation (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def _hist(self, registry=None):
+        registry = registry or Registry()
+        vec = registry.histogram_vec("lat_seconds", "latency", ("result",),
+                                     buckets=(0.1, 1.0))
+        return registry, vec
+
+    def test_exemplar_stored_per_bucket_and_rendered_only_openmetrics(self):
+        registry, vec = self._hist()
+        vec.labels(result="ok").observe(0.05, exemplar={"trace_id": "aa11"})
+        vec.labels(result="ok").observe(0.5, exemplar={"trace_id": "bb22"})
+        vec.labels(result="ok").observe(50.0, exemplar={"trace_id": "cc33"})
+        om = registry.expose(openmetrics=True)
+        assert re.search(r'le="0\.1"\} 1 # \{trace_id="aa11"\} 0\.05 '
+                         r'\d+\.\d+', om)
+        assert '# {trace_id="bb22"} 0.5' in om
+        # beyond the last finite bucket: the +Inf bucket carries it
+        assert re.search(r'le="\+Inf"\} 3 # \{trace_id="cc33"\} 50', om)
+        assert om.endswith("# EOF\n")
+        plain = registry.expose()
+        assert "trace_id" not in plain and "# EOF" not in plain
+
+    def test_latest_exemplar_wins_per_bucket(self):
+        _registry, vec = self._hist()
+        vec.labels(result="ok").observe(0.05, exemplar={"trace_id": "old"})
+        vec.labels(result="ok").observe(0.06, exemplar={"trace_id": "new"})
+        om = vec.expose(openmetrics=True)
+        assert "new" in om and "old" not in om
+
+    def test_plain_text_byte_identical_with_and_without_exemplars(self):
+        """The drift-proofing satellite: text-0.0.4 output must not
+        change AT ALL when exemplars are attached — every PR 3
+        exposition test keeps passing against exemplar-carrying
+        histograms."""
+        _ra, with_ex = self._hist()
+        _rb, without_ex = self._hist()
+        with_ex.labels(result="ok").observe(0.05,
+                                            exemplar={"trace_id": "x"})
+        without_ex.labels(result="ok").observe(0.05)
+        assert with_ex.expose() == without_ex.expose()
+        assert (with_ex.labels(result="ok").sample_lines()
+                == without_ex.labels(result="ok").sample_lines())
+
+    def test_observe_without_exemplar_keeps_om_clean(self):
+        registry, vec = self._hist()
+        vec.labels(result="ok").observe(0.05)
+        om = registry.expose(openmetrics=True)
+        assert " # {" not in om
+
+    def test_openmetrics_counter_family_drops_total_suffix(self):
+        """OM counter FAMILY names must not end in _total (samples keep
+        it) or strict OM parsers reject the whole scrape; text 0.0.4
+        keeps the suffix everywhere, unchanged."""
+        registry = Registry()
+        registry.counter("acme_requests_total", "req").inc(3)
+        registry.counter_vec("acme_errs_total", "", ("verb",)).labels(
+            verb="get").inc()
+        om = registry.expose(openmetrics=True)
+        assert "# TYPE acme_requests counter" in om
+        assert "# HELP acme_requests req" in om
+        assert "\nacme_requests_total 3" in om  # sample keeps the suffix
+        assert "# TYPE acme_errs counter" in om
+        assert 'acme_errs_total{verb="get"} 1' in om
+        assert "acme_requests_total counter" not in om
+        plain = registry.expose()
+        assert "# TYPE acme_requests_total counter" in plain
+        assert "# TYPE acme_errs_total counter" in plain
+
+    def test_openmetrics_parses_with_strict_parser(self):
+        """Round-trip the OM exposition (exemplars included) through
+        prometheus_client's strict OpenMetrics parser when available."""
+        try:
+            from prometheus_client.openmetrics.parser import (
+                text_string_to_metric_families,
+            )
+        except ImportError:
+            pytest.skip("prometheus_client not installed")
+        registry, vec = self._hist()
+        vec.labels(result="ok").observe(0.05, exemplar={"trace_id": "ab12"})
+        registry.counter("acme_requests_total", "req").inc(2)
+        registry.gauge("acme_depth", "d").set(4)
+        families = {f.name: f for f in text_string_to_metric_families(
+            registry.expose(openmetrics=True))}
+        assert "acme_requests" in families
+        assert "lat_seconds" in families
+        bucket = next(s for s in families["lat_seconds"].samples
+                      if s.name == "lat_seconds_bucket"
+                      and s.labels["le"] == "0.1")
+        assert bucket.exemplar.labels == {"trace_id": "ab12"}
+
+    def test_server_content_negotiation(self):
+        """Plain scrape = text 0.0.4 bytes (no exemplar syntax);
+        OpenMetrics Accept = exemplars + # EOF + the OM content type."""
+        import urllib.request
+
+        from pytorch_operator_tpu.metrics.server import start_metrics_server
+
+        registry, vec = self._hist()
+        vec.labels(result="ok").observe(0.05, exemplar={"trace_id": "e2e1"})
+        server = start_metrics_server(registry, 0, host="127.0.0.1")
+        port = server.server_address[1]
+        try:
+            plain_resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5)
+            plain = plain_resp.read().decode()
+            assert plain_resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            assert plain == registry.expose()  # byte-identical
+            assert "e2e1" not in plain
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "application/openmetrics-text; "
+                                   "version=1.0.0"})
+            om_resp = urllib.request.urlopen(req, timeout=5)
+            om = om_resp.read().decode()
+            assert om_resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            assert '# {trace_id="e2e1"} 0.05' in om
+            assert om.endswith("# EOF\n")
+        finally:
+            server.shutdown()
+
+    def test_reconcile_exemplar_links_trace(self):
+        """The wiring contract: process_next_work_item attaches the
+        root span id, and Tracer.find resolves it."""
+        from pytorch_operator_tpu.runtime.tracing import Tracer
+
+        tracer = Tracer(buffer_size=8)
+        registry = Registry()
+        hist = registry.histogram_vec(
+            "pytorch_operator_reconcile_duration_seconds", "", ("result",))
+        with tracer.trace("reconcile", key="default/j") as root:
+            with tracing.span("creates"):
+                pass
+        hist.labels(result="success").observe(
+            0.01, exemplar={"trace_id": root.trace_id})
+        om = registry.expose(openmetrics=True)
+        m = re.search(r'# \{trace_id="([0-9a-f]+)"\}', om)
+        assert m
+        trace = tracer.find(m.group(1))
+        assert trace is not None and trace["name"] == "reconcile"
+        assert tracer.find("no-such-trace") is None
+
+
+# ---------------------------------------------------------------------------
+# Scrape-error isolation (ISSUE 4 satellite: one bad set_function
+# callback must not poison /metrics)
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeErrorIsolation:
+    def test_broken_gauge_function_degrades_only_its_family(self):
+        registry = Registry()
+        healthy = registry.counter("healthy_total", "fine")
+        healthy.inc(3)
+        depth = registry.gauge_vec("depth", "queue depth", ("name",))
+        depth.labels(name="ok").set(5)
+        depth.labels(name="broken").set_function(
+            lambda: 1 / 0)  # scrape-time crash
+        text = registry.expose()
+        # the rest of the scrape survives
+        assert "healthy_total 3" in text
+        # the broken family degrades to its header (discoverable, empty)
+        assert "# TYPE depth gauge" in text
+        assert 'depth{name="ok"}' not in text  # family-level skip
+        assert 'depth{name="broken"}' not in text
+        # and the failure is counted — visible from the next scrape
+        # (which itself hits the still-broken family again: 1 -> 2)
+        assert registry.scrape_errors.value == 1
+        assert ("pytorch_operator_scrape_errors_total 2"
+                in registry.expose())
+
+    def test_standalone_gauge_function_crash_isolated_too(self):
+        registry = Registry()
+        g = registry.gauge("lag_seconds", "")
+        g.set_function(lambda: [][1])  # IndexError at scrape
+        registry.counter("other_total", "").inc()
+        text = registry.expose()
+        assert "other_total 1" in text
+        assert "# TYPE lag_seconds gauge" in text
+        assert "\nlag_seconds " not in text
+        assert registry.scrape_errors.value == 1
+
+    def test_healthy_registry_never_counts_errors(self):
+        registry = Registry()
+        registry.counter("a_total", "").inc()
+        registry.expose()
+        registry.expose(openmetrics=True)
+        assert registry.scrape_errors.value == 0
+
+    def test_recovered_callback_resumes_serving(self):
+        registry = Registry()
+        state = {"boom": True}
+
+        def fn():
+            if state["boom"]:
+                raise RuntimeError("transient")
+            return 7.0
+
+        registry.gauge_vec("depth", "", ("name",)).labels(
+            name="q").set_function(fn)
+        registry.expose()
+        assert registry.scrape_errors.value == 1
+        state["boom"] = False
+        assert 'depth{name="q"} 7' in registry.expose()
+        assert registry.scrape_errors.value == 1  # no new errors
+
+
+# ---------------------------------------------------------------------------
 # Workqueue instrumentation (client-go metric names)
 # ---------------------------------------------------------------------------
 

@@ -36,7 +36,7 @@ def _series_value(text: str, series: str) -> float:
 
 
 @pytest.fixture
-def world():
+def world(e2e_artifacts):
     cluster = FakeCluster()
     registry = Registry()
     tracer = Tracer(buffer_size=64)
@@ -53,6 +53,9 @@ def world():
             "readyz": lambda: (ctl.informers_synced(),
                                {"informers_synced": ctl.informers_synced()}),
         })
+    # a failing e2e test gets its /metrics + /debug/traces captured
+    # into the artifact dir before this fixture tears the server down
+    e2e_artifacts["port"] = server.server_address[1]
     yield cluster, ctl, registry, kubelet, server.server_address[1]
     stop.set()
     ctl.work_queue.shutdown()

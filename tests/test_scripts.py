@@ -145,6 +145,84 @@ def test_bench_trend_skipped_rounds_are_not_regressions(tmp_path,
     assert bench_trend.main([measured_1, skipped_3, measured_4]) == 0
 
 
+def test_bench_trend_reads_step_profiler_jsonl(tmp_path, monkeypatch):
+    """ISSUE 4 satellite: a StepProfiler JSONL step log enters the
+    trend as a measured round (mean tokens/sec over steady-state
+    steps); a log with no steady-state signal classifies as skipped,
+    never as a regression."""
+    import json as _json
+
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_trend
+
+    def step_log(n, records):
+        path = tmp_path / f"STEPS_r{n:02d}.jsonl"
+        path.write_text("".join(_json.dumps(r) + "\n" for r in records))
+        return str(path)
+
+    measured = step_log(1, [
+        {"compile": True, "step": 1, "step_time_s": 2.0},
+        {"compile": False, "step": 2, "step_time_s": 0.5,
+         "tokens_per_sec": 2000.0},
+        {"compile": False, "step": 3, "step_time_s": 0.5,
+         "tokens_per_sec": 2200.0},
+    ])
+    r = bench_trend.classify(bench_trend.load_round(measured))
+    assert r["status"] == "measured"
+    assert r["value"] == pytest.approx(2100.0)
+    assert r["unit"] == "tok/s"
+    assert r["n"] == 1
+
+    compile_only = step_log(2, [
+        {"compile": True, "step": 1, "step_time_s": 2.0}])
+    r2 = bench_trend.classify(bench_trend.load_round(compile_only))
+    assert r2["status"] == "skipped"
+
+    # data-plane rounds ride the same verdict logic as bench rounds:
+    # measured r1 vs measured r3 across the skipped r2
+    faster = step_log(3, [
+        {"compile": False, "step": 2, "step_time_s": 0.4,
+         "tokens_per_sec": 2500.0}])
+    rounds = [bench_trend.classify(bench_trend.load_round(p))
+              for p in (measured, compile_only, faster)]
+    verdict = bench_trend.trend(rounds, tolerance=0.2)
+    assert verdict["comparable"] and not verdict["regressed"]
+    assert verdict["latest"]["value"] == pytest.approx(2500.0)
+
+    # an unreadable log is a failed round, not a crash
+    r3 = bench_trend.classify(
+        bench_trend.load_round(str(tmp_path / "missing_r04.jsonl")))
+    assert r3["status"] == "failed"
+
+    # CLI end to end over jsonl rounds
+    assert bench_trend.main([measured, compile_only, faster]) == 0
+
+
+def test_bench_churn_pods_smoke(monkeypatch):
+    """ISSUE 4 satellite: the pod-informer MODIFIED-burst measurement
+    must run — status bursts are delivered (never actually coalesced:
+    behavior unchanged) and classified into a coalescible fraction."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE",
+                       os.environ.get("PYTORCH_OPERATOR_NATIVE", ""))
+    import bench_control_plane as bcp
+
+    res = bcp.run_churn_pods(jobs=3, workers=1, bursts=5, threadiness=2,
+                             timeout=60.0)
+    assert res["converged"], res
+    assert res["pods"] == 6
+    # every burst patch was delivered as a MODIFIED (plus lifecycle
+    # transitions observed on the way to Running)
+    assert res["modified"] >= res["burst_events"] == 30
+    # delivered >= probe-observed: a MODIFIED arriving before its
+    # pod's ADDED was applied (the kubelet's nested bind patch) is
+    # delivered with old=None and never consults the coalesce hook
+    assert res["informer_delivered_modified"] >= res["modified"]
+    assert 0 <= res["coalescible"] <= res["modified"]
+    frac = res["coalescible_fraction"]
+    assert frac is not None and 0.0 <= frac <= 1.0
+
+
 def test_bench_chaos_tier_smoke(monkeypatch):
     """The --chaos tier (ROADMAP item) must run end to end: proactive
     variant fires gang restarts and populates the restart-latency

@@ -1,0 +1,534 @@
+"""Data-plane telemetry (ISSUE 4 tentpole): StepProfiler timing /
+throughput / MFU, push ingestion with the series budget, the HTTP push
+endpoint, and the sim-e2e acceptance loop — a job's pushed step metrics
+appear job-labeled on the operator's /metrics within budget, and an
+OpenMetrics scrape of the reconcile histogram carries an exemplar that
+resolves in /debug/traces."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.telemetry import (
+    PushClient,
+    PushGateway,
+    StepProfiler,
+    peak_flops_per_chip,
+    read_step_log,
+    train_step_flops,
+)
+from pytorch_operator_tpu.telemetry.push import (
+    MFU,
+    STEP_DURATION,
+    STEPS_TOTAL,
+    TOKENS_PER_SEC,
+    step_record_samples,
+)
+
+
+# ---------------------------------------------------------------------------
+# StepProfiler
+# ---------------------------------------------------------------------------
+
+
+class TestStepProfiler:
+    def test_compile_vs_steady_split(self):
+        prof = StepProfiler(job="default/j", batch=4, seq_len=256,
+                            n_params=1000, peak_flops=1e12)
+        first = prof.observe(3.0)   # trace+compile+execute
+        assert first.compile and prof.compile_time_s == 3.0
+        assert first.tokens_per_sec is None  # compile never pollutes stats
+        prof.observe(0.5)
+        prof.observe(0.5)
+        assert prof.mean_step_time() == pytest.approx(0.5)
+        assert prof.compile_time_s == 3.0  # steady steps don't touch it
+
+    def test_tokens_per_sec_and_mfu_math(self):
+        # 4x256 = 1024 tokens in 0.5s -> 2048 tok/s; FLOPs/step =
+        # 6*1e9*1024, achieved = that/0.5, peak = 1e12 * 2 chips
+        prof = StepProfiler(batch=4, seq_len=256, n_params=int(1e9),
+                            n_chips=2, peak_flops=1e12)
+        prof.observe(1.0)  # compile
+        rec = prof.observe(0.5)
+        assert rec.tokens_per_sec == pytest.approx(2048.0)
+        expected_mfu = (6 * 1e9 * 1024 / 0.5) / (1e12 * 2)
+        assert rec.mfu == pytest.approx(expected_mfu, rel=1e-4)
+        assert prof.tokens_per_sec() == pytest.approx(2048.0)
+        assert prof.mfu() == pytest.approx(expected_mfu, rel=1e-4)
+
+    def test_no_model_shape_means_no_throughput(self):
+        prof = StepProfiler()
+        prof.observe(1.0)
+        rec = prof.observe(0.1)
+        assert rec.tokens_per_sec is None and rec.mfu is None
+
+    def test_rolling_window_bounds_memory_of_the_mean(self):
+        prof = StepProfiler(batch=1, seq_len=1, window=2)
+        prof.observe(9.0)  # compile
+        for t in (1.0, 2.0, 4.0):
+            prof.observe(t)
+        # window=2: the 1.0 step has rolled out
+        assert prof.mean_step_time() == pytest.approx(3.0)
+
+    def test_jsonl_log_and_read_back(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        prof = StepProfiler(job="default/j", batch=2, seq_len=8,
+                            n_params=100, peak_flops=1e12,
+                            jsonl_path=path)
+        prof.observe(1.0, loss=2.5)
+        prof.observe(0.01, loss=2.0)
+        prof.observe(0.01, loss=1.5)
+        prof.close()
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["compile"] is True
+        assert lines[1]["compile"] is False
+        assert lines[1]["loss"] == 2.0
+        assert lines[1]["job"] == "default/j"
+        parsed = read_step_log(path)
+        assert parsed["unit"] == "tok/s"
+        assert parsed["steps"] == 2
+        assert parsed["value"] == pytest.approx(1600.0)  # 16 tokens / 0.01
+        assert parsed["mean_step_time_s"] == pytest.approx(0.01)
+
+    def test_read_step_log_without_throughput_is_skipped(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        path.write_text(
+            '{"compile": true, "step": 1, "step_time_s": 1.0}\n'
+            '{"compile": false, "step": 2, "step_time_s": 0.5}\n')
+        parsed = read_step_log(str(path))
+        assert parsed["skipped"] is True
+        assert "tokens/sec" in parsed["reason"]
+
+    def test_read_step_log_compile_only_is_skipped(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        path.write_text('{"compile": true, "step": 1, "step_time_s": 9}\n')
+        assert read_step_log(str(path))["skipped"] is True
+
+    def test_wrap_times_a_jitted_step_and_extracts_loss(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        prof = StepProfiler(batch=2, seq_len=8, n_params=100,
+                            peak_flops=1e12)
+
+        @jax.jit
+        def step(state, batch):
+            return state + batch.sum(), {"loss": jnp.float32(1.25)}
+
+        wrapped = prof.wrap(step)
+        assert wrapped.profiler is prof
+        state = jnp.zeros(())
+        for _ in range(3):
+            state, metrics = wrapped(state, jnp.ones((2, 8)))
+        assert prof.step_count == 3
+        assert prof.compile_time_s is not None
+        assert prof.records[-1].loss == pytest.approx(1.25)
+        assert prof.mean_step_time() > 0
+
+    def test_on_record_exceptions_never_escape(self):
+        def boom(record):
+            raise RuntimeError("push failed")
+
+        prof = StepProfiler(on_record=boom)
+        prof.observe(1.0)  # must not raise
+
+    def test_peak_flops_prefix_lookup(self):
+        assert peak_flops_per_chip("TPU v5p chip") == 459e12
+        assert peak_flops_per_chip("TPU v5 lite") == 197e12
+        assert peak_flops_per_chip("TPU v4") == 275e12
+        # unknown kinds fall back instead of crashing the loop
+        assert peak_flops_per_chip("Radeon") == peak_flops_per_chip("cpu")
+
+    def test_train_step_flops_is_6nbt(self):
+        assert train_step_flops(10, 2, 3) == 6 * 10 * 2 * 3
+
+    def test_with_step_profiler_on_real_train_step(self):
+        import jax
+        import optax
+
+        from pytorch_operator_tpu.models import llama
+        from pytorch_operator_tpu.parallel import train
+        from pytorch_operator_tpu.parallel.mesh import make_mesh
+
+        cfg = llama.tiny()
+        mesh = make_mesh(dp=1, fsdp=1, tp=1, devices=jax.devices()[:1])
+        opt = optax.sgd(1e-3)
+        state = train.sharded_init(cfg, mesh, opt)
+        step = train.make_train_step(cfg, mesh, opt)
+        B, T = 2, 16
+        profiled, prof = train.with_step_profiler(
+            step, cfg, mesh, batch=B, seq_len=T, job="default/train")
+        key = jax.random.key(0)
+        batch = jax.random.randint(key, (B, T + 1), 0, cfg.vocab_size)
+        for _ in range(3):
+            state, metrics = profiled(state, batch)
+        summary = prof.summary()
+        assert summary["steps"] == 3
+        assert summary["compile_time_s"] > summary["mean_step_time_s"]
+        assert summary["tokens_per_sec"] > 0
+        assert summary["mfu"] is not None and summary["mfu"] > 0
+        assert prof.n_params == llama.n_params(cfg)
+        assert prof.records[-1].loss is not None  # loss rode along
+
+
+# ---------------------------------------------------------------------------
+# PushGateway (ingestion + budget)
+# ---------------------------------------------------------------------------
+
+
+class TestPushGateway:
+    def test_ingest_applies_known_families(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        out = gw.ingest({"job": "default/j1", "samples": [
+            {"name": STEP_DURATION, "op": "observe", "value": 0.02},
+            {"name": TOKENS_PER_SEC, "op": "set", "value": 1500.5},
+            {"name": STEPS_TOTAL, "op": "inc", "value": 2},
+            {"name": MFU, "op": "set", "value": 0.41},
+        ]})
+        assert out == {"accepted": 4, "rejected": 0, "dropped": 0}
+        text = registry.expose()
+        assert ('pytorch_operator_job_step_duration_seconds_count'
+                '{job="default/j1"} 1') in text
+        assert ('pytorch_operator_job_tokens_per_second'
+                '{job="default/j1"} 1500.5') in text
+        assert 'pytorch_operator_job_steps_total{job="default/j1"} 2' in text
+        assert 'pytorch_operator_job_mfu{job="default/j1"} 0.41' in text
+        assert 'pytorch_operator_push_samples_total 4' in text
+
+    def test_rejections_counted_not_raised(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        out = gw.ingest({"job": "default/j1", "samples": [
+            {"name": "made_up_family", "op": "set", "value": 1},
+            {"name": TOKENS_PER_SEC, "op": "observe", "value": 1},  # op swap
+            {"name": TOKENS_PER_SEC, "op": "set", "value": "NaN-ish"},
+            {"name": STEPS_TOTAL, "op": "inc", "value": -5},  # down-counter
+            "not-even-a-dict",
+        ]})
+        assert out["accepted"] == 0 and out["rejected"] == 5
+        text = registry.expose()
+        assert 'pytorch_operator_push_rejected_total 5' in text
+        # a rejected sample must not have minted a series for its job
+        # (it would burn a budget slot and export a zero-valued series)
+        assert 'job="default/j1"' not in text
+        assert out["dropped"] == 0
+
+    def test_malformed_payload_raises_for_http_400(self):
+        gw = PushGateway(Registry())
+        for bad in (None, [], {"samples": []}, {"job": ""},
+                    {"job": "j", "samples": "x"}):
+            with pytest.raises(ValueError):
+                gw.ingest(bad)
+
+    def test_series_budget_bounds_job_label_cardinality(self):
+        registry = Registry()
+        gw = PushGateway(registry, series_budget=2)
+        for i in range(5):
+            out = gw.ingest({"job": f"default/job-{i}", "samples": [
+                {"name": TOKENS_PER_SEC, "op": "set", "value": float(i)}]})
+        # jobs 0 and 1 minted series; 2..4 were dropped, and the LAST
+        # request reported its drop in the response
+        assert out["dropped"] == 1 and out["accepted"] == 1
+        text = registry.expose()
+        for i in (0, 1):
+            assert f'{{job="default/job-{i}"}}' in text
+        for i in (2, 3, 4):
+            assert f'job-{i}' not in text, "over-budget series exported"
+        m = re.search(
+            r'pytorch_operator_metrics_dropped_series_total (\d+)', text)
+        assert m and int(m.group(1)) == 3
+        # existing series keep accepting samples at full budget
+        out = gw.ingest({"job": "default/job-0", "samples": [
+            {"name": TOKENS_PER_SEC, "op": "set", "value": 9.5}]})
+        assert out == {"accepted": 1, "rejected": 0, "dropped": 0}
+        assert ('pytorch_operator_job_tokens_per_second'
+                '{job="default/job-0"} 9.5') in registry.expose()
+
+    def test_step_record_samples_vocabulary(self):
+        from pytorch_operator_tpu.telemetry.step_timer import StepRecord
+
+        compile_rec = StepRecord(job="j", step=1, step_time_s=3.0,
+                                 compile=True, tokens_per_sec=None, mfu=None)
+        names = {s["name"] for s in step_record_samples(compile_rec)}
+        assert names == {"pytorch_operator_job_compile_time_seconds"}
+        steady = StepRecord(job="j", step=2, step_time_s=0.5, compile=False,
+                            tokens_per_sec=2048.0, mfu=0.4, loss=1.5)
+        samples = step_record_samples(steady)
+        gw = PushGateway(registry := Registry())
+        out = gw.ingest({"job": "default/j", "samples": samples})
+        assert out["rejected"] == 0 and out["accepted"] == len(samples)
+        text = registry.expose()
+        assert 'pytorch_operator_job_loss{job="default/j"} 1.5' in text
+
+
+# ---------------------------------------------------------------------------
+# HTTP: POST /push/v1/metrics + content negotiation
+# ---------------------------------------------------------------------------
+
+
+def _post(port: int, body: bytes, path: str = "/push/v1/metrics"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=5)
+
+
+class TestPushEndpoint:
+    def test_post_roundtrip_and_reexport(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                      push_gateway=gw)
+        port = server.server_address[1]
+        try:
+            body = json.dumps({"job": "default/j1", "samples": [
+                {"name": STEP_DURATION, "op": "observe", "value": 0.2}]})
+            resp = _post(port, body.encode())
+            assert resp.status == 200
+            assert json.loads(resp.read())["accepted"] == 1
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert ('pytorch_operator_job_step_duration_seconds_count'
+                    '{job="default/j1"} 1') in text
+        finally:
+            server.shutdown()
+
+    def test_post_error_statuses(self):
+        registry = Registry()
+        server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                      push_gateway=PushGateway(registry))
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(port, b"{not json")
+            assert exc.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(port, json.dumps({"samples": []}).encode())
+            assert exc.value.code == 400  # missing job
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(port, b"{}", path="/some/other/path")
+            assert exc.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_post_404_without_gateway(self):
+        server = start_metrics_server(Registry(), 0, host="127.0.0.1")
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(port, b"{}")
+            assert exc.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_push_client_swallows_dead_operator(self):
+        client = PushClient("http://127.0.0.1:1", "default/j", timeout=0.2)
+        assert client.push_samples(
+            [{"name": STEP_DURATION, "op": "observe", "value": 1}]) is None
+        assert client.errors == 1  # counted, not raised
+
+    def test_push_client_feeds_profiler_records(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                      push_gateway=gw)
+        port = server.server_address[1]
+        try:
+            client = PushClient(f"http://127.0.0.1:{port}", "default/j1")
+            prof = StepProfiler(job="default/j1", batch=2, seq_len=8,
+                                n_params=100, peak_flops=1e12,
+                                on_record=client.on_record)
+            prof.observe(1.0)   # compile -> compile_time gauge
+            prof.observe(0.01)  # steady -> duration/steps/tps/mfu
+            text = registry.expose()
+            assert ('pytorch_operator_job_compile_time_seconds'
+                    '{job="default/j1"} 1') in text
+            assert ('pytorch_operator_job_step_duration_seconds_count'
+                    '{job="default/j1"} 1') in text
+            assert ('pytorch_operator_job_steps_total'
+                    '{job="default/j1"} 1') in text
+        finally:
+            server.shutdown()
+
+    def test_operator_flags(self):
+        from pytorch_operator_tpu.cmd.operator import build_parser
+
+        args = build_parser().parse_args(["--push-series-budget", "7"])
+        assert args.push_series_budget == 7
+        assert args.enable_push_ingestion is True
+        args = build_parser().parse_args(["--enable-push-ingestion=false"])
+        assert args.enable_push_ingestion is False
+
+
+# ---------------------------------------------------------------------------
+# Sim e2e: the acceptance loop
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def telemetry_world(e2e_artifacts):
+    from pytorch_operator_tpu.controller import PyTorchController
+    from pytorch_operator_tpu.k8s.fake import FakeCluster
+    from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+    from pytorch_operator_tpu.runtime import JobControllerConfig
+    from pytorch_operator_tpu.runtime.tracing import Tracer
+
+    cluster = FakeCluster()
+    registry = Registry()
+    tracer = Tracer(buffer_size=128)
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=registry, tracer=tracer)
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    gateway = PushGateway(registry, series_budget=2)
+    server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                  tracer=tracer, push_gateway=gateway)
+    port = server.server_address[1]
+    # the fake kubelet plays the trainer side: each completing pod
+    # pushes step samples for its owning job to this operator
+    kubelet.telemetry_url = f"http://127.0.0.1:{port}"
+    e2e_artifacts["port"] = port
+    yield cluster, registry, gateway, kubelet, port
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+    server.shutdown()
+
+
+def _job_succeeded(cluster, name: str) -> bool:
+    job = cluster.jobs.get("default", name)
+    return any(c.get("type") == "Succeeded" and c.get("status") == "True"
+               for c in (job.get("status") or {}).get("conditions") or [])
+
+
+def test_sim_e2e_pushed_step_metrics_within_budget_and_exemplar_resolves(
+        telemetry_world):
+    from testutil import new_job, wait_for
+
+    cluster, registry, gateway, kubelet, port = telemetry_world
+    # budget is 2: two jobs mint series, the third must be dropped
+    for name in ("tele-a", "tele-b", "tele-c"):
+        cluster.jobs.create("default", new_job(workers=1, name=name)
+                            .to_dict())
+    for name in ("tele-a", "tele-b", "tele-c"):
+        assert wait_for(lambda n=name: _job_succeeded(cluster, n),
+                        timeout=30), name
+    # pushes happen as pods complete; wait until the budget counter
+    # proves the third job's samples were refused
+    dropped = registry.dropped_series_counter()
+    assert wait_for(lambda: dropped.value > 0, timeout=10), \
+        "over-budget pushes never hit the dropped-series counter"
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    # pushed step series are exported job-labeled...
+    job_series = re.findall(
+        r'pytorch_operator_job_step_duration_seconds_count'
+        r'\{job="default/(tele-[abc])"\} (\d+)', text)
+    assert job_series, "no pushed step series on /metrics"
+    for _job, count in job_series:
+        assert int(count) >= 1
+    # ...and stay within the configured budget: at most 2 of the 3
+    # jobs minted series, none past the budget leaked into exposition
+    assert len(job_series) == 2
+    tps_jobs = re.findall(
+        r'pytorch_operator_job_tokens_per_second\{job="default/(tele-'
+        r'[abc])"\}', text)
+    assert len(tps_jobs) == 2  # throughput gauges rode along, same cap
+
+    # OpenMetrics scrape: the reconcile histogram carries an exemplar
+    # whose trace id resolves in /debug/traces
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    om = urllib.request.urlopen(req, timeout=5).read().decode()
+    assert om.rstrip().endswith("# EOF")
+    exemplars = re.findall(
+        r'pytorch_operator_reconcile_duration_seconds_bucket\{[^}]*\} '
+        r'\d+ # \{trace_id="([0-9a-f]+)"\}', om)
+    assert exemplars, "no exemplar on the reconcile histogram"
+    traces = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/traces", timeout=5).read())["traces"]
+    trace_ids = {t["span_id"] for t in traces}
+    assert set(exemplars) & trace_ids, (
+        f"no exemplar trace id {exemplars} resolves in /debug/traces")
+    # the plain scrape never leaks exemplar syntax
+    assert "# {trace_id=" not in text
+
+
+def test_artifact_capture_fixture_scrapes_on_failure(tmp_path, monkeypatch):
+    """The conftest flight recorder end to end: a failing test whose
+    world registered a port leaves /metrics + /debug/traces files in
+    $E2E_ARTIFACTS_DIR."""
+    import subprocess
+    import sys as _sys
+    import os as _os
+    import textwrap
+    import uuid
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    # the probe must live under tests/ so the inner pytest loads this
+    # suite's conftest (fixture + capture hook); unique name, removed
+    # in finally so the outer suite never collects it
+    test_file = _os.path.join(
+        repo, "tests", f"_artifact_probe_{uuid.uuid4().hex[:8]}.py")
+    probe_src = textwrap.dedent("""
+        from pytorch_operator_tpu.metrics.prometheus import Registry
+        from pytorch_operator_tpu.metrics.server import start_metrics_server
+        from pytorch_operator_tpu.runtime.tracing import Tracer
+
+        def test_fails(e2e_artifacts):
+            tracer = Tracer()
+            with tracer.trace("reconcile", key="default/x"):
+                pass
+            # NOT shut down before the assert: capture runs from the
+            # makereport hook right after the test body, while fixture
+            # teardown (where a real world stops its server) has not
+            # started; the daemon server dies with the interpreter
+            server = start_metrics_server(Registry(), 0, host="127.0.0.1",
+                                          tracer=tracer)
+            e2e_artifacts["port"] = server.server_address[1]
+            e2e_artifacts["extra"]["state.txt"] = "world state dump"
+            assert False, "deliberate failure"
+    """)
+    artifacts = tmp_path / "artifacts"
+    try:
+        with open(test_file, "w") as f:
+            f.write(probe_src)
+        proc = subprocess.run(
+            [_sys.executable, "-m", "pytest", "-q", "-p",
+             "no:cacheprovider", test_file],
+            cwd=_os.path.join(repo, "tests"),
+            env={**_os.environ, "E2E_ARTIFACTS_DIR": str(artifacts),
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120)
+    finally:
+        if _os.path.exists(test_file):
+            _os.unlink(test_file)
+    assert proc.returncode != 0  # the inner test fails by design
+    assert artifacts.is_dir(), (proc.stdout, proc.stderr)
+    names = sorted(p.name for p in artifacts.iterdir())
+
+    def find(suffix):
+        # file base is the sanitized nodeid (module__test), so two
+        # same-named tests in different modules can't clobber each other
+        matches = [n for n in names if n.endswith(f"test_fails.{suffix}")]
+        assert matches, (suffix, names, proc.stdout)
+        return artifacts / matches[0]
+
+    traces = json.loads(find("traces.json").read_text())
+    assert traces["traces"][0]["name"] == "reconcile"
+    assert "scrape_errors_total" in find("metrics.txt").read_text()
+    assert find("state.txt").read_text() == "world state dump"
