@@ -160,8 +160,29 @@ def build_parser() -> argparse.ArgumentParser:
                    default="12h", help="informer resync period")
     p.add_argument("--init-container-image", default="alpine:3.10",
                    help="image for the worker DNS-wait init container")
-    p.add_argument("--qps", type=float, default=5.0)
-    p.add_argument("--burst", type=int, default=10)
+    p.add_argument("--qps", "--kube-api-qps", dest="qps", type=float,
+                   default=5.0,
+                   help="client-side QPS toward the API server "
+                        "(client-go-style token bucket shared by every "
+                        "request, the create fan-out included; 0 "
+                        "disables pacing)")
+    p.add_argument("--burst", "--kube-api-burst", dest="burst", type=int,
+                   default=10,
+                   help="token-bucket burst size for --kube-api-qps")
+    p.add_argument("--kube-api-retries", type=int, default=4,
+                   help="max attempts per API call for transient "
+                        "failures (429/5xx/connection), with jittered "
+                        "exponential backoff under a per-call deadline; "
+                        "1 or 0 = single-shot (retries off)")
+    p.add_argument("--circuit-breaker-threshold", type=int, default=5,
+                   help="consecutive transient API failures that open "
+                        "the client-side circuit breaker (requests then "
+                        "fail fast and reconciles requeue rate-limited "
+                        "instead of hammering a down apiserver; 0 "
+                        "disables)")
+    p.add_argument("--circuit-breaker-reset", default="5s",
+                   help="how long the breaker stays open before letting "
+                        "one half-open probe through (duration string)")
     p.add_argument("--leader-elect", type=lambda s: s.lower() != "false",
                    default=True, nargs="?", const=True)
     p.add_argument("--fake-cluster", action="store_true",
@@ -219,8 +240,20 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
                 "no API server configured (%s); pass --master/--kubeconfig "
                 "or run with --fake-cluster", e)
             return 1
+        from pytorch_operator_tpu.k8s.resilience import ResilienceConfig
+
+        try:
+            breaker_reset = parse_duration(args.circuit_breaker_reset)
+        except ValueError as e:
+            logger.error("invalid --circuit-breaker-reset: %s", e)
+            return 1
+        resilience = ResilienceConfig(
+            qps=args.qps, burst=args.burst,
+            max_attempts=max(1, args.kube_api_retries),
+            breaker_threshold=max(0, args.circuit_breaker_threshold),
+            breaker_reset=breaker_reset)
         cluster = RestCluster(kube_config, namespace=args.namespace or None,
-                              registry=registry)
+                              registry=registry, resilience=resilience)
         # checkCRDExists (reference server.go:106-109): fail fast when the
         # CRD isn't installed
         if not cluster.check_crd_exists():
@@ -268,7 +301,18 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         synced = controller.informers_synced()
         leading = leader_state["leading"]
         ok = not stop_event.is_set() and (synced if leading else True)
-        return ok, {"leader": leading, "informers_synced": synced}
+        detail = {"leader": leading, "informers_synced": synced}
+        # An open apiserver circuit breaker reports DEGRADED, not
+        # unready: the informer caches still serve and flipping /readyz
+        # to 503 during an apiserver outage would only thrash Service
+        # endpoints while nothing this replica does can help.
+        snapshot = getattr(cluster, "resilience_snapshot", None)
+        if snapshot is not None:
+            breaker = snapshot()
+            detail["circuit_breaker"] = breaker["state"]
+            if breaker["state"] == "open":
+                detail["degraded"] = True
+        return ok, detail
 
     metrics_server = None
     if args.monitoring_port:
@@ -276,8 +320,13 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         if args.enable_push_ingestion:
             from pytorch_operator_tpu.telemetry import PushGateway
 
+            # identity hardening (ROADMAP push item): a pushed sample's
+            # job must name a live PyTorchJob in the informer cache —
+            # unknown jobs are counted under reason="unknown_job" and
+            # never mint a series
             push_gateway = PushGateway(
-                registry, series_budget=args.push_series_budget)
+                registry, series_budget=args.push_series_budget,
+                job_validator=controller.job_informer.store.contains)
         metrics_server = start_metrics_server(
             registry, args.monitoring_port, tracer=tracer,
             health_checks={"healthz": healthz, "readyz": readyz},

@@ -21,7 +21,8 @@ from ..api.v1.types import PyTorchJob
 from ..api.v1.validation import ValidationError, validate_spec
 from ..disruption.handler import DisruptionHandlingMixin
 from ..k8s import serde
-from ..k8s.errors import ConflictError, NotFoundError
+from ..k8s.errors import CircuitOpenError, ConflictError, NotFoundError
+from ..k8s.resilience import RetryPolicy
 from ..metrics import default_registry
 from ..runtime.expectations import (
     expectation_pods_key,
@@ -101,6 +102,12 @@ class PyTorchController(
             "Counts resourceVersion conflicts (409) hit while patching "
             "job status; each costs one base re-read and retry",
         )
+        # Conflict retries ride the same RetryPolicy machinery as the
+        # REST client's transient retries (k8s/resilience.py) — the 409
+        # loop differs only in its hooks: refetch-resourceVersion-and-
+        # re-diff instead of backoff (conflicts are contention, not
+        # outage; sleeping would just widen the stale window).
+        self.status_retry = RetryPolicy(max_attempts=2)
         # One sync_job pass, labeled by how it ended: success (forget),
         # error (requeued with backoff), requeue (retry without an
         # error, e.g. an unparseable key).  The per-result split is what
@@ -210,36 +217,48 @@ class PyTorchController(
         # serialize only .status — this is the hottest write path, and
         # to_dict(job) would re-serde the full pod templates per patch
         new_status = serde.to_dict(job.status)
-        cached = self._get_job_from_cache(namespace, name)
-        for attempt in range(2):
-            old_status = (cached or {}).get("status") or {}
+        base = {"cached": self._get_job_from_cache(namespace, name)}
+
+        def patch_once():
+            old_status = (base["cached"] or {}).get("status") or {}
             diff = status_machine.status_merge_diff(old_status, new_status)
             if not diff:
                 return
             body: dict = {"status": diff}
-            rv = ((cached or {}).get("metadata") or {}).get("resourceVersion")
+            rv = ((base["cached"] or {}).get("metadata") or {}).get(
+                "resourceVersion")
             if rv:
                 body["metadata"] = {"resourceVersion": rv}
             try:
                 self.cluster.jobs.patch(
                     namespace, name, body, subresource="status")
-                return
             except ConflictError:
                 self.status_conflicts_counter.inc()
-                if attempt:
-                    raise
-                fresh = self._get_job_from_cache(namespace, name)
-                fresh_rv = ((fresh or {}).get("metadata") or {}).get(
-                    "resourceVersion")
-                if fresh is not None and fresh_rv != rv:
-                    cached = fresh
-                else:
-                    # cache hasn't observed the conflicting write yet:
-                    # one live read gets the authoritative base
-                    try:
-                        cached = self.cluster.jobs.get(namespace, name)
-                    except NotFoundError:
-                        return  # job deleted under us; nothing to persist
+                raise
+
+        def refetch_base(_err, _attempt):
+            # conflict: re-read the authoritative base so the next
+            # attempt re-diffs against (and preconditions on) the
+            # winner's resourceVersion
+            rv = ((base["cached"] or {}).get("metadata") or {}).get(
+                "resourceVersion")
+            fresh = self._get_job_from_cache(namespace, name)
+            fresh_rv = ((fresh or {}).get("metadata") or {}).get(
+                "resourceVersion")
+            if fresh is not None and fresh_rv != rv:
+                base["cached"] = fresh
+            else:
+                # cache hasn't observed the conflicting write yet:
+                # one live read gets the authoritative base
+                base["cached"] = self.cluster.jobs.get(namespace, name)
+
+        try:
+            self.status_retry.run(
+                patch_once,
+                retryable=lambda e: isinstance(e, ConflictError),
+                on_retry=refetch_base, backoff=False)
+        except NotFoundError:
+            return  # job deleted under us; nothing to persist
 
     # -- disruption hooks --------------------------------------------------
     def update_pod(self, old_pod: dict, new_pod: dict) -> None:
@@ -307,6 +326,18 @@ class PyTorchController(
                 exemplar={"trace_id": tspan.trace_id})
             if err is None and forget:
                 self.work_queue.forget(key)
+            elif isinstance(err, CircuitOpenError):
+                # the apiserver breaker is open: pace this key at the
+                # breaker's half-open cadence instead of rate-limited —
+                # every fail-fast would otherwise count as a backoff
+                # strike, and the per-key exponential would overshoot
+                # the apiserver's recovery by multiples of the outage
+                logger_for_key(self.logger, key).warning(
+                    "apiserver circuit open; requeueing %s in %.2fs",
+                    key, err.retry_in or 1.0)
+                self.work_queue.forget(key)
+                self.work_queue.add_after(key, max(0.05, err.retry_in
+                                                   or 1.0))
             elif err is not None:
                 logger_for_key(self.logger, key).warning(
                     "reconcile error for %s: %s", key, err)

@@ -27,7 +27,7 @@ from ..runtime.job_controller import _controller_ref_of
 from ..runtime.logger import logger_for_job
 from ..runtime.recorder import EVENT_TYPE_WARNING
 from .detector import pod_disruption_reason
-from .watcher import DisruptionWatcher
+from .watcher import DisruptionWatcher, PodNodeIndex
 
 
 class DisruptionHandlingMixin:
@@ -59,9 +59,13 @@ class DisruptionHandlingMixin:
         self.disruption_watcher: Optional[DisruptionWatcher] = None
         if self.config.enable_disruption_handling and \
                 self.node_informer is not None:
+            # nodeName index over the pod informer (ROADMAP scalability
+            # item): a disrupted node resolves its pods in one dict hit
+            # instead of a cluster-wide LIST per node event
             self.disruption_watcher = DisruptionWatcher(
                 self.cluster, self.node_informer, self._note_disruption,
-                kind=self.KIND)
+                kind=self.KIND,
+                pod_index=PodNodeIndex(self.pod_informer))
 
     def disruption_handling_enabled(self) -> bool:
         return self.config.enable_disruption_handling

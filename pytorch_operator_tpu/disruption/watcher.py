@@ -19,11 +19,86 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Set
 
+from ..runtime.informer import meta_namespace_key
 from .detector import node_disruption_reason
 
 _log = logging.getLogger(__name__)
+
+
+class PodNodeIndex:
+    """``spec.nodeName`` -> pod-key index over the pod informer store.
+
+    The watcher used to LIST pods cluster-wide per disrupted node (fine
+    at sim scale, O(pods) per node event at fleet scale — the ROADMAP
+    scalability item).  This index rides the pod informer's event
+    stream instead: adds/updates move the pod between per-node buckets
+    (binding arrives as a MODIFIED patch after the ADDED, so moves are
+    the common path), deletes drop it, and lookup resolves keys back
+    through the informer store — one dict hit per disrupted node
+    instead of a cluster-wide scan, and no extra apiserver traffic.
+    """
+
+    def __init__(self, informer):
+        self._store = informer.store
+        self._lock = threading.Lock()
+        self._keys_by_node: Dict[str, Set[str]] = {}
+        self._node_of_key: Dict[str, str] = {}
+        informer.add_event_handler(
+            on_add=self._upsert,
+            on_update=lambda _old, new: self._upsert(new),
+            on_delete=self._remove,
+        )
+
+    def _upsert(self, pod: dict) -> None:
+        # the informer store's OWN key function — divergent key logic
+        # here would silently fail every pods_on() store lookup
+        key = meta_namespace_key(pod)
+        node = (pod.get("spec") or {}).get("nodeName") or None
+        with self._lock:
+            prev = self._node_of_key.get(key)
+            if prev == node:
+                return
+            if prev is not None:
+                bucket = self._keys_by_node.get(prev)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._keys_by_node[prev]
+            if node is None:
+                self._node_of_key.pop(key, None)
+            else:
+                self._node_of_key[key] = node
+                self._keys_by_node.setdefault(node, set()).add(key)
+
+    def _remove(self, pod: dict) -> None:
+        key = meta_namespace_key(pod)
+        with self._lock:
+            node = self._node_of_key.pop(key, None)
+            if node is not None:
+                bucket = self._keys_by_node.get(node)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._keys_by_node[node]
+
+    def pods_on(self, node_name: str) -> List[dict]:
+        """Pods currently bound to the node (resolved live from the
+        informer store, so callers see fresh objects, not index-time
+        snapshots)."""
+        with self._lock:
+            keys = list(self._keys_by_node.get(node_name, ()))
+        pods = []
+        for key in keys:
+            obj = self._store.get_by_key(key)
+            if obj is not None:
+                pods.append(obj)
+        return pods
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._keys_by_node)
 
 
 class DisruptionWatcher:
@@ -33,14 +108,19 @@ class DisruptionWatcher:
         informer,
         on_job_disruption: Callable[..., None],
         kind: str = "PyTorchJob",
+        pod_index: Optional[PodNodeIndex] = None,
     ):
         """``informer`` is a runtime.Informer over ``cluster.nodes``;
         the watcher registers its handlers but leaves start/stop to the
-        controller's informer lifecycle."""
+        controller's informer lifecycle.  ``pod_index`` (a PodNodeIndex
+        over the pod informer) resolves a disrupted node's pods in one
+        dict hit; without it the watcher falls back to the original
+        cluster-wide pod LIST per node event."""
         self.cluster = cluster
         self.informer = informer
         self.on_job_disruption = on_job_disruption
         self.kind = kind
+        self.pod_index = pod_index
         self._lock = threading.Lock()
         self._flagged: Dict[str, str] = {}  # node name -> last fired reason
         informer.add_event_handler(
@@ -99,9 +179,13 @@ class DisruptionWatcher:
         note against a delete-recreate of the same key."""
         pairs = []
         seen = set()
-        for pod in self.cluster.pods.list():
-            if (pod.get("spec") or {}).get("nodeName") != node_name:
-                continue
+        if self.pod_index is not None:
+            candidates = self.pod_index.pods_on(node_name)
+        else:
+            candidates = [p for p in self.cluster.pods.list()
+                          if (p.get("spec") or {}).get("nodeName")
+                          == node_name]
+        for pod in candidates:
             meta = pod.get("metadata") or {}
             ref = self._controller_ref(meta)
             if ref is None:

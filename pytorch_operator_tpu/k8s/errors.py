@@ -1,6 +1,19 @@
-"""Kubernetes-style API errors shared by the real and fake clients."""
+"""Kubernetes-style API errors shared by the real and fake clients.
+
+Error classes mirror the apiserver's status-code vocabulary; the
+``code`` attribute is the HTTP status, set per instance for statuses
+without a dedicated class.  :func:`is_transient` is the one place the
+retry/breaker layer (k8s/resilience.py) asks "could a retry succeed":
+429 throttling, 5xx server errors, and pre-send connection failures
+qualify; 404/409/422 are definitive answers from a healthy server and
+never retried blindly (conflict handling re-reads and re-diffs at the
+controller layer instead).
+"""
 
 from __future__ import annotations
+
+from http.client import HTTPException
+from typing import Optional
 
 
 class ApiError(Exception):
@@ -29,9 +42,112 @@ class InvalidError(ApiError):
     code = 422
 
 
+class TooManyRequestsError(ApiError):
+    """429: the apiserver is shedding load (priority & fairness,
+    max-inflight).  ``retry_after`` carries the server's Retry-After
+    hint in seconds (None when the response had no header, e.g. over
+    the native transport, which surfaces status+body only)."""
+
+    code = 429
+
+    def __init__(self, message: str = "",
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class InternalServerError(ApiError):
+    """500 InternalError — commonly a transient etcd hiccup."""
+
+    code = 500
+
+
+class ServiceUnavailableError(ApiError):
+    """503 ServiceUnavailable — apiserver restarting / LB draining
+    (the master-upgrade signature)."""
+
+    code = 503
+
+
+class ServerTimeoutError(ApiError):
+    """504 ServerTimeout/Timeout — the request may or may not have been
+    applied; only idempotent-safe retries are allowed."""
+
+    code = 504
+
+
+class CircuitOpenError(ApiError):
+    """Raised client-side, without touching the wire, while the
+    consecutive-failure circuit breaker is open.  Deliberately NOT
+    transient for the retry loop: the whole point of the breaker is to
+    fail fast and let the controller pace retries at the breaker's
+    cadence.  ``retry_in`` carries the seconds until the breaker's next
+    half-open probe — the controller requeues the job after that delay
+    instead of rate-limited, because each fail-fast would otherwise
+    count as a backoff strike and the per-key exponential would
+    overshoot the apiserver's recovery by far more than the outage
+    itself."""
+
+    code = 503
+
+    def __init__(self, message: str = "",
+                 retry_in: Optional[float] = None):
+        super().__init__(message)
+        self.retry_in = retry_in
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError)
 
 
 def is_already_exists(err: Exception) -> bool:
     return isinstance(err, AlreadyExistsError)
+
+
+def is_transient(err: Exception) -> bool:
+    """True when a retry of the same call could plausibly succeed:
+    429 throttling, any 5xx, or a connection-level failure (refused,
+    reset, timeout, broken framing) where the response never arrived."""
+    if isinstance(err, CircuitOpenError):
+        return False
+    if isinstance(err, ApiError):
+        code = getattr(err, "code", 0)
+        return code == 429 or 500 <= code < 600
+    return isinstance(err, (OSError, HTTPException))
+
+
+def transient_reason(err: Exception) -> str:
+    """Label value classifying a transient error for the retry metric:
+    ``throttled`` (429), ``server_error`` (5xx), ``connection``
+    (never got a response)."""
+    if isinstance(err, TooManyRequestsError):
+        return "throttled"
+    if isinstance(err, ApiError):
+        return "server_error"
+    return "connection"
+
+
+def error_for_status(status: int, message: str,
+                     retry_after: Optional[float] = None) -> ApiError:
+    """Map an HTTP status to the matching ApiError subclass (shared by
+    the REST client's _raise_for and the fault injector, so both raise
+    identically classified errors)."""
+    if status == 404:
+        return NotFoundError(message)
+    if status == 409:
+        if "already exists" in message:
+            return AlreadyExistsError(message)
+        return ConflictError(message)
+    if status in (400, 422):
+        return InvalidError(message)
+    if status == 429:
+        return TooManyRequestsError(message, retry_after=retry_after)
+    if status == 500:
+        return InternalServerError(message)
+    if status == 503:
+        return ServiceUnavailableError(message)
+    if status == 504:
+        return ServerTimeoutError(message)
+    err = ApiError(f"HTTP {status}: {message}")
+    err.code = status
+    return err

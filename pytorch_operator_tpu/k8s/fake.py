@@ -70,6 +70,7 @@ class FakeResourceStore:
 
     # -- CRUD --------------------------------------------------------------
     def create(self, namespace: str, obj: dict) -> dict:
+        self._cluster.maybe_fault("create", self.kind)
         with self._cluster.lock:
             obj = copy.deepcopy(obj)
             meta = obj.setdefault("metadata", {})
@@ -93,6 +94,7 @@ class FakeResourceStore:
             return copy.deepcopy(obj)
 
     def get(self, namespace: str, name: str) -> dict:
+        self._cluster.maybe_fault("get", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             if key not in self._objects:
@@ -104,6 +106,7 @@ class FakeResourceStore:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[dict]:
+        self._cluster.maybe_fault("list", self.kind)
         with self._cluster.lock:
             out = []
             for (ns, _), obj in sorted(self._objects.items()):
@@ -115,6 +118,7 @@ class FakeResourceStore:
 
     def update(self, obj: dict, subresource: Optional[str] = None) -> dict:
         """Replace an object; enforces resourceVersion optimistic locking."""
+        self._cluster.maybe_fault("update", self.kind)
         with self._cluster.lock:
             obj = copy.deepcopy(obj)
             meta = obj.get("metadata") or {}
@@ -154,6 +158,7 @@ class FakeResourceStore:
         precondition is honored, everything else outside status is
         ignored), so the sim and http tiers exercise the same
         merge-patch + conflict-retry path the controller ships."""
+        self._cluster.maybe_fault("patch", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             existing = self._objects.get(key)
@@ -176,6 +181,7 @@ class FakeResourceStore:
             return copy.deepcopy(new_obj)
 
     def delete(self, namespace: str, name: str) -> None:
+        self._cluster.maybe_fault("delete", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             obj = self._objects.pop(key, None)
@@ -233,9 +239,15 @@ class FakeCluster:
         "nodes": "Node",
     }
 
-    def __init__(self):
+    def __init__(self, fault_plan=None):
         self.lock = threading.RLock()
         self._rv = 0
+        # k8s/faults.FaultPlan (assignable after construction): CRUD
+        # calls consult it and raise the classified transient errors —
+        # the sim tier's apiserver chaos.  "after" faults and watch
+        # resets are http-tier-only (the fake's listeners are
+        # synchronous calls; there is no response framing to tear).
+        self.fault_plan = fault_plan
         self.stores: Dict[str, FakeResourceStore] = {
             plural: FakeResourceStore(self, kind) for plural, kind in self.KINDS.items()
         }
@@ -243,6 +255,30 @@ class FakeCluster:
     def next_rv(self) -> int:
         self._rv += 1
         return self._rv
+
+    def maybe_fault(self, verb: str, resource: str) -> None:
+        """Execute one CRUD call's injected fault (latency and/or a
+        raised transient error).  Called BEFORE the store mutates and
+        outside the cluster lock, so injected latency cannot serialize
+        unrelated stores and an injected error never half-applies."""
+        plan = self.fault_plan
+        if plan is None:
+            return
+        if plan.error_when == "after":
+            # loud, not silent: the torn-response (commit-then-fail)
+            # case needs response framing to tear — only the stub
+            # server models that.  Downgrading to a before-fault here
+            # would run a DIFFERENT scenario than the test asked for
+            # while its snapshot still claimed the error was injected.
+            raise ValueError(
+                "FaultPlan(error_when='after') is http-tier-only "
+                "(StubApiServer); FakeCluster CRUD has no response to "
+                "tear after the commit")
+        fault = plan.on_request(verb, resource)
+        if fault.delay:
+            time.sleep(fault.delay)
+        if fault.error is not None:
+            raise fault.error
 
     def resource(self, plural: str) -> FakeResourceStore:
         """Store for ``plural``.  Unknown plurals raise (KeyError →

@@ -1,0 +1,191 @@
+"""Apiserver fault injection: the chaos plan the stub server and the
+in-memory fake execute.
+
+The disruption subsystem (PR 2) injects faults at the node/pod layer;
+this module injects them at the layer real outages actually hit first —
+the API server itself (master upgrades, etcd hiccups, priority &
+fairness shedding).  A :class:`FaultPlan` is a deterministic, seeded
+schedule of:
+
+  * **transient errors** — a per-verb error rate returning 5xx
+    (``error_code``), optionally AFTER the mutation committed
+    (``error_when="after"``: the torn-response case where a create
+    lands but its 201 never arrives — the scenario the retry layer's
+    AlreadyExists-resolves-as-success rule exists for);
+  * **latency** — fixed injected delay per matching request;
+  * **a 429 burst** — after ``throttle_after`` total requests, the next
+    ``throttle_burst`` requests are answered 429 with a Retry-After of
+    ``retry_after_s`` (apiserver max-inflight shedding);
+  * **an outage window** — once request number ``outage_at_request``
+    arrives, every matching verb is answered 503 for
+    ``outage_duration_s`` wall seconds (the master-upgrade blip: writes
+    fail wholesale, then the server comes back).  This is the fault
+    class that separates in-call retries from workqueue backoff: a
+    client that retries with backoff rides THROUGH the window inside
+    the call, while a single-shot client burns a failed reconcile per
+    attempt and its exponential requeue backoff overshoots the
+    recovery;
+  * **watch resets** — every ``watch_reset_every``-th watch event is
+    truncated mid-line and the stream torn down without a clean chunked
+    EOF, so the client sees a framing error, declares a GAP, and must
+    relist to heal.
+
+Consumers: ``StubApiServer(fault_plan=...)`` (the http tier — faults
+surface as real HTTP responses, Retry-After headers included) and
+``FakeCluster(fault_plan=...)`` (the sim tier — CRUD raises the
+classified errors directly; ``after`` faults and watch resets are
+http-tier-only, since the fake's listeners are synchronous function
+calls with no stream to tear).  ``snapshot()`` reports what was
+actually injected, so benches and tests assert against the achieved
+fault load, not the requested one.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+from .errors import ApiError, error_for_status
+
+#: Verbs a FaultPlan can target (watch is addressed separately through
+#: the reset schedule, not the error rate).
+MUTATING_VERBS = ("create", "update", "patch", "delete")
+
+
+class Fault:
+    """One injected behavior for one request."""
+
+    __slots__ = ("delay", "error", "when")
+
+    def __init__(self, delay: float = 0.0,
+                 error: Optional[ApiError] = None, when: str = "before"):
+        self.delay = delay
+        self.error = error
+        self.when = when  # "before" | "after" (after = commit, then fail)
+
+    def __bool__(self) -> bool:
+        return bool(self.delay or self.error)
+
+
+class FaultPlan:
+    def __init__(self, *,
+                 error_rate: float = 0.0,
+                 error_verbs: Sequence[str] = MUTATING_VERBS,
+                 error_code: int = 503,
+                 error_when: str = "before",
+                 latency_s: float = 0.0,
+                 latency_verbs: Optional[Sequence[str]] = None,
+                 throttle_after: Optional[int] = None,
+                 throttle_burst: int = 0,
+                 retry_after_s: float = 0.5,
+                 outage_at_request: Optional[int] = None,
+                 outage_duration_s: float = 0.0,
+                 outage_verbs: Sequence[str] = MUTATING_VERBS,
+                 watch_reset_every: int = 0,
+                 seed: int = 0,
+                 clock=None):
+        """``latency_verbs=None`` applies ``latency_s`` to every verb.
+        One RNG seeded with ``seed`` drives the error coin-flips, so a
+        plan replays identically run-to-run (modulo request ordering
+        under concurrency)."""
+        if error_when not in ("before", "after"):
+            raise ValueError(f"error_when must be before|after, "
+                             f"got {error_when!r}")
+        self.error_rate = float(error_rate)
+        self.error_verbs = frozenset(error_verbs)
+        self.error_code = int(error_code)
+        self.error_when = error_when
+        self.latency_s = float(latency_s)
+        self.latency_verbs = (None if latency_verbs is None
+                              else frozenset(latency_verbs))
+        self.throttle_after = throttle_after
+        self.throttle_burst = int(throttle_burst)
+        self.retry_after_s = float(retry_after_s)
+        self.outage_at_request = outage_at_request
+        self.outage_duration_s = float(outage_duration_s)
+        self.outage_verbs = frozenset(outage_verbs)
+        self.watch_reset_every = int(watch_reset_every)
+        self._clock = clock or time.monotonic
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._throttled_remaining = 0
+        self._throttle_armed = throttle_after is not None
+        self._outage_until: Optional[float] = None
+        self._watch_events = 0
+        self._injected: Dict[str, int] = {
+            "errors": 0, "throttled": 0, "latency": 0, "outage": 0,
+            "watch_resets": 0}
+
+    # -- request-path injection -------------------------------------------
+    def on_request(self, verb: str, resource: str = "") -> Fault:
+        """Consulted once per request by the serving side; returns the
+        Fault to execute (falsy = serve normally).  The 429 burst takes
+        precedence over the error coin-flip — a shedding apiserver
+        answers 429 before its handlers ever run."""
+        with self._lock:
+            self._requests += 1
+            if (self.outage_at_request is not None
+                    and self._outage_until is None
+                    and self._requests >= self.outage_at_request):
+                self._outage_until = self._clock() + self.outage_duration_s
+            if (self._outage_until is not None
+                    and self._clock() < self._outage_until
+                    and verb in self.outage_verbs):
+                self._injected["outage"] += 1
+                return Fault(error=error_for_status(
+                    503, f"apiserver outage window (injected) on "
+                         f"{verb} {resource}"))
+            if self._throttle_armed and \
+                    self._requests > self.throttle_after:
+                self._throttle_armed = False
+                self._throttled_remaining = self.throttle_burst
+            if self._throttled_remaining > 0:
+                self._throttled_remaining -= 1
+                self._injected["throttled"] += 1
+                return Fault(error=error_for_status(
+                    429, "too many requests (injected burst)",
+                    retry_after=self.retry_after_s))
+            delay = 0.0
+            if self.latency_s > 0 and (self.latency_verbs is None
+                                       or verb in self.latency_verbs):
+                delay = self.latency_s
+                self._injected["latency"] += 1
+            if (self.error_rate > 0 and verb in self.error_verbs
+                    and self._rng.random() < self.error_rate):
+                self._injected["errors"] += 1
+                return Fault(delay=delay, error=error_for_status(
+                    self.error_code,
+                    f"injected {self.error_code} on {verb} {resource}"),
+                    when=self.error_when)
+            return Fault(delay=delay)
+
+    def arm_throttle_burst(self, burst: int,
+                           retry_after_s: Optional[float] = None) -> None:
+        """Re-arm a one-shot 429 burst starting with the NEXT request
+        (tests drive multi-phase scenarios — e.g. a 429 answered to the
+        breaker's half-open probe — without rebuilding the plan)."""
+        with self._lock:
+            self._throttle_armed = False
+            self._throttled_remaining = int(burst)
+            if retry_after_s is not None:
+                self.retry_after_s = float(retry_after_s)
+
+    # -- watch-path injection ---------------------------------------------
+    def on_watch_event(self) -> bool:
+        """True when THIS watch event should be truncated mid-line and
+        its stream torn down (counted across all streams)."""
+        if self.watch_reset_every <= 0:
+            return False
+        with self._lock:
+            self._watch_events += 1
+            if self._watch_events % self.watch_reset_every == 0:
+                self._injected["watch_resets"] += 1
+                return True
+        return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"requests": self._requests, **self._injected}

@@ -1,0 +1,378 @@
+"""Client-side apiserver resilience: retries, flow control, breaking.
+
+The reference operator inherits all three from client-go (request retry
+via the rest.Request machinery, QPS/burst rate limiting via
+``flowcontrol.NewTokenBucketRateLimiter`` — client-go's default 5 qps /
+10 burst — and relist-on-watch-failure); our from-scratch REST client
+was single-shot.  This module supplies the missing pieces as small,
+independently-testable primitives that ``k8s/rest.py`` composes:
+
+  * :class:`RetryPolicy` — jittered exponential backoff with a
+    per-call deadline; also the generic bounded-attempt executor
+    (:meth:`RetryPolicy.run`) the controller's status-conflict path
+    rides, so transient handling and conflict handling share one code
+    path.
+  * :class:`TokenBucket` — client-go-style QPS/burst limiter shared by
+    every request the client issues (the create fan-out's concurrent
+    workers all drain the same bucket), with a ``pause_for`` hook the
+    429 handler uses to push the whole client past a Retry-After.
+  * :class:`CircuitBreaker` — consecutive-transient-failure breaker:
+    open means requests fail fast with ``CircuitOpenError`` (reconciles
+    requeue rate-limited instead of hammering a down apiserver, while
+    informers keep serving their stores); after ``reset_timeout`` one
+    half-open probe is let through — success closes, failure re-opens.
+  * :class:`ResilienceMetrics` — the retry/throttle/breaker metric
+    families on the operator registry.
+
+Every primitive takes injectable ``clock``/``sleep``/``rand`` so the
+unit tier (tests/test_resilience.py) is deterministic.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .errors import CircuitOpenError
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs for one client's resilience layer; zero values disable the
+    matching piece (``qps=0`` = unlimited, ``max_attempts<=1`` =
+    single-shot, ``breaker_threshold=0`` = no breaker).  Library
+    defaults keep the limiter OFF — tests and benches construct
+    RestCluster directly and must not be paced — while the operator CLI
+    passes client-go-style 5 qps / 10 burst from --kube-api-qps/-burst."""
+
+    qps: float = 0.0
+    burst: int = 10
+    max_attempts: int = 4
+    base_backoff: float = 0.05
+    max_backoff: float = 2.0
+    deadline: float = 30.0
+    breaker_threshold: int = 5
+    breaker_reset: float = 5.0
+
+
+class RetryPolicy:
+    """Bounded attempts with jittered exponential backoff and a
+    per-call wall-clock deadline.
+
+    ``backoff(attempt)`` is ``min(max_backoff, base * 2^attempt)``
+    scaled by a uniform factor in ``[1 - jitter, 1]`` — jitter shrinks
+    the delay, never grows it, so the cap is honored and synchronized
+    retry storms (every fan-out worker failing at once) de-correlate.
+    """
+
+    def __init__(self, max_attempts: int = 4, base_backoff: float = 0.05,
+                 max_backoff: float = 2.0, deadline: float = 30.0,
+                 jitter: float = 0.5, *,
+                 rand: Callable[[], float] = random.random,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_backoff = float(base_backoff)
+        self.max_backoff = float(max_backoff)
+        self.deadline = float(deadline)
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self._rand = rand
+        self._sleep = sleep
+        self._clock = clock
+
+    def backoff(self, attempt: int) -> float:
+        cap = min(self.max_backoff, self.base_backoff * (2 ** attempt))
+        return cap * (1.0 - self.jitter * self._rand())
+
+    def start_deadline(self) -> float:
+        """Absolute deadline for one logical call starting now."""
+        return self._clock() + self.deadline
+
+    def sleep_before_retry(self, attempt: int, deadline: float,
+                           at_least: float = 0.0) -> bool:
+        """Sleep the attempt's backoff (at least ``at_least`` — the
+        429 Retry-After hint); False when the sleep would cross the
+        deadline (caller gives up instead of sleeping uselessly)."""
+        delay = max(self.backoff(attempt), at_least)
+        if self._clock() + delay > deadline:
+            return False
+        if delay > 0:
+            self._sleep(delay)
+        return True
+
+    def run(self, fn: Callable, *, retryable: Callable[[Exception], bool],
+            on_retry: Optional[Callable[[Exception, int], None]] = None,
+            max_attempts: Optional[int] = None,
+            backoff: bool = True):
+        """Generic bounded-attempt executor: call ``fn`` until it
+        succeeds, an error fails ``retryable``, attempts run out, or
+        the deadline would be crossed.  ``on_retry(err, attempt)`` runs
+        before each retry (the controller's conflict path refetches the
+        resourceVersion base there); whatever it raises propagates and
+        ends the loop."""
+        attempts = max_attempts if max_attempts is not None \
+            else self.max_attempts
+        deadline = self.start_deadline()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not retryable(e) or attempt + 1 >= attempts:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                if backoff and not self.sleep_before_retry(attempt, deadline):
+                    raise
+                attempt += 1
+
+
+class TokenBucket:
+    """client-go-style QPS/burst limiter.  ``acquire()`` blocks until a
+    token is available and returns the seconds waited; ``pause_for``
+    pushes the whole bucket's next-available time forward (the 429
+    Retry-After hook — every thread sharing the client waits it out,
+    not just the one that saw the 429).  ``qps <= 0`` disables the
+    bucket entirely — acquire returns immediately and pauses are
+    ignored (the shipped wiring never builds a bucket for unlimited
+    clients; their 429s are handled by the retry backoff alone)."""
+
+    def __init__(self, qps: float, burst: int = 10, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._clock = clock
+        self._sleep = sleep
+        self._last = clock()
+        self._pause_until = 0.0
+        self._lock = threading.Lock()
+
+    def acquire(self) -> float:
+        if self.qps <= 0:
+            return 0.0
+        waited = 0.0
+        while True:
+            with self._lock:
+                now = self._clock()
+                self._tokens = min(
+                    float(self.burst),
+                    self._tokens + (now - self._last) * self.qps)
+                self._last = now
+                wait = self._pause_until - now
+                if wait <= 0:
+                    # epsilon-tolerant take + floored wait: refill math
+                    # leaves float residue (tokens = 0.99999...), and a
+                    # computed wait below the clock's resolution would
+                    # spin forever without advancing the bucket
+                    if self._tokens >= 1.0 - 1e-9:
+                        self._tokens = max(0.0, self._tokens - 1.0)
+                        return waited
+                    wait = max((1.0 - self._tokens) / self.qps, 1e-6)
+            self._sleep(wait)  # outside the lock: no convoy
+            waited += wait
+
+    def pause_for(self, seconds: float) -> None:
+        with self._lock:
+            self._pause_until = max(self._pause_until,
+                                    self._clock() + float(seconds))
+
+
+class CircuitBreaker:
+    """Consecutive-transient-failure breaker with a half-open probe.
+
+    closed -> open after ``threshold`` consecutive failures; while open
+    ``allow()`` returns False (the caller raises CircuitOpenError
+    without touching the wire); after ``reset_timeout`` the state turns
+    half-open and exactly ONE caller is admitted as the probe — its
+    success closes the breaker, its failure re-opens it (and restarts
+    the reset clock).  Any successful response (including a 404/409 —
+    the server answered, it is alive) resets the failure count.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+    _STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, threshold: int = 5, reset_timeout: float = 5.0, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str], None]] = None):
+        self.threshold = max(1, int(threshold))
+        self.reset_timeout = float(reset_timeout)
+        self._clock = clock
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _transition(self, to: str) -> None:
+        # lock held by caller
+        if self._state == to:
+            return
+        self._state = to
+        hook = self.on_transition
+        if hook is not None:
+            try:
+                hook(to)
+            except Exception:
+                pass
+
+    def allow(self) -> bool:
+        """True when a request may go out; flips open -> half-open once
+        the reset timeout elapsed (admitting one probe)."""
+        with self._lock:
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at >= self.reset_timeout:
+                    self._transition(self.HALF_OPEN)
+                    self._probing = False
+                else:
+                    return False
+            if self._state == self.HALF_OPEN:
+                if self._probing:
+                    return False
+                self._probing = True
+            return True
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._transition(self.CLOSED)
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            self._failures += 1
+            if self._state == self.HALF_OPEN or \
+                    self._failures >= self.threshold:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
+    def release_probe(self) -> None:
+        """Release an admitted probe slot WITHOUT recording an outcome —
+        the escape hatch for exception paths that are neither a server
+        answer nor a classified connection failure (an unexpected local
+        error between allow() and the breaker accounting must not latch
+        ``_probing`` and wedge the client in half-open forever)."""
+        with self._lock:
+            self._probing = False
+
+    def remaining_open(self) -> float:
+        """Seconds until the next half-open probe is admitted (0 when
+        not open) — the requeue hint CircuitOpenError carries."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.reset_timeout
+                       - (self._clock() - self._opened_at))
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface the would-be half-open transition to observers
+            if self._state == self.OPEN and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                return self.HALF_OPEN
+            return self._state
+
+    def state_code(self) -> int:
+        """0 closed / 1 half-open / 2 open — the gauge encoding."""
+        return self._STATE_CODES[self.state]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._failures,
+                    "threshold": self.threshold,
+                    "reset_timeout_s": self.reset_timeout}
+
+
+class ResilienceMetrics:
+    """The retry/throttle/breaker families on ``registry`` (the same
+    registry carrying the REST latency histogram, so one scrape answers
+    'is the control plane healthy AND what is the client doing about
+    it')."""
+
+    #: token-bucket / Retry-After waits are sub-second by design;
+    #: the tail buckets catch a pathological pause pile-up
+    THROTTLE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, registry, breaker: Optional[CircuitBreaker] = None):
+        self.retries = registry.counter_vec(
+            "pytorch_operator_rest_retries_total",
+            "Kubernetes API request retries, by verb and error class "
+            "(throttled=429, server_error=5xx, connection=no response)",
+            ("verb", "reason"))
+        self.retry_exhausted = registry.counter_vec(
+            "pytorch_operator_rest_retry_exhausted_total",
+            "Requests that still failed transiently after every retry "
+            "attempt (or whose backoff would cross the per-call "
+            "deadline), by verb",
+            ("verb",))
+        self.throttle_wait = registry.histogram(
+            "pytorch_operator_rest_throttle_wait_seconds",
+            "Seconds a request spent blocked in the client-side "
+            "QPS/burst token bucket (including 429 Retry-After pauses); "
+            "unblocked acquisitions are not observed",
+            buckets=self.THROTTLE_BUCKETS)
+        state_gauge = registry.gauge(
+            "pytorch_operator_circuit_breaker_state",
+            "Apiserver circuit-breaker state: 0 closed, 1 half-open, "
+            "2 open (open = requests fail fast client-side)")
+        self.transitions = registry.counter_vec(
+            "pytorch_operator_circuit_breaker_transitions_total",
+            "Circuit-breaker state transitions, by target state",
+            ("to",))
+        if breaker is not None:
+            state_gauge.set_function(breaker.state_code)
+            breaker.on_transition = (
+                lambda to: self.transitions.labels(to=to).inc())
+
+    def count_retry(self, verb: str, reason: str) -> None:
+        self.retries.labels(verb=verb, reason=reason).inc()
+
+    def count_exhausted(self, verb: str) -> None:
+        self.retry_exhausted.labels(verb=verb).inc()
+
+    def observe_throttle_wait(self, seconds: float) -> None:
+        if seconds > 0:
+            self.throttle_wait.observe(seconds)
+
+
+def build(config: Optional[ResilienceConfig], registry=None):
+    """(retry_policy, rate_limiter, breaker, metrics) for one client —
+    each piece independently None when its knob disables it.  ``None``
+    config means 'all defaults' (retries + breaker on, limiter off)."""
+    config = config or ResilienceConfig()
+    policy = None
+    if config.max_attempts > 1:
+        policy = RetryPolicy(
+            max_attempts=config.max_attempts,
+            base_backoff=config.base_backoff,
+            max_backoff=config.max_backoff,
+            deadline=config.deadline)
+    limiter = TokenBucket(config.qps, config.burst) \
+        if config.qps > 0 else None
+    breaker = CircuitBreaker(config.breaker_threshold,
+                             config.breaker_reset) \
+        if config.breaker_threshold > 0 else None
+    metrics = ResilienceMetrics(registry, breaker) \
+        if registry is not None else None
+    return policy, limiter, breaker, metrics
+
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "ResilienceConfig",
+    "ResilienceMetrics",
+    "RetryPolicy",
+    "TokenBucket",
+    "build",
+]

@@ -28,10 +28,15 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .errors import (
     AlreadyExistsError,
     ApiError,
-    ConflictError,
-    InvalidError,
+    CircuitOpenError,
     NotFoundError,
+    TooManyRequestsError,
+    error_for_status,
+    is_transient,
+    transient_reason,
 )
+from .resilience import ResilienceConfig
+from . import resilience as _resilience
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -161,9 +166,23 @@ class RestClient:
     and `PYTORCH_OPERATOR_NATIVE=0` forces it everywhere.
     """
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+    def __init__(self, config: KubeConfig, timeout: float = 30.0, *,
+                 retry_policy=None, rate_limiter=None, breaker=None,
+                 metrics=None):
+        """``retry_policy``/``rate_limiter``/``breaker``/``metrics`` are
+        the resilience layer (k8s/resilience.py), each independently
+        optional: transient failures retried with jittered backoff under
+        a per-call deadline, every request paced by the shared
+        QPS/burst token bucket, and a consecutive-failure circuit
+        breaker that fails fast while the apiserver is down.  Watch
+        streams and the log endpoints bypass all three — they have their
+        own reconnect loop and must not drain the request budget."""
         self.config = config
         self.timeout = timeout
+        self.retry_policy = retry_policy
+        self.rate_limiter = rate_limiter
+        self.breaker = breaker
+        self.metrics = metrics
         self.native = None
         from pytorch_operator_tpu import native as _native
 
@@ -211,27 +230,131 @@ class RestClient:
             h["Authorization"] = f"Bearer {self.config.token}"
         return h
 
-    def request(self, method: str, path: str, body: Optional[dict] = None,
-                content_type: str = "application/json") -> dict:
-        headers = self._headers(content_type if body is not None else None)
-        payload = json.dumps(body) if body is not None else None
+    _VERB_OF_METHOD = {"POST": "create", "GET": "get", "PUT": "update",
+                       "PATCH": "patch", "DELETE": "delete"}
+
+    def _send_once(self, method: str, path: str, payload: Optional[str],
+                   headers: Dict[str, str]):
+        """One wire round-trip -> (status, data, retry_after_seconds).
+        Retry-After is parseable only on the Python transport (the
+        native transport surfaces status+body; the backoff schedule
+        covers a header-less 429)."""
         if self.native is not None:
             status, data = self.native.request(
                 method, path, headers=headers,
                 body=payload.encode() if payload is not None else None)
-            if status >= 400:
-                self._raise_for(status, data)
-            return json.loads(data) if data else {}
+            return status, data, None
         conn = self._connect()
         try:
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
-            if resp.status >= 400:
-                self._raise_for(resp.status, data)
-            return json.loads(data) if data else {}
+            retry_after = None
+            if resp.status == 429:
+                try:
+                    retry_after = float(
+                        resp.getheader("Retry-After") or "")
+                except ValueError:
+                    retry_after = None
+            return resp.status, data, retry_after
         finally:
             conn.close()
+
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                content_type: str = "application/json") -> dict:
+        """JSON request with the resilience layer applied.
+
+        Retry matrix: transient failures (429 / 5xx / connection) are
+        retried with jittered exponential backoff under the policy's
+        per-call deadline — for ALL verbs, because every verb here is
+        retry-safe once the two POST/DELETE ambiguities are resolved:
+        a create retry answered AlreadyExists means an earlier attempt
+        landed (resolved by returning the existing object — the same
+        convergence the expectations ledger assumes), and a delete
+        retry answered NotFound means an earlier attempt deleted it
+        (resolved as success, so no delete is ever lost to a torn
+        response).  Non-transient answers (404/409/422) raise
+        immediately; conflict re-diffing lives at the controller layer.
+        A 429's Retry-After additionally pauses the shared rate
+        limiter, so every concurrent fan-out worker backs off together.
+        """
+        headers = self._headers(content_type if body is not None else None)
+        payload = json.dumps(body) if body is not None else None
+        policy = self.retry_policy
+        attempts = policy.max_attempts if policy is not None else 1
+        deadline = policy.start_deadline() if policy is not None else None
+        verb = self._VERB_OF_METHOD.get(method, method.lower())
+        attempt = 0
+        while True:
+            if self.breaker is not None and not self.breaker.allow():
+                raise CircuitOpenError(
+                    f"apiserver circuit breaker open; {method} {path} "
+                    f"failed fast ({self.breaker.snapshot()})",
+                    retry_in=self.breaker.remaining_open())
+            if self.rate_limiter is not None:
+                waited = self.rate_limiter.acquire()
+                if waited > 0 and self.metrics is not None:
+                    self.metrics.observe_throttle_wait(waited)
+            err: Exception
+            try:
+                status, data, retry_after = self._send_once(
+                    method, path, payload, headers)
+            except (OSError, HTTPException) as e:
+                err = e
+            except BaseException:
+                # an unexpected local error (not a server answer, not a
+                # classified connection failure) must still hand back
+                # an admitted half-open probe slot, or the breaker
+                # wedges with _probing latched and every request fails
+                # fast against a healthy apiserver
+                if self.breaker is not None:
+                    self.breaker.release_probe()
+                raise
+            else:
+                if status < 400:
+                    if self.breaker is not None:
+                        self.breaker.on_success()
+                    return json.loads(data) if data else {}
+                err = self._error_for(status, data, retry_after)
+            transient = is_transient(err)
+            if self.breaker is not None:
+                if transient and not isinstance(err, TooManyRequestsError):
+                    self.breaker.on_failure()
+                elif isinstance(err, ApiError):
+                    # any answered response — 404/409/422 AND 429 — means
+                    # the server is alive: reset the failure count and,
+                    # crucially, release a half-open probe slot (a 429
+                    # answered to the probe must close the breaker, not
+                    # leave _probing latched and the client wedged open;
+                    # flow control, not the breaker, handles shedding)
+                    self.breaker.on_success()
+            retry_after = getattr(err, "retry_after", None)
+            if retry_after and self.rate_limiter is not None:
+                self.rate_limiter.pause_for(retry_after)
+            if attempt > 0:
+                # ambiguity resolution: an earlier attempt may have been
+                # applied even though its response was lost
+                if method == "POST" and isinstance(err, AlreadyExistsError):
+                    name = ((body or {}).get("metadata") or {}).get("name")
+                    if name:
+                        try:
+                            return self.request("GET", f"{path}/{name}")
+                        except ApiError:
+                            pass
+                if method == "DELETE" and isinstance(err, NotFoundError):
+                    return {}
+            if not transient or attempt + 1 >= attempts:
+                if transient and self.metrics is not None:
+                    self.metrics.count_exhausted(verb)
+                raise err
+            if not policy.sleep_before_retry(attempt, deadline,
+                                             at_least=retry_after or 0.0):
+                if self.metrics is not None:
+                    self.metrics.count_exhausted(verb)
+                raise err
+            if self.metrics is not None:
+                self.metrics.count_retry(verb, transient_reason(err))
+            attempt += 1
 
     def request_text(self, method: str, path: str) -> str:
         """Raw-text request (pod logs endpoint returns plain text)."""
@@ -301,21 +424,31 @@ class RestClient:
             conn.close()
 
     @staticmethod
-    def _raise_for(status: int, data: bytes):
+    def _error_for(status: int, data: bytes,
+                   retry_after: Optional[float] = None) -> ApiError:
+        """HTTP status + body -> the classified ApiError (the API server
+        uses 409 for both conflict and already-exists; errors.py's
+        shared mapper disambiguates on the message).  A 429's
+        Retry-After hint is taken from the header when the transport
+        surfaced it, else from the Status body's
+        ``details.retryAfterSeconds`` (kube-apiserver sends both; the
+        native transport returns status+body only)."""
+        msg = data.decode(errors="replace")
         try:
-            msg = json.loads(data).get("message", data.decode(errors="replace"))
+            status_obj = json.loads(data)
+            msg = status_obj.get("message", msg)
+            if retry_after is None:
+                body_hint = (status_obj.get("details") or {}).get(
+                    "retryAfterSeconds")
+                if isinstance(body_hint, (int, float)):
+                    retry_after = float(body_hint)
         except (ValueError, AttributeError):
-            msg = data.decode(errors="replace")
-        if status == 404:
-            raise NotFoundError(msg)
-        if status == 409:
-            # the API server uses 409 for both conflict and already-exists
-            if "already exists" in msg:
-                raise AlreadyExistsError(msg)
-            raise ConflictError(msg)
-        if status in (400, 422):
-            raise InvalidError(msg)
-        raise ApiError(f"HTTP {status}: {msg}")
+            pass
+        return error_for_status(status, msg, retry_after=retry_after)
+
+    @staticmethod
+    def _raise_for(status: int, data: bytes):
+        raise RestClient._error_for(status, data)
 
 
 class _ObserveOnExit:
@@ -586,18 +719,29 @@ class RestCluster:
     """FakeCluster-shaped facade over a real API server."""
 
     def __init__(self, config: KubeConfig, namespace: Optional[str] = None,
-                 registry=None):
+                 registry=None, resilience: Optional[ResilienceConfig] = None):
         """``namespace`` scopes every store's lists/watches to one
         namespace (the operator's --namespace flag); None = cluster-wide.
         ``registry`` receives the per-verb/resource request-latency
-        histogram (shared default registry when None)."""
-        self.client = RestClient(config)
+        histogram plus the retry/throttle/breaker families (shared
+        default registry when None).  ``resilience`` configures the
+        client-side retry policy, QPS/burst limiter and circuit breaker
+        (k8s/resilience.py); the default keeps retries + breaker on and
+        the limiter off — the operator CLI passes --kube-api-qps/-burst
+        through here."""
         self.namespace = namespace or None
         self._stores: Dict[str, RestResourceStore] = {}
         self._lock = threading.Lock()
         if registry is None:
             from pytorch_operator_tpu.metrics import default_registry
             registry = default_registry
+        self.resilience = resilience or ResilienceConfig()
+        policy, limiter, breaker, metrics = _resilience.build(
+            self.resilience, registry)
+        self.breaker = breaker
+        self.client = RestClient(config, retry_policy=policy,
+                                 rate_limiter=limiter, breaker=breaker,
+                                 metrics=metrics)
         self.request_latency = registry.histogram_vec(
             "pytorch_operator_rest_request_duration_seconds",
             "Kubernetes API request latency, by verb and resource "
@@ -665,6 +809,19 @@ class RestCluster:
             return True
         except NotFoundError:
             return False
+
+    def resilience_snapshot(self) -> dict:
+        """Breaker + config state for /readyz detail and the e2e
+        artifact capture (``state`` is ``disabled`` without a breaker —
+        callers need not special-case)."""
+        snap = {"state": "disabled",
+                "qps": self.resilience.qps,
+                "burst": self.resilience.burst,
+                "max_attempts": self.resilience.max_attempts}
+        if self.breaker is not None:
+            snap.update(self.breaker.snapshot())
+            snap["state"] = self.breaker.state
+        return snap
 
     def close(self) -> None:
         with self._lock:

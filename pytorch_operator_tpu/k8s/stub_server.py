@@ -36,11 +36,21 @@ _PATH_RE = re.compile(
 class StubApiServer:
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None):
+                 ssl_context=None, fault_plan=None):
         """``ssl_context``: server-side ssl.SSLContext — serves HTTPS,
         exercising the production (TLS) client paths against the same
-        in-memory cluster."""
+        in-memory cluster.  ``fault_plan`` (k8s/faults.FaultPlan,
+        assignable after construction too) injects apiserver chaos:
+        per-verb 5xx (before or after the mutation commits), request
+        latency, 429 bursts with a real Retry-After header, and
+        mid-event watch-stream resets."""
         self.cluster = cluster if cluster is not None else FakeCluster()
+        self.fault_plan = fault_plan
+        # response accounting by "METHOD status" (e.g. "POST 409") —
+        # benches and the resilience e2e assert duplicate-create /
+        # injected-fault counts against what the server actually sent
+        self.counters: dict = {}
+        self._counters_lock = threading.Lock()
         # Test hook: while set, active watch streams terminate and new watch
         # requests are refused with 500, simulating an API-server outage /
         # network partition so watch-gap healing can be exercised.
@@ -53,16 +63,43 @@ class StubApiServer:
             def log_message(self, *a):
                 pass
 
-            def _send(self, status: int, body: dict):
+            def _send(self, status: int, body: dict,
+                      extra_headers: Optional[dict] = None):
+                outer._count(self.command, status)
                 data = json.dumps(body).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
             def _error(self, e: ApiError):
-                self._send(e.code, {"message": str(e)})
+                headers = None
+                body = {"message": str(e)}
+                retry_after = getattr(e, "retry_after", None)
+                if retry_after is not None:
+                    # a real kube-apiserver sheds load with 429 +
+                    # Retry-After, and mirrors the hint into the Status
+                    # body's details.retryAfterSeconds — send both, so
+                    # transports that surface only the body (the native
+                    # C++ one) still see the pause
+                    headers = {"Retry-After": f"{retry_after:g}"}
+                    body["details"] = {"retryAfterSeconds": retry_after}
+                self._send(e.code, body, headers)
+
+            def _fault(self, verb: str, plural: str):
+                """Consult the fault plan; executes injected latency and
+                returns the Fault when an error must be served (caller
+                decides before/after placement), else None."""
+                plan = outer.fault_plan
+                if plan is None:
+                    return None
+                fault = plan.on_request(verb, plural)
+                if fault.delay:
+                    time.sleep(fault.delay)
+                return fault if fault.error is not None else None
 
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length") or 0)
@@ -82,7 +119,7 @@ class StubApiServer:
                                      f"unknown resource {d['plural']!r}"})
                     return None
                 return (store, d["ns"], d["name"], d["sub"],
-                        parse_qs(u.query))
+                        parse_qs(u.query), d["plural"])
 
             def cluster_store(self, plural):
                 return outer.cluster.resource(plural)
@@ -91,7 +128,13 @@ class StubApiServer:
                 r = self._route()
                 if not r:
                     return
-                store, ns, name, sub, q = r
+                store, ns, name, sub, q, plural = r
+                is_watch = q.get("watch", ["false"])[0] == "true"
+                if not is_watch and sub != "log":
+                    fault = self._fault("get" if name else "list", plural)
+                    if fault is not None:
+                        self._error(fault.error)
+                        return
                 try:
                     if name and sub == "log":
                         if q.get("follow", ["false"])[0] == "true":
@@ -111,7 +154,7 @@ class StubApiServer:
                     if name:
                         self._send(200, store.get(ns, name))
                         return
-                    if q.get("watch", ["false"])[0] == "true":
+                    if is_watch:
                         if outer._drop_watch.is_set():
                             self._send(500, {"message": "watch unavailable"})
                             return
@@ -233,6 +276,19 @@ class StubApiServer:
                             continue
                         line = json.dumps(
                             {"type": et, "object": obj}).encode() + b"\n"
+                        plan = outer.fault_plan
+                        if plan is not None and plan.on_watch_event():
+                            # mid-event reset: declare the full chunk,
+                            # write half of it, and let the finally
+                            # block tear the socket down with no clean
+                            # chunked EOF — the client sees a framing
+                            # error (IncompleteRead), reports a GAP,
+                            # and must relist to heal
+                            self.wfile.write(
+                                f"{len(line):x}\r\n".encode()
+                                + line[:max(1, len(line) // 2)])
+                            self.wfile.flush()
+                            return
                         self.wfile.write(
                             f"{len(line):x}\r\n".encode() + line + b"\r\n")
                         self.wfile.flush()
@@ -249,47 +305,66 @@ class StubApiServer:
                     except OSError:
                         pass
 
+            def _mutate(self, verb: str, plural: str, op, ok_status: int,
+                        ok_body=None):
+                """Shared mutating-handler shape: 'before' faults answer
+                without touching the store; 'after' faults COMMIT the
+                mutation and then fail the response — the torn-response
+                case the client's retry-ambiguity rules resolve."""
+                fault = self._fault(verb, plural)
+                if fault is not None and fault.when == "before":
+                    self._error(fault.error)
+                    return
+                try:
+                    result = op()
+                except ApiError as e:
+                    self._error(e)
+                    return
+                if fault is not None:  # when == "after"
+                    self._error(fault.error)
+                    return
+                self._send(ok_status,
+                           result if ok_body is None else ok_body)
+
             def do_POST(self):
                 r = self._route()
                 if not r:
                     return
-                store, ns, _name, _sub, _q = r
-                try:
-                    self._send(201, store.create(ns or "default", self._body()))
-                except ApiError as e:
-                    self._error(e)
+                store, ns, _name, _sub, _q, plural = r
+                body = self._body()
+                self._mutate("create", plural,
+                             lambda: store.create(ns or "default", body),
+                             201)
 
             def do_PUT(self):
                 r = self._route()
                 if not r:
                     return
-                store, _ns, _name, sub, _q = r
-                try:
-                    self._send(200, store.update(self._body(), subresource=sub))
-                except ApiError as e:
-                    self._error(e)
+                store, _ns, _name, sub, _q, plural = r
+                body = self._body()
+                self._mutate("update", plural,
+                             lambda: store.update(body, subresource=sub),
+                             200)
 
             def do_PATCH(self):
                 r = self._route()
                 if not r:
                     return
-                store, ns, name, sub, _q = r
-                try:
-                    self._send(200, store.patch(ns or "default", name,
-                                                self._body(), subresource=sub))
-                except ApiError as e:
-                    self._error(e)
+                store, ns, name, sub, _q, plural = r
+                body = self._body()
+                self._mutate("patch", plural,
+                             lambda: store.patch(ns or "default", name,
+                                                 body, subresource=sub),
+                             200)
 
             def do_DELETE(self):
                 r = self._route()
                 if not r:
                     return
-                store, ns, name, _sub, _q = r
-                try:
-                    store.delete(ns or "default", name)
-                    self._send(200, {"status": "Success"})
-                except ApiError as e:
-                    self._error(e)
+                store, ns, name, _sub, _q, plural = r
+                self._mutate("delete", plural,
+                             lambda: store.delete(ns or "default", name),
+                             200, ok_body={"status": "Success"})
 
         class Server(ThreadingHTTPServer):
             # The stdlib default accept backlog is 5; the controller's
@@ -308,6 +383,11 @@ class StubApiServer:
                 self.server.socket, server_side=True)
         self.server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+
+    def _count(self, method: str, status: int) -> None:
+        key = f"{method} {status}"
+        with self._counters_lock:
+            self.counters[key] = self.counters.get(key, 0) + 1
 
     @property
     def port(self) -> int:

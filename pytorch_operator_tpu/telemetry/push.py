@@ -82,17 +82,29 @@ _STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 
 class PushGateway:
     """Validates pushed samples and applies them to budget-guarded
-    ``job``-labeled families on ``registry``."""
+    ``job``-labeled families on ``registry``.
+
+    ``job_validator`` closes the trusted-``job``-field hole (ROADMAP
+    multi-tenant item): when set, a payload whose ``job`` does not name
+    a live PyTorchJob — the operator passes the job informer store's
+    ``namespace/name`` containment check — is rejected wholesale and
+    counted under ``reason="unknown_job"``, so a stray or hostile pod
+    cannot mint series for jobs that don't exist."""
 
     def __init__(self, registry: Registry,
-                 series_budget: int = DEFAULT_SERIES_BUDGET):
+                 series_budget: int = DEFAULT_SERIES_BUDGET,
+                 job_validator=None):
         self.registry = registry
         self.series_budget = series_budget
+        self.job_validator = job_validator
         dropped = registry.dropped_series_counter()
-        self.rejected = registry.counter(
+        self.rejected = registry.counter_vec(
             "pytorch_operator_push_rejected_total",
-            "Pushed samples refused at ingestion (unknown family, "
-            "op/family mismatch, non-numeric value, missing job)")
+            "Pushed samples refused at ingestion, by reason: "
+            "unknown_job (no live PyTorchJob matches), unknown_family, "
+            "op_mismatch, bad_value (non-numeric / negative counter / "
+            "malformed sample)",
+            ("reason",))
         self.accepted = registry.counter(
             "pytorch_operator_push_samples_total",
             "Pushed samples applied to a job-labeled family")
@@ -122,38 +134,47 @@ class PushGateway:
             raise ValueError("payload needs a non-empty string 'job'")
         if not isinstance(samples, list):
             raise ValueError("payload needs a 'samples' list")
-        accepted = rejected = 0
+        accepted = 0
+        rejected: Dict[str, int] = {}
         with self._lock:
             dropped_before = self._dropped.value
-            for sample in samples:
-                if self._apply(job, sample):
-                    accepted += 1
-                else:
-                    rejected += 1
+            # identity check once per payload, BEFORE any sample can
+            # mint a series: an unknown job rejects the whole batch
+            if self.job_validator is not None and not self.job_validator(job):
+                rejected["unknown_job"] = len(samples)
+            else:
+                for sample in samples:
+                    reason = self._apply(job, sample)
+                    if reason is None:
+                        accepted += 1
+                    else:
+                        rejected[reason] = rejected.get(reason, 0) + 1
             dropped = self._dropped.value - dropped_before
         if accepted:
             self.accepted.inc(accepted)
-        if rejected:
-            self.rejected.inc(rejected)
-        return {"accepted": accepted, "rejected": rejected,
+        for reason, count in rejected.items():
+            self.rejected.labels(reason=reason).inc(count)
+        return {"accepted": accepted, "rejected": sum(rejected.values()),
                 "dropped": int(dropped)}
 
-    def _apply(self, job: str, sample) -> bool:
+    def _apply(self, job: str, sample):
+        """Apply one sample; returns None on success, else the
+        rejection-reason label value."""
         if not isinstance(sample, dict):
-            return False
+            return "bad_value"
         name = sample.get("name")
         family = _FAMILIES.get(name)
         if family is None:
-            return False
+            return "unknown_family"
         kind, allowed_op, _help = family
         op = sample.get("op", allowed_op)
         if op != allowed_op:
-            return False
+            return "op_mismatch"
         value = sample.get("value", 1.0 if kind == "counter" else None)
         if not isinstance(value, (int, float)) or isinstance(value, bool):
-            return False
+            return "bad_value"
         if kind == "counter" and value < 0:
-            return False  # counters only go up
+            return "bad_value"  # counters only go up
         # every validation happens BEFORE labels(): a rejected sample
         # must not mint a series (or burn a budget slot) for its job
         child = self._vecs[name].labels(job=job)
@@ -163,7 +184,7 @@ class PushGateway:
             child.set(float(value))
         else:
             child.inc(float(value))
-        return True
+        return None
 
 
 def step_record_samples(record: StepRecord) -> List[dict]:

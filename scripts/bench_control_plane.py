@@ -52,9 +52,23 @@ the job informer's coalescer uses) WITHOUT changing behavior.  The
 coalescible fraction is the measured upper bound on what a safe pod
 coalesce variant could skip.
 
+``--chaos-apiserver`` runs the APISERVER fault tier STANDALONE (ISSUE 5):
+the stub API server executes a FaultPlan — 10% transient 5xx on
+mutating verbs, one 429 burst with a real Retry-After, and periodic
+watch-stream resets mid-event — while J jobs are driven to Succeeded
+over real HTTP.  The A/B is the resilience layer itself: ``resilient``
+runs the shipped client (retries + QPS limiter + circuit breaker),
+``single_shot`` disables all three (``--kube-api-qps 0`` / retries
+off), leaving only workqueue backoff.  Duplicate creates are counted at
+the server (POST 409s) and pods are reconciled against the expected
+count, so the expectations ledger is proven intact under fault
+injection, not assumed.  ``--out`` rewrites only the delimited
+chaos-apiserver section of BENCH_CONTROL_PLANE.md.
+
 Run:  python scripts/bench_control_plane.py --out BENCH_CONTROL_PLANE.md
       python scripts/bench_control_plane.py --chaos
       python scripts/bench_control_plane.py --churn-pods
+      python scripts/bench_control_plane.py --chaos-apiserver --out BENCH_CONTROL_PLANE.md
 """
 
 from __future__ import annotations
@@ -485,6 +499,272 @@ def run_chaos_ab(jobs: int, workers: int) -> dict:
     retries) under the identical storm shape."""
     return {"chaos_proactive": run_chaos(jobs, workers, proactive=True),
             "chaos_legacy": run_chaos(jobs, workers, proactive=False)}
+
+
+def chaos_apiserver_plan(seed: int = 11, outage_s: float = 1.5,
+                         error_rate: float = 0.10):
+    """The committed chaos-apiserver fault shape (shared with the
+    test-tier smoke so the bench and the regression test measure the
+    same plan): 10% transient 503s on every mutating verb, one 8-deep
+    429 burst with a 0.2s Retry-After after the 30th request, one
+    ``outage_s`` write outage starting at the 60th request (the
+    master-upgrade blip), and a watch-stream reset every 40th event."""
+    from pytorch_operator_tpu.k8s.faults import FaultPlan
+
+    return FaultPlan(error_rate=error_rate, error_code=503,
+                     throttle_after=30, throttle_burst=8,
+                     retry_after_s=0.2,
+                     outage_at_request=60, outage_duration_s=outage_s,
+                     watch_reset_every=40, seed=seed)
+
+
+def run_chaos_apiserver(jobs: int, workers: int, resilient: bool,
+                        timeout: float = 180.0, seed: int = 11,
+                        error_rate: float = 0.10) -> dict:
+    """One apiserver-chaos round over real HTTP: the stub server
+    executes the fault plan while the controller drives `jobs` jobs to
+    Succeeded.  ``resilient`` selects the shipped client resilience
+    (retries + limiter + breaker) vs single-shot (the pre-ISSUE-5
+    behavior: every transient error fails the sync and leans on
+    workqueue backoff).  Jobs are seeded and observed through the
+    in-memory cluster directly so the DRIVER is never subject to the
+    faults — only the operator's client is."""
+    import re as _re
+
+    from pytorch_operator_tpu.k8s.resilience import ResilienceConfig
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+    plan = chaos_apiserver_plan(seed, error_rate=error_rate)
+    srv = StubApiServer(fault_plan=plan).start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    registry = Registry()
+    if resilient:
+        # enough in-call backoff span (0.05+0.1+0.2+0.4+0.8 ~ 1.6s)
+        # to ride through the plan's 1.5s write-outage window; the
+        # breaker probes every 0.5s so recovery is detected promptly
+        # once the window ends
+        resilience = ResilienceConfig(
+            qps=200.0, burst=400, max_attempts=6,
+            base_backoff=0.05, max_backoff=1.0, breaker_reset=0.5)
+    else:
+        resilience = ResilienceConfig(qps=0.0, max_attempts=1,
+                                      breaker_threshold=0)
+    rest = RestCluster(KubeConfig.from_url(f"http://127.0.0.1:{srv.port}"),
+                       namespace="default", registry=registry,
+                       resilience=resilience)
+    ctl = PyTorchController(rest, config=JobControllerConfig(),
+                            registry=registry)
+    stop = threading.Event()
+    ctl.run(threadiness=4, stop_event=stop)
+    expected_pods = jobs * (workers + 1)
+    out: dict = {"variant": "resilient" if resilient else "single_shot",
+                 "jobs": jobs, "workers": workers,
+                 "expected_pods": expected_pods}
+
+    def succeeded():
+        n = 0
+        for j in range(jobs):
+            try:
+                job = srv.cluster.jobs.get("default", f"chaosapi-{j}")
+            except NotFoundError:
+                continue
+            if _condition_true(job, "Succeeded"):
+                n += 1
+        return n
+
+    t0 = time.perf_counter()
+    try:
+        for j in range(jobs):
+            srv.cluster.jobs.create("default",
+                                    new_job(f"chaosapi-{j}", workers))
+        deadline = t0 + timeout
+        while succeeded() < jobs:
+            if time.perf_counter() > deadline:
+                break
+            time.sleep(0.01)
+        out["succeeded"] = succeeded()
+        out["converged"] = out["succeeded"] == jobs
+        out["wall_s"] = round(time.perf_counter() - t0, 3)
+        pods = srv.cluster.pods.list("default")
+        out["pods_final"] = len(pods)
+        # CleanPodPolicy defaults keep pods after Succeeded: any count
+        # other than expected means a lost delete or a duplicate create
+        out["pods_match_expected"] = len(pods) == expected_pods
+        out["duplicate_create_conflicts"] = srv.counters.get("POST 409", 0)
+        out["faults_injected"] = plan.snapshot()
+        text = registry.expose()
+
+        def series_sum(pattern):
+            return sum(float(m) for m in _re.findall(pattern, text))
+
+        out["rest_retries"] = int(series_sum(
+            r'pytorch_operator_rest_retries_total\{[^}]*\} (\d+)'))
+        out["retry_exhausted"] = int(series_sum(
+            r'pytorch_operator_rest_retry_exhausted_total\{[^}]*\} (\d+)'))
+        out["reconcile_errors"] = int(series_sum(
+            r'pytorch_operator_reconcile_duration_seconds_count'
+            r'\{result="error"\} (\d+)'))
+        out["throttle_waits"] = int(series_sum(
+            r'pytorch_operator_rest_throttle_wait_seconds_count (\d+)'))
+        return out
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+        rest.close()
+        srv.stop()
+
+
+def run_chaos_apiserver_ab(jobs: int, workers: int,
+                           timeout: float = 180.0,
+                           error_rate: float = 0.10) -> dict:
+    return {
+        "chaos_apiserver_resilient": run_chaos_apiserver(
+            jobs, workers, resilient=True, timeout=timeout,
+            error_rate=error_rate),
+        "chaos_apiserver_single_shot": run_chaos_apiserver(
+            jobs, workers, resilient=False, timeout=timeout,
+            error_rate=error_rate),
+    }
+
+
+CHAOS_APISERVER_BEGIN = "<!-- chaos-apiserver:begin -->"
+CHAOS_APISERVER_END = "<!-- chaos-apiserver:end -->"
+
+
+def _chaos_apiserver_reading(res: dict) -> str:
+    """Verdict computed from THIS run, reported honestly either way:
+    the resilient client must converge with zero duplicate creates, and
+    the single-shot variant is expected to demonstrably degrade (longer
+    wall and/or more reconcile errors) under the identical plan."""
+    r = res["chaos_apiserver_resilient"]
+    s = res["chaos_apiserver_single_shot"]
+    clean = (r["converged"] and r["duplicate_create_conflicts"] == 0
+             and r["pods_match_expected"])
+    lines = [
+        f"resilient: converged={r['converged']} in {r.get('wall_s')}s, "
+        f"{r['rest_retries']} retries, {r['throttle_waits']} throttled "
+        f"waits, {r['reconcile_errors']} reconcile errors, "
+        f"{r['faults_injected'].get('outage', 0)} requests sent into "
+        f"the outage window, "
+        f"{r['duplicate_create_conflicts']} duplicate-create 409s, "
+        f"pods {r['pods_final']}/{r['expected_pods']}",
+        f"single-shot: converged={s['converged']} in {s.get('wall_s')}s, "
+        f"{s['reconcile_errors']} reconcile errors, "
+        f"{s['faults_injected'].get('outage', 0)} requests sent into "
+        f"the outage window, "
+        f"{s['duplicate_create_conflicts']} duplicate-create 409s, "
+        f"pods {s['pods_final']}/{s['expected_pods']}",
+    ]
+    detail = "; ".join(lines)
+    if not clean:
+        return (f"  **Chaos-apiserver verdict: the resilience layer did "
+                f"NOT absorb the fault plan cleanly on this run** "
+                f"({detail}) — investigate before trusting the layer.")
+    if not s["converged"]:
+        return (f"  **Chaos-apiserver verdict: the layer absorbs the "
+                f"fault plan (zero duplicate creates, pods exact); with "
+                f"it disabled the identical plan did not converge within "
+                f"the timeout** — {detail}.")
+    ratio = (s["wall_s"] / r["wall_s"]) if r.get("wall_s") else None
+    err_ratio = (s["reconcile_errors"] / r["reconcile_errors"]
+                 if r["reconcile_errors"] else None)
+    degraded = (ratio is not None and ratio >= 1.2) or \
+        s["reconcile_errors"] >= max(10, 2 * r["reconcile_errors"])
+    hammer_r = r["faults_injected"].get("outage", 0)
+    hammer_s = s["faults_injected"].get("outage", 0)
+    hammer = (f"{hammer_s / hammer_r:.1f}x" if hammer_r
+              else f"{hammer_s} vs 0")
+    if degraded:
+        return (f"  **Chaos-apiserver verdict: the layer absorbs the "
+                f"fault plan (zero duplicate creates, pods exact) and "
+                f"single-shot demonstrably degrades under the identical "
+                f"plan** — {detail}.  Wall ratio "
+                f"{ratio:.2f}x, reconcile-error ratio "
+                f"{f'{err_ratio:.1f}x' if err_ratio else 'n/a (resilient had 0)'}, "
+                f"outage-window hammering {hammer} (requests the breaker "
+                f"declined to send vs single-shot's blind retries): "
+                f"with retries on, transient faults are absorbed inside "
+                f"the call (invisible to the sync loop), the breaker "
+                f"stops traffic into the dead window, and breaker-paced "
+                f"requeues resume promptly at the half-open probe — "
+                f"single-shot pays a failed reconcile + a workqueue "
+                f"backoff strike per fault, and its per-key exponential "
+                f"overshoots the apiserver's recovery.")
+    return (f"  **Chaos-apiserver verdict: the layer is clean (zero "
+            f"duplicate creates, pods exact) but single-shot did not "
+            f"measurably degrade on this run** ({detail}) — at this "
+            f"fault rate workqueue backoff alone keeps up on this box; "
+            f"re-run with a higher --chaos-apiserver-rate before citing "
+            f"either direction.")
+
+
+def render_chaos_apiserver_md(res: dict, jobs: int, workers: int) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    r = res["chaos_apiserver_resilient"]
+
+    def row(label, d):
+        return (f"| {label} | {'yes' if d['converged'] else '**NO**'} | "
+                f"{d.get('wall_s', '—')} | {d['rest_retries']} | "
+                f"{d['throttle_waits']} | {d['reconcile_errors']} | "
+                f"{d['faults_injected'].get('outage', 0)} | "
+                f"{d['duplicate_create_conflicts']} | "
+                f"{d['pods_final']}/{d['expected_pods']} |")
+
+    return "\n".join([
+        CHAOS_APISERVER_BEGIN,
+        f"## Apiserver chaos ({jobs} jobs x (1+{workers}), fault plan: "
+        f"10% 503 on mutating verbs, one 8-deep 429 burst w/ 0.2s "
+        f"Retry-After, one 1.5s write-outage window, watch reset every "
+        f"40th event)",
+        "",
+        f"Generated {now} by `python scripts/bench_control_plane.py "
+        f"--chaos-apiserver`.  `resilient` is the shipped client "
+        f"(jittered-backoff retries, QPS/burst token bucket, circuit "
+        f"breaker with breaker-paced requeues); `single_shot` disables "
+        f"all three (`--kube-api-qps 0` / retries off) leaving only "
+        f"workqueue backoff.  `outage reqs` counts requests the client "
+        f"sent INTO the dead window — the hammering the breaker "
+        f"exists to stop.",
+        "",
+        "| variant | converged | wall s | rest retries | throttled "
+        "waits | reconcile errors | outage reqs | duplicate-create "
+        "409s | pods |",
+        "|---|---|---|---|---|---|---|---|---|",
+        row("resilient", r),
+        row("single-shot", res["chaos_apiserver_single_shot"]),
+        "",
+        _chaos_apiserver_reading(res),
+        "",
+        "```json",
+        json.dumps(res, indent=2),
+        "```",
+        CHAOS_APISERVER_END,
+    ])
+
+
+def update_md_section(path: str, begin: str, end: str,
+                      content: str) -> None:
+    """Replace (or append) the delimited section of ``path`` — the
+    chaos-apiserver tier regenerates its own verdict without forcing a
+    full (hour-long) bench rerun of every other tier."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        text = ""
+    if begin in text and end in text:
+        head, rest = text.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        text = head + content + tail
+    else:
+        if text and not text.endswith("\n"):
+            text += "\n"
+        text += "\n" + content + "\n"
+    with open(path, "w") as f:
+        f.write(text)
 
 
 def run_churn_pods(jobs: int, workers: int, bursts: int = 20,
@@ -992,6 +1272,18 @@ def main() -> None:
                          "per variant")
     ap.add_argument("--chaos-jobs", type=int, default=8)
     ap.add_argument("--chaos-workers", type=int, default=3)
+    ap.add_argument("--chaos-apiserver", action="store_true",
+                    help="run ONLY the apiserver fault-injection tier "
+                         "(resilient vs single-shot client under the "
+                         "same FaultPlan), print one JSON line per "
+                         "variant, and with --out update only the "
+                         "delimited chaos-apiserver section")
+    ap.add_argument("--chaos-apiserver-jobs", type=int, default=6)
+    ap.add_argument("--chaos-apiserver-workers", type=int, default=3)
+    ap.add_argument("--chaos-apiserver-timeout", type=float, default=180.0)
+    ap.add_argument("--chaos-apiserver-rate", type=float, default=0.10,
+                    help="transient-error rate on mutating verbs for "
+                         "the apiserver fault plan")
     ap.add_argument("--churn-pods", action="store_true",
                     help="run ONLY the pod-informer MODIFIED-burst "
                          "measurement (delivered vs coalescible) and "
@@ -1009,6 +1301,25 @@ def main() -> None:
         res = run_churn_pods(args.churn_pods_jobs, args.churn_pods_workers,
                              bursts=args.churn_pods_bursts)
         print(json.dumps({"tier": "churn_pods", **res}))
+        return
+
+    if args.chaos_apiserver:
+        print(f"[bench_cp] chaos-apiserver ({args.chaos_apiserver_jobs} "
+              f"jobs x (1+{args.chaos_apiserver_workers}), resilient vs "
+              f"single-shot)...", file=sys.stderr)
+        res = run_chaos_apiserver_ab(args.chaos_apiserver_jobs,
+                                     args.chaos_apiserver_workers,
+                                     timeout=args.chaos_apiserver_timeout,
+                                     error_rate=args.chaos_apiserver_rate)
+        for tier, r in res.items():
+            print(json.dumps({"tier": tier, **r}))
+        if args.out:
+            update_md_section(
+                args.out, CHAOS_APISERVER_BEGIN, CHAOS_APISERVER_END,
+                render_chaos_apiserver_md(res, args.chaos_apiserver_jobs,
+                                          args.chaos_apiserver_workers))
+            print(f"[bench_cp] updated chaos-apiserver section of "
+                  f"{args.out}", file=sys.stderr)
         return
 
     if args.chaos:

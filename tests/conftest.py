@@ -72,6 +72,15 @@ def _capture_e2e_artifacts(item, reg) -> None:
                 captured.append(f"{base}.{suffix}.error")
     for name, text in reg.get("extra", {}).items():
         path = f"{base}.{name}"
+        # callables are resolved at capture time — the resilience world
+        # registers one returning breaker state + retry/fault counters,
+        # so the snapshot reflects the moment of failure, not fixture
+        # setup
+        if callable(text):
+            try:
+                text = text()
+            except Exception as e:
+                text = f"extra callable failed: {e!r}\n"
         with open(path, "w") as f:
             f.write(text)
         captured.append(path)
@@ -103,8 +112,11 @@ def e2e_artifacts(request):
 
     A test (or its world fixture) sets ``e2e_artifacts["port"]`` to the
     operator metrics server's port (and may add ``extra``: filename ->
-    text).  If the test body fails, the makereport hook scrapes
-    ``/metrics`` and ``/debug/traces`` from that port into
+    text, or filename -> zero-arg callable resolved at capture time —
+    the resilience e2e registers circuit-breaker state + retry/fault
+    counters this way).  If the test body fails, the makereport hook
+    scrapes ``/metrics`` (retry/throttle/breaker series included) and
+    ``/debug/traces`` from that port into
     ``$E2E_ARTIFACTS_DIR/<test-name>.*`` (default ``test-artifacts/``)
     while the server is still up.
     """

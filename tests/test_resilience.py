@@ -1,0 +1,673 @@
+"""Apiserver-resilience layer (ISSUE 5): transient-error
+classification, retry backoff/deadline, token-bucket flow control, the
+circuit-breaker state machine, verb-aware retry semantics over the
+stub server's fault injection, and the http-tier sim e2e — a job
+reaching Succeeded through an apiserver injecting 5xx, a 429 burst and
+a mid-watch reset, with zero duplicate pods and the retry counters
+visible on /metrics."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s import errors as k8s_errors
+from pytorch_operator_tpu.k8s.errors import (
+    AlreadyExistsError,
+    ApiError,
+    CircuitOpenError,
+    ConflictError,
+    InternalServerError,
+    InvalidError,
+    NotFoundError,
+    ServerTimeoutError,
+    ServiceUnavailableError,
+    TooManyRequestsError,
+    error_for_status,
+    is_transient,
+    transient_reason,
+)
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.k8s.faults import FaultPlan
+from pytorch_operator_tpu.k8s.resilience import (
+    CircuitBreaker,
+    ResilienceConfig,
+    ResilienceMetrics,
+    RetryPolicy,
+    TokenBucket,
+)
+from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+from pytorch_operator_tpu.k8s.stub_server import StubApiServer
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from testutil import new_job, wait_for
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_transient_statuses(self):
+        for err in (TooManyRequestsError("429"),
+                    InternalServerError("500"),
+                    ServiceUnavailableError("503"),
+                    ServerTimeoutError("504"),
+                    error_for_status(502, "bad gateway")):
+            assert is_transient(err), err
+
+    def test_connection_failures_are_transient(self):
+        from http.client import IncompleteRead
+
+        assert is_transient(ConnectionResetError("reset"))
+        assert is_transient(TimeoutError("timed out"))
+        assert is_transient(IncompleteRead(b""))
+
+    def test_definitive_answers_are_not_transient(self):
+        for err in (NotFoundError("404"), AlreadyExistsError("409"),
+                    ConflictError("409"), InvalidError("422"),
+                    error_for_status(418, "teapot"),
+                    ValueError("not an api error")):
+            assert not is_transient(err), err
+
+    def test_circuit_open_is_never_retried(self):
+        assert not is_transient(CircuitOpenError("open"))
+
+    def test_status_mapping(self):
+        assert isinstance(error_for_status(404, "x"), NotFoundError)
+        assert isinstance(error_for_status(409, "already exists"),
+                          AlreadyExistsError)
+        assert isinstance(error_for_status(409, "rv conflict"),
+                          ConflictError)
+        assert isinstance(error_for_status(422, "x"), InvalidError)
+        assert isinstance(error_for_status(429, "x"),
+                          TooManyRequestsError)
+        assert isinstance(error_for_status(503, "x"),
+                          ServiceUnavailableError)
+        err = error_for_status(502, "x")
+        assert type(err) is ApiError and err.code == 502
+
+    def test_retry_after_carried(self):
+        err = error_for_status(429, "slow down", retry_after=3.5)
+        assert err.retry_after == 3.5
+
+    def test_reason_labels(self):
+        assert transient_reason(TooManyRequestsError("")) == "throttled"
+        assert transient_reason(ServiceUnavailableError("")) == \
+            "server_error"
+        assert transient_reason(ConnectionResetError("")) == "connection"
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_exponential_and_capped(self):
+        policy = RetryPolicy(base_backoff=0.1, max_backoff=0.8,
+                             jitter=0.0, rand=lambda: 0.0)
+        assert [policy.backoff(a) for a in range(5)] == \
+            [0.1, 0.2, 0.4, 0.8, 0.8]
+
+    def test_jitter_shrinks_never_grows(self):
+        policy = RetryPolicy(base_backoff=0.1, max_backoff=10.0,
+                             jitter=0.5, rand=lambda: 1.0)
+        # rand=1.0 -> full jitter: half the nominal delay
+        assert policy.backoff(0) == pytest.approx(0.05)
+        policy_hi = RetryPolicy(base_backoff=0.1, max_backoff=10.0,
+                                jitter=0.5, rand=lambda: 0.0)
+        assert policy_hi.backoff(0) == pytest.approx(0.1)
+
+    def test_run_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ServiceUnavailableError("boom")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, base_backoff=0.0)
+        assert policy.run(flaky, retryable=is_transient) == "ok"
+        assert len(calls) == 3
+
+    def test_run_respects_max_attempts(self):
+        calls = []
+
+        def always_fails():
+            calls.append(1)
+            raise ServiceUnavailableError("boom")
+
+        policy = RetryPolicy(max_attempts=3, base_backoff=0.0)
+        with pytest.raises(ServiceUnavailableError):
+            policy.run(always_fails, retryable=is_transient)
+        assert len(calls) == 3
+
+    def test_run_never_retries_non_retryable(self):
+        calls = []
+
+        def fails():
+            calls.append(1)
+            raise NotFoundError("gone")
+
+        policy = RetryPolicy(max_attempts=5, base_backoff=0.0)
+        with pytest.raises(NotFoundError):
+            policy.run(fails, retryable=is_transient)
+        assert len(calls) == 1
+
+    def test_run_on_retry_hook_sees_error_and_attempt(self):
+        seen = []
+
+        def fails():
+            raise ServiceUnavailableError("boom")
+
+        policy = RetryPolicy(max_attempts=3, base_backoff=0.0)
+        with pytest.raises(ServiceUnavailableError):
+            policy.run(fails, retryable=is_transient,
+                       on_retry=lambda e, a: seen.append((type(e), a)))
+        assert seen == [(ServiceUnavailableError, 0),
+                        (ServiceUnavailableError, 1)]
+
+    def test_deadline_cuts_retries_short(self):
+        # fake clock: each backoff would be 10s against a 5s deadline,
+        # so the second attempt is never made
+        now = [0.0]
+        policy = RetryPolicy(max_attempts=10, base_backoff=10.0,
+                             max_backoff=10.0, deadline=5.0, jitter=0.0,
+                             rand=lambda: 0.0,
+                             sleep=lambda s: now.__setitem__(0, now[0] + s),
+                             clock=lambda: now[0])
+        calls = []
+
+        def fails():
+            calls.append(1)
+            raise ServiceUnavailableError("boom")
+
+        with pytest.raises(ServiceUnavailableError):
+            policy.run(fails, retryable=is_transient)
+        assert len(calls) == 1
+
+    def test_sleep_before_retry_honors_at_least(self):
+        slept = []
+        policy = RetryPolicy(base_backoff=0.01, max_backoff=0.01,
+                             deadline=60.0, jitter=0.0, rand=lambda: 0.0,
+                             sleep=slept.append, clock=lambda: 0.0)
+        assert policy.sleep_before_retry(0, 60.0, at_least=0.7)
+        assert slept == [0.7]  # the Retry-After hint wins over backoff
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+
+def _fake_timeline():
+    """(clock, sleep) over a virtual timeline."""
+    now = [0.0]
+    return (lambda: now[0]), (lambda s: now.__setitem__(0, now[0] + s))
+
+
+class TestTokenBucket:
+    def test_burst_then_qps_pacing(self):
+        clock, sleep = _fake_timeline()
+        bucket = TokenBucket(qps=10.0, burst=3, clock=clock, sleep=sleep)
+        # the burst drains for free
+        assert [bucket.acquire() for _ in range(3)] == [0.0, 0.0, 0.0]
+        # then one token per 1/qps
+        waited = bucket.acquire()
+        assert waited == pytest.approx(0.1)
+        waited = bucket.acquire()
+        assert waited == pytest.approx(0.1)
+
+    def test_refill_caps_at_burst(self):
+        clock, sleep = _fake_timeline()
+        bucket = TokenBucket(qps=10.0, burst=2, clock=clock, sleep=sleep)
+        bucket.acquire()
+        bucket.acquire()
+        sleep(100.0)  # a long idle refills at most `burst` tokens
+        assert bucket.acquire() == 0.0
+        assert bucket.acquire() == 0.0
+        assert bucket.acquire() == pytest.approx(0.1)
+
+    def test_pause_for_delays_everyone(self):
+        clock, sleep = _fake_timeline()
+        bucket = TokenBucket(qps=1000.0, burst=100, clock=clock,
+                             sleep=sleep)
+        bucket.pause_for(2.0)  # the 429 Retry-After hook
+        assert bucket.acquire() == pytest.approx(2.0)
+        # after the pause the bucket flows again
+        assert bucket.acquire() == 0.0
+
+    def test_qps_zero_is_unlimited(self):
+        clock, sleep = _fake_timeline()
+        bucket = TokenBucket(qps=0.0, clock=clock, sleep=sleep)
+        assert all(bucket.acquire() == 0.0 for _ in range(100))
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        now = [0.0]
+        breaker = CircuitBreaker(clock=lambda: now[0], **kw)
+        return breaker, now
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        breaker, _ = self._breaker(threshold=3, reset_timeout=5.0)
+        for _ in range(2):
+            breaker.on_failure()
+        assert breaker.state == "closed" and breaker.allow()
+        breaker.on_failure()
+        assert breaker.state == "open" and not breaker.allow()
+
+    def test_success_resets_the_count(self):
+        breaker, _ = self._breaker(threshold=3, reset_timeout=5.0)
+        breaker.on_failure()
+        breaker.on_failure()
+        breaker.on_success()  # any definitive answer: server is alive
+        breaker.on_failure()
+        breaker.on_failure()
+        assert breaker.state == "closed"
+
+    def test_half_open_admits_exactly_one_probe(self):
+        breaker, now = self._breaker(threshold=1, reset_timeout=5.0)
+        breaker.on_failure()
+        assert not breaker.allow()
+        now[0] += 5.0
+        assert breaker.state == "half-open"
+        assert breaker.allow()       # the probe
+        assert not breaker.allow()   # everyone else keeps failing fast
+
+    def test_probe_success_closes(self):
+        breaker, now = self._breaker(threshold=1, reset_timeout=5.0)
+        breaker.on_failure()
+        now[0] += 5.0
+        assert breaker.allow()
+        breaker.on_success()
+        assert breaker.state == "closed"
+        assert breaker.allow() and breaker.allow()
+
+    def test_probe_failure_reopens_and_restarts_clock(self):
+        breaker, now = self._breaker(threshold=1, reset_timeout=5.0)
+        breaker.on_failure()
+        now[0] += 5.0
+        assert breaker.allow()
+        breaker.on_failure()
+        assert breaker.state == "open" and not breaker.allow()
+        now[0] += 4.9
+        assert not breaker.allow()  # clock restarted at the reopen
+        now[0] += 0.2
+        assert breaker.allow()
+
+    def test_remaining_open_counts_down(self):
+        breaker, now = self._breaker(threshold=1, reset_timeout=5.0)
+        breaker.on_failure()
+        assert breaker.remaining_open() == pytest.approx(5.0)
+        now[0] += 3.0
+        assert breaker.remaining_open() == pytest.approx(2.0)
+        breaker.on_success()
+        assert breaker.remaining_open() == 0.0
+
+    def test_transitions_feed_the_metric(self):
+        registry = Registry()
+        breaker, now = self._breaker(threshold=1, reset_timeout=5.0)
+        ResilienceMetrics(registry, breaker)
+        breaker.on_failure()
+        now[0] += 5.0
+        breaker.allow()
+        breaker.on_success()
+        text = registry.expose()
+        assert ('pytorch_operator_circuit_breaker_transitions_total'
+                '{to="open"} 1') in text
+        assert ('pytorch_operator_circuit_breaker_transitions_total'
+                '{to="closed"} 1') in text
+        assert 'pytorch_operator_circuit_breaker_state 0' in text
+
+
+# ---------------------------------------------------------------------------
+# Verb-aware retry semantics over real HTTP (stub server + FaultPlan)
+# ---------------------------------------------------------------------------
+
+
+def _pod(name: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {}}
+
+
+def _cluster_for(srv, **resilience_kw):
+    defaults = dict(max_attempts=4, base_backoff=0.01, max_backoff=0.05,
+                    breaker_threshold=0)
+    defaults.update(resilience_kw)
+    return RestCluster(KubeConfig("127.0.0.1", srv.port),
+                       registry=Registry(),
+                       resilience=ResilienceConfig(**defaults))
+
+
+class TestRestRetrySemantics:
+    def test_429_burst_retried_with_retry_after_pause(self):
+        plan = FaultPlan(throttle_after=0, throttle_burst=2,
+                         retry_after_s=0.02)
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv, qps=100.0)
+        try:
+            created = cluster.pods.create("default", _pod("p429"))
+            assert created["metadata"]["name"] == "p429"
+            assert plan.snapshot()["throttled"] == 2
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_5xx_exhausts_attempts_then_raises(self):
+        plan = FaultPlan(error_rate=1.0, error_verbs=("patch",),
+                         error_code=503)
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv)
+        try:
+            cluster.pods.create("default", _pod("p5xx"))
+            with pytest.raises(ServiceUnavailableError):
+                cluster.pods.patch("default", "p5xx",
+                                   {"metadata": {"labels": {"x": "1"}}})
+            # all 4 attempts were spent on the patch
+            assert plan.snapshot()["errors"] == 4
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_torn_create_resolves_already_exists_as_success(self):
+        """The POST ambiguity: the create COMMITS but its 201 is lost
+        (injected 503 after commit).  The retry hits AlreadyExists and
+        must resolve to the existing object — expectations semantics:
+        the pod exists exactly once, the caller sees success."""
+        plan = FaultPlan(error_rate=1.0, error_verbs=("create",),
+                         error_code=503, error_when="after")
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv)
+        try:
+            created = cluster.pods.create("default", _pod("torn"))
+            assert created["metadata"]["name"] == "torn"
+            assert created["metadata"]["uid"]
+            # exactly one pod exists server-side
+            assert len(srv.cluster.pods.list("default")) == 1
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_torn_delete_resolves_not_found_as_success(self):
+        """The DELETE ambiguity: the delete commits, the response is
+        lost, the retry 404s — resolved as success (no lost deletes)."""
+        plan = FaultPlan(error_rate=1.0, error_verbs=("delete",),
+                         error_code=503, error_when="after")
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv)
+        try:
+            cluster.pods.create("default", _pod("doomed"))
+            cluster.pods.delete("default", "doomed")  # must not raise
+            assert srv.cluster.pods.list("default") == []
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_first_attempt_already_exists_still_raises(self):
+        """AlreadyExists on a FIRST attempt is a real duplicate create
+        (someone else made the object) and must propagate — only the
+        retry path may resolve it."""
+        srv = StubApiServer().start()
+        cluster = _cluster_for(srv)
+        try:
+            cluster.pods.create("default", _pod("dup"))
+            with pytest.raises(AlreadyExistsError):
+                cluster.pods.create("default", _pod("dup"))
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_breaker_opens_fails_fast_and_recovers(self):
+        plan = FaultPlan(error_rate=1.0, error_verbs=("create",),
+                         error_code=503)
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv, max_attempts=1, breaker_threshold=2,
+                               breaker_reset=0.2)
+        try:
+            for _ in range(2):
+                with pytest.raises(ServiceUnavailableError):
+                    cluster.pods.create("default", _pod("pb"))
+            before = plan.snapshot()["requests"]
+            with pytest.raises(CircuitOpenError) as exc:
+                cluster.pods.create("default", _pod("pb"))
+            # failed fast: no request reached the server, and the error
+            # carries the requeue hint
+            assert plan.snapshot()["requests"] == before
+            assert 0 < exc.value.retry_in <= 0.2
+            assert cluster.resilience_snapshot()["state"] == "open"
+            # server heals; the half-open probe closes the breaker
+            plan.error_rate = 0.0
+            assert wait_for(lambda: cluster.breaker.allow(), timeout=2)
+            cluster.breaker.on_success()  # hand the probe slot back
+            created = cluster.pods.create("default", _pod("pb"))
+            assert created["metadata"]["name"] == "pb"
+            assert cluster.resilience_snapshot()["state"] == "closed"
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_429_answered_to_half_open_probe_closes_not_wedges(self):
+        """A 429 is a LIVE answer: answered to the half-open probe it
+        must release the probe slot and close the breaker — excluding
+        429 from on_failure without the on_success path would latch
+        _probing and wedge the client open forever."""
+        plan = FaultPlan(error_rate=1.0, error_verbs=("create",),
+                         error_code=503)
+        srv = StubApiServer(fault_plan=plan).start()
+        cluster = _cluster_for(srv, max_attempts=1, breaker_threshold=1,
+                               breaker_reset=0.05)
+        try:
+            with pytest.raises(ServiceUnavailableError):
+                cluster.pods.create("default", _pod("pw"))
+            assert cluster.breaker.state == "open"
+            # server recovers but sheds the probe with 429
+            plan.error_rate = 0.0
+            plan.arm_throttle_burst(1, retry_after_s=0.01)
+            assert wait_for(lambda: cluster.breaker.state == "half-open",
+                            timeout=2)
+            with pytest.raises(TooManyRequestsError):
+                cluster.pods.create("default", _pod("pw"))
+            # the 429 closed the breaker instead of wedging the probe
+            assert cluster.breaker.state == "closed"
+            created = cluster.pods.create("default", _pod("pw"))
+            assert created["metadata"]["name"] == "pw"
+        finally:
+            cluster.close()
+            srv.stop()
+
+    def test_retry_metrics_exported(self):
+        plan = FaultPlan(throttle_after=0, throttle_burst=1,
+                         retry_after_s=0.01)
+        srv = StubApiServer(fault_plan=plan).start()
+        registry = Registry()
+        cluster = RestCluster(
+            KubeConfig("127.0.0.1", srv.port), registry=registry,
+            resilience=ResilienceConfig(max_attempts=3,
+                                        base_backoff=0.01, qps=50.0))
+        try:
+            cluster.pods.create("default", _pod("pm"))
+            text = registry.expose()
+            assert ('pytorch_operator_rest_retries_total'
+                    '{verb="create",reason="throttled"} 1') in text
+            assert 'pytorch_operator_circuit_breaker_state 0' in text
+        finally:
+            cluster.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sim-tier fault injection (FakeCluster consults the same plan)
+# ---------------------------------------------------------------------------
+
+
+def test_fake_cluster_injects_classified_errors():
+    plan = FaultPlan(error_rate=1.0, error_verbs=("create",),
+                     error_code=503)
+    cluster = FakeCluster(fault_plan=plan)
+    with pytest.raises(ServiceUnavailableError):
+        cluster.pods.create("default", _pod("px"))
+    plan.error_rate = 0.0
+    cluster.pods.create("default", _pod("px"))
+    assert len(cluster.pods.list("default")) == 1
+
+
+def test_fake_cluster_rejects_after_commit_faults_loudly():
+    """error_when='after' (torn response) needs response framing to
+    tear — only the stub server models it.  The fake must refuse
+    loudly, not silently run a different scenario than the test asked
+    for."""
+    cluster = FakeCluster(fault_plan=FaultPlan(
+        error_rate=1.0, error_when="after"))
+    with pytest.raises(ValueError, match="http-tier-only"):
+        cluster.pods.create("default", _pod("pa"))
+
+
+# ---------------------------------------------------------------------------
+# http-tier sim e2e: Succeeded through an unreliable apiserver
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chaos_world(e2e_artifacts):
+    """Operator over real HTTP against a stub apiserver executing the
+    chaos plan (transient 5xx on every mutating verb + one 429 burst +
+    watch resets), with the resilience layer on and /metrics served."""
+    plan = FaultPlan(error_rate=0.10, error_code=503,
+                     throttle_after=20, throttle_burst=4,
+                     retry_after_s=0.05, watch_reset_every=25, seed=5)
+    srv = StubApiServer(fault_plan=plan).start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    registry = Registry()
+    rest = RestCluster(
+        KubeConfig("127.0.0.1", srv.port), namespace="default",
+        registry=registry,
+        resilience=ResilienceConfig(qps=200.0, burst=400, max_attempts=5,
+                                    base_backoff=0.02, max_backoff=0.2,
+                                    breaker_threshold=5,
+                                    breaker_reset=0.3))
+    ctl = PyTorchController(rest, config=JobControllerConfig(),
+                            registry=registry)
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    server = start_metrics_server(registry, 0, host="127.0.0.1")
+    e2e_artifacts["port"] = server.server_address[1]
+    # a failing run additionally captures breaker + retry state
+    e2e_artifacts["extra"]["resilience.json"] = (
+        lambda: json.dumps({"breaker": rest.resilience_snapshot(),
+                            "faults": plan.snapshot(),
+                            "server_responses": dict(srv.counters)},
+                           indent=1))
+    yield srv, plan, rest, ctl, registry, server.server_address[1]
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+    rest.close()
+    server.shutdown()
+    srv.stop()
+
+
+def test_e2e_job_succeeds_through_chaotic_apiserver(chaos_world):
+    srv, plan, rest, ctl, registry, port = chaos_world
+    srv.cluster.jobs.create("default",
+                            new_job(workers=3, name="chaos-job").to_dict())
+
+    def succeeded():
+        try:
+            job = srv.cluster.jobs.get("default", "chaos-job")
+        except NotFoundError:
+            return False
+        return any(c.get("type") == "Succeeded"
+                   and c.get("status") == "True"
+                   for c in (job.get("status") or {}).get("conditions")
+                   or [])
+
+    assert wait_for(succeeded, timeout=60), (
+        f"job stuck; faults={plan.snapshot()} "
+        f"responses={dict(srv.counters)} "
+        f"breaker={rest.resilience_snapshot()}")
+
+    # the plan genuinely fired (the e2e exercised faults, not a
+    # fault-free pass) ...
+    snapshot = plan.snapshot()
+    assert snapshot["errors"] + snapshot["throttled"] > 0
+    # ... and the expectations ledger held: exactly the declared gang,
+    # every pod name unique, zero duplicate-create conflicts at the
+    # server (an AlreadyExists answered to a FIRST attempt would count
+    # here; retry-resolved ones cannot occur with error_when=before)
+    pods = srv.cluster.pods.list("default")
+    assert len(pods) == 4
+    assert len({p["metadata"]["name"] for p in pods}) == 4
+    assert srv.counters.get("POST 409", 0) == 0
+
+    # retry counters are visible on the operator's /metrics
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    retried = sum(
+        int(v) for v in re.findall(
+            r'pytorch_operator_rest_retries_total\{[^}]*\} (\d+)', text))
+    assert retried > 0
+    assert "pytorch_operator_circuit_breaker_state" in text
+    # the breaker ended the run closed (the apiserver was flaky, not
+    # down) and the job never lost a delete or duplicated a create
+    assert rest.resilience_snapshot()["state"] in ("closed", "disabled")
+
+
+def test_watch_reset_heals_via_gap_relist():
+    """A watch stream torn down mid-event must surface as a GAP (not a
+    clean EOF): the informer relists and no event is silently lost."""
+    plan = FaultPlan(watch_reset_every=1)  # every event tears the stream
+    srv = StubApiServer(fault_plan=plan).start()
+    cluster = _cluster_for(srv)
+    seen = []
+    try:
+        cluster.pods.add_listener(lambda et, obj: seen.append(
+            (et, (obj.get("metadata") or {}).get("name"))))
+        srv.cluster.pods.create("default", _pod("w1"))
+        # the event is truncated mid-line; the stream dies; the client
+        # must report GAP so the informer's relist can heal the cache
+        assert wait_for(lambda: ("GAP", "") in [(e, n or "")
+                                                for e, n in seen],
+                        timeout=10), seen
+        assert plan.snapshot()["watch_resets"] >= 1
+    finally:
+        cluster.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI flag surface
+# ---------------------------------------------------------------------------
+
+
+def test_operator_resilience_flags_parse():
+    from pytorch_operator_tpu.cmd.operator import build_parser
+
+    args = build_parser().parse_args(
+        ["--kube-api-qps", "20", "--kube-api-burst", "40",
+         "--kube-api-retries", "3", "--circuit-breaker-threshold", "7",
+         "--circuit-breaker-reset", "2s"])
+    assert args.qps == 20.0 and args.burst == 40
+    assert args.kube_api_retries == 3
+    assert args.circuit_breaker_threshold == 7
+    assert args.circuit_breaker_reset == "2s"
+    # the historical spellings stay valid
+    legacy = build_parser().parse_args(["--qps", "9", "--burst", "18"])
+    assert legacy.qps == 9.0 and legacy.burst == 18

@@ -223,6 +223,47 @@ def test_bench_churn_pods_smoke(monkeypatch):
     assert frac is not None and 0.0 <= frac <= 1.0
 
 
+def test_bench_chaos_apiserver_tier_smoke(monkeypatch, tmp_path):
+    """ISSUE 5: the apiserver fault tier must run end to end — the
+    resilient client converges under the committed fault plan with zero
+    duplicate creates and exact pod counts, retries are counted, and
+    the markdown section updater rewrites only its delimited region."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE",
+                       os.environ.get("PYTORCH_OPERATOR_NATIVE", ""))
+    import bench_control_plane as bcp
+
+    res = bcp.run_chaos_apiserver(jobs=2, workers=1, resilient=True,
+                                  timeout=90.0)
+    assert res["converged"], res
+    assert res["duplicate_create_conflicts"] == 0
+    assert res["pods_match_expected"], res
+    assert res["rest_retries"] + res["faults_injected"]["throttled"] > 0
+
+    # the section updater: replaces its own delimited region, touches
+    # nothing else, and appends when the section is absent
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nbody stays\n")
+    section = "\n".join([bcp.CHAOS_APISERVER_BEGIN, "v1",
+                         bcp.CHAOS_APISERVER_END])
+    bcp.update_md_section(str(md), bcp.CHAOS_APISERVER_BEGIN,
+                          bcp.CHAOS_APISERVER_END, section)
+    text = md.read_text()
+    assert "body stays" in text and "v1" in text
+    bcp.update_md_section(str(md), bcp.CHAOS_APISERVER_BEGIN,
+                          bcp.CHAOS_APISERVER_END,
+                          section.replace("v1", "v2"))
+    text = md.read_text()
+    assert "v2" in text and "v1" not in text
+    assert text.count(bcp.CHAOS_APISERVER_BEGIN) == 1
+
+    # the verdict renderer runs on real results (content sanity only)
+    fake_ab = {"chaos_apiserver_resilient": res,
+               "chaos_apiserver_single_shot": res}
+    out = bcp.render_chaos_apiserver_md(fake_ab, 2, 1)
+    assert "Chaos-apiserver verdict" in out
+
+
 def test_bench_chaos_tier_smoke(monkeypatch):
     """The --chaos tier (ROADMAP item) must run end to end: proactive
     variant fires gang restarts and populates the restart-latency

@@ -217,11 +217,42 @@ class TestPushGateway:
         ]})
         assert out["accepted"] == 0 and out["rejected"] == 5
         text = registry.expose()
-        assert 'pytorch_operator_push_rejected_total 5' in text
+        # rejected counter is labeled by reason (the unknown_job reason
+        # rides the same family; see TestPushJobValidation)
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="unknown_family"} 1') in text
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="op_mismatch"} 1') in text
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="bad_value"} 3') in text
         # a rejected sample must not have minted a series for its job
         # (it would burn a budget slot and export a zero-valued series)
         assert 'job="default/j1"' not in text
         assert out["dropped"] == 0
+
+    def test_unknown_job_rejected_when_validator_set(self):
+        """ROADMAP push-hardening item: with a job validator wired (the
+        operator passes the job informer store), a payload whose job
+        does not name a live PyTorchJob is rejected wholesale under
+        reason="unknown_job" and mints nothing."""
+        registry = Registry()
+        live = {"default/real-job"}
+        gw = PushGateway(registry, job_validator=lambda j: j in live)
+        out = gw.ingest({"job": "default/ghost", "samples": [
+            {"name": STEP_DURATION, "op": "observe", "value": 0.02},
+            {"name": TOKENS_PER_SEC, "op": "set", "value": 1500.5},
+        ]})
+        assert out == {"accepted": 0, "rejected": 2, "dropped": 0}
+        text = registry.expose()
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="unknown_job"} 2') in text
+        assert 'job="default/ghost"' not in text
+        # a live job's samples pass through the same gateway untouched
+        out = gw.ingest({"job": "default/real-job", "samples": [
+            {"name": TOKENS_PER_SEC, "op": "set", "value": 99.0}]})
+        assert out["accepted"] == 1
+        assert ('pytorch_operator_job_tokens_per_second'
+                '{job="default/real-job"} 99') in registry.expose()
 
     def test_malformed_payload_raises_for_http_400(self):
         gw = PushGateway(Registry())
