@@ -60,16 +60,13 @@ def _probe_tpu():
     try:
         dev = jax.devices()[0]
         err = None
-    except RuntimeError as e:
+    except Exception as e:  # UNAVAILABLE tunnels etc. aren't always RuntimeError
         dev, err = None, str(e)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            jax.extend.backend.clear_backends()
-        except Exception:
-            pass
+        _clear_backend_cache()
         try:
             dev = jax.devices("cpu")[0]
-        except RuntimeError:
+        except Exception:
             return None, f"no usable jax backend (cpu fallback failed): {err}"
     if dev.platform != "tpu" and os.environ.get(
             "PYTORCH_OPERATOR_BENCH_CPU") != "1":
@@ -78,18 +75,99 @@ def _probe_tpu():
     return dev, None
 
 
+def _emit_skipped(reason: str) -> None:
+    print(f"[bench] skipped: {reason}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "dist-MNIST training throughput",
+        "unit": "images/sec/chip",
+        "skipped": True,
+        "reason": reason,
+    }))
+
+
+def _is_backend_init_error(e: BaseException) -> bool:
+    """A RuntimeError that smells like PJRT backend init dying (the
+    BENCH_r05 shape: jax.devices() raising UNAVAILABLE through a downed
+    TPU tunnel) rather than a bug in the measured code."""
+    msg = str(e)
+    return any(marker in msg for marker in (
+        "UNAVAILABLE",
+        "Unable to initialize backend",
+        "TPU backend",
+        "DEADLINE_EXCEEDED",
+        "backend setup",
+    ))
+
+
+def _clear_backend_cache() -> bool:
+    """Drop jax's cached PJRT clients so the next ``jax.devices()``
+    really re-initializes.  ``jax.extend`` is NOT exposed by a plain
+    ``import jax`` (the bare attribute access raises AttributeError on
+    this jax) — it must be imported explicitly."""
+    try:
+        from jax.extend import backend
+
+        backend.clear_backends()
+        return True
+    except Exception:
+        return False
+
+
+def _backend_alive_on_reprobe() -> bool:
+    """Confirm an infra-looking measurement error really is infra: drop
+    the cached PJRT client and re-init.  A healthy re-init means the
+    backend is alive, so the error was a genuine bug in the measured
+    code (the marker match alone can't tell — a real regression's
+    message may contain "TPU backend" or DEADLINE_EXCEEDED); re-init
+    raising — or hanging, which a dead tunnel can do — means the round
+    really is skippable.  The re-init runs on a daemon thread bounded
+    by GRAFT_BACKEND_PROBE_TIMEOUT (like dryrun_multichip's probe) so
+    a hung tunnel can't wedge the bench."""
+    import threading
+
+    import jax
+
+    if not _clear_backend_cache():
+        # can't drop the cache -> jax.devices() would just read the
+        # stale client list and "confirm" a dead backend alive; fall
+        # back to trusting the marker match (the skip-leaning default
+        # this satellite exists for)
+        return False
+    alive = []
+
+    def _probe():
+        try:
+            jax.devices()
+        except Exception:
+            return
+        alive.append(True)
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(float(os.environ.get("GRAFT_BACKEND_PROBE_TIMEOUT", "45")))
+    return bool(alive)
+
+
 def main() -> None:
     dev, skip_reason = _probe_tpu()
     if dev is None:
-        print(f"[bench] skipped: {skip_reason}", file=sys.stderr)
-        print(json.dumps({
-            "metric": "dist-MNIST training throughput",
-            "unit": "images/sec/chip",
-            "skipped": True,
-            "reason": skip_reason,
-        }))
+        _emit_skipped(skip_reason)
         return
+    try:
+        _measure(dev)
+    except Exception as e:  # UNAVAILABLE isn't always RuntimeError (probe ↑)
+        # ROADMAP direction 5 tail: a backend that passed the probe but
+        # died before/while measuring (flaky tunnel) is a skipped round
+        # — rc=1 here poisoned the BENCH_r05 trend.  Genuine measurement
+        # bugs still crash loudly, including ones whose message merely
+        # contains an infra marker: the re-probe sees a live backend
+        # and re-raises.
+        if not _is_backend_init_error(e) or _backend_alive_on_reprobe():
+            raise
+        _emit_skipped(f"backend died during measurement: {e}")
 
+
+def _measure(dev) -> None:
     import jax
 
     # persistent compile cache: first bench run pays the (slow) TPU

@@ -300,16 +300,19 @@ def main() -> int:
     if args.checkpoint_dir:
         import orbax.checkpoint as ocp
 
+        from pytorch_operator_tpu.parallel import restore_on_mesh
+
         mngr = ocp.CheckpointManager(os.path.abspath(args.checkpoint_dir))
         latest = mngr.latest_step()
         if latest is not None:
-            abstract = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-                state,
-            )
-            state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+            # restore onto the CURRENT state's shardings: the checkpoint
+            # may have been written at a different world size (an
+            # elastic gang that shrank or grew between runs) — orbax
+            # reshards each array onto this mesh during the read
+            state = restore_on_mesh(mngr, latest, state)
             start_step = latest
-            print(f"restored checkpoint at step {latest}", flush=True)
+            print(f"restored checkpoint at step {latest} onto "
+                  f"{n} device(s)", flush=True)
 
     tokens_per_step = args.batch_size * args.seq_len
     # --profile-dir: trace steps [start+1, start+profile_steps] — step 0 is

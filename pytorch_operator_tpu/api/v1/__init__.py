@@ -7,6 +7,7 @@ pkg/apis/pytorch/validation packages.
 from . import constants
 from .defaults import set_defaults
 from .types import (
+    ElasticPolicy,
     JobCondition,
     JobStatus,
     PyTorchJob,
@@ -22,6 +23,7 @@ __all__ = [
     "set_defaults",
     "validate_spec",
     "ValidationError",
+    "ElasticPolicy",
     "PyTorchJob",
     "PyTorchJobSpec",
     "JobStatus",

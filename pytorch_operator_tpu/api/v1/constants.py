@@ -49,6 +49,10 @@ JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
+# Elastic-gang extension: set True while the gang is moving between
+# worker counts (drain-shrink on preemption, grow on returned capacity),
+# cleared (status False) once actual matches desired again.
+JOB_RESIZING = "Resizing"
 
 # --- Labels (reference: controller.go:55-58, jobcontroller.go:138-147) -----
 LABEL_GROUP_NAME = "group-name"
@@ -111,13 +115,53 @@ POD_CONDITION_DISRUPTION_TARGET = "DisruptionTarget"
 
 # Node taints that mean "this node is going away" — the single source of
 # the detection vocabulary shared by disruption.detector (recognition)
-# and k8s.fake_kubelet (injection).
+# and k8s.fake_kubelet (injection).  The last two are the
+# graceful-node-shutdown spellings: the out-of-service taint an operator
+# (human or controller) applies to a shut-down node, and the shutdown
+# taint cloud providers set while a VM powers down.
 IMPENDING_NODE_TERMINATION_TAINT = (
     "cloud.google.com/impending-node-termination")
 NODE_UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
 NODE_NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+NODE_OUT_OF_SERVICE_TAINT = "node.kubernetes.io/out-of-service"
+CLOUD_NODE_SHUTDOWN_TAINT = "node.cloudprovider.kubernetes.io/shutdown"
 DISRUPTION_TAINT_KEYS = (
     IMPENDING_NODE_TERMINATION_TAINT,
     NODE_UNREACHABLE_TAINT,
     NODE_NOT_READY_TAINT,
+    NODE_OUT_OF_SERVICE_TAINT,
+    CLOUD_NODE_SHUTDOWN_TAINT,
 )
+
+# --- Elastic gangs ----------------------------------------------------------
+# Resizing condition reasons: set on shrink (drain the doomed slice,
+# keep training on the survivors) and on grow (schedulable TPU capacity
+# returned, gang restored toward the configured replica count).
+RESIZE_SHRINK_REASON = "ShrinkOnPreemption"
+RESIZE_GROW_REASON = "GrowOnCapacity"
+RESIZE_COMPLETED_REASON = "ElasticResizeCompleted"
+# A shrink widened mid-drain below minReplicas is abandoned for the
+# legacy full restart: the Resizing condition clears with this reason
+# and the consumed budget slot is returned (no resize happened).
+RESIZE_ABANDONED_REASON = "ElasticResizeAbandoned"
+# Emitted instead of a shrink once the per-job resize budget is spent
+# (the job then falls back to the legacy full-gang restart path).
+ELASTIC_RESIZES_EXHAUSTED_REASON = "ElasticResizesExhausted"
+
+# Drain protocol annotations (on replica pods):
+#   checkpoint-requested — the controller's signal to a doomed pod that
+#     it must checkpoint now (the kubelet delivers SIGTERM alongside; in
+#     sim the fake kubelet answers the annotation directly);
+#   checkpointed — the pod's acknowledgement that its state is on disk;
+#     the drain completes early once every doomed pod acked.
+ANNOTATION_CHECKPOINT_REQUESTED = "pytorch.kubeflow.org/checkpoint-requested"
+ANNOTATION_CHECKPOINTED = "pytorch.kubeflow.org/checkpointed"
+# Re-rendered rendezvous for a resized gang: running pods cannot take
+# new env vars, so the surviving replicas' WORLD_SIZE/RANK/hostnames are
+# re-published as annotations (readable via the downward API) whenever
+# the gang's effective size changes.
+ANNOTATION_ELASTIC_WORLD_SIZE = "pytorch.kubeflow.org/elastic-world-size"
+ANNOTATION_ELASTIC_RANK = "pytorch.kubeflow.org/elastic-rank"
+ANNOTATION_ELASTIC_HOSTNAMES = "pytorch.kubeflow.org/elastic-hostnames"
+# Per-job override of the operator-wide --max-elastic-resizes budget.
+ANNOTATION_MAX_ELASTIC_RESIZES = "pytorch.kubeflow.org/max-elastic-resizes"

@@ -62,6 +62,13 @@ class JobStatus:
     # the serde omitempty invariant: an untouched status serializes to
     # nothing.
     preemption_restarts: Optional[int] = None
+    # Elastic resize state machine: the current Worker target the
+    # controller reconciles toward (None = the spec's replica count —
+    # the job has never been resized), and the shrink budget consumed so
+    # far.  Both persist through the status merge-patch so a restarted
+    # operator resumes the resize where it left off.
+    desired_replicas: Optional[int] = None
+    elastic_resizes: Optional[int] = None
 
 
 @dataclass
@@ -69,6 +76,23 @@ class SchedulingPolicy:
     """Gang-scheduling knobs (kubeflow/common types.go:180-191)."""
 
     min_available: Optional[int] = None
+
+
+@dataclass
+class ElasticPolicy:
+    """Elastic-gang bounds for the Worker replica set.
+
+    A job carrying an elasticPolicy opts into checkpoint-drain-resize on
+    preemption: losing workers shrinks the gang to the surviving slice
+    (never below ``min_replicas``) instead of the full delete-recreate
+    restart, and the gang grows back toward the configured replica count
+    (never above ``max_replicas``) when schedulable TPU capacity
+    returns.  Mirrors the upstream training-operator's
+    ``spec.elasticPolicy.{minReplicas,maxReplicas}`` shape.
+    """
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
 
 
 @dataclass
@@ -81,6 +105,7 @@ class PyTorchJobSpec:
     clean_pod_policy: Optional[str] = None
     ttl_seconds_after_finished: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
+    elastic_policy: Optional[ElasticPolicy] = None
     # Map keyed "Master" / "Worker" (reference types.go:74-98).
     pytorch_replica_specs: Dict[str, ReplicaSpec] = field(
         default_factory=dict, metadata={"k8s": "pytorchReplicaSpecs"}

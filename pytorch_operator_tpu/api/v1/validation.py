@@ -7,6 +7,12 @@ pkg/apis/pytorch/validation/validation.go:23-77:
   * every replica spec needs at least one container, every container an
     image, and one container must be named ``pytorch``;
   * a Master spec must exist with exactly one replica.
+
+Elastic extension: an ``elasticPolicy`` must name a Worker replica set,
+carry sane bounds (1 <= minReplicas <= maxReplicas), and bracket the
+configured Worker count — the resize machinery shrinks/grows strictly
+inside [minReplicas, maxReplicas], so a spec outside its own bounds
+could never be reconciled.
 """
 
 from __future__ import annotations
@@ -58,3 +64,47 @@ def validate_spec(spec: PyTorchJobSpec) -> None:
         raise ValidationError(
             "PyTorchJobSpec is not valid: Master ReplicaSpec must be present"
         )
+
+    _validate_elastic_policy(spec)
+
+
+def _validate_elastic_policy(spec: PyTorchJobSpec) -> None:
+    policy = spec.elastic_policy
+    if policy is None:
+        return
+    worker = spec.pytorch_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+    if worker is None:
+        raise ValidationError(
+            "PyTorchJobSpec is not valid: elasticPolicy requires a Worker "
+            "ReplicaSpec (only Workers resize; the Master is the rendezvous "
+            "anchor)"
+        )
+    min_r = policy.min_replicas
+    max_r = policy.max_replicas
+    for name, value in (("minReplicas", min_r), ("maxReplicas", max_r)):
+        # bool before int: isinstance(True, int) holds in Python, and a
+        # YAML `minReplicas: true` must not silently become a floor of 1
+        if value is not None and (isinstance(value, bool)
+                                  or not isinstance(value, int)
+                                  or value < 1):
+            raise ValidationError(
+                f"PyTorchJobSpec is not valid: elasticPolicy.{name} must be "
+                f"an integer >= 1, got {value!r}"
+            )
+    if min_r is not None and max_r is not None and min_r > max_r:
+        raise ValidationError(
+            f"PyTorchJobSpec is not valid: elasticPolicy.minReplicas "
+            f"({min_r}) exceeds maxReplicas ({max_r})"
+        )
+    configured = worker.replicas
+    if configured is not None:
+        if min_r is not None and configured < min_r:
+            raise ValidationError(
+                f"PyTorchJobSpec is not valid: Worker replicas "
+                f"({configured}) below elasticPolicy.minReplicas ({min_r})"
+            )
+        if max_r is not None and configured > max_r:
+            raise ValidationError(
+                f"PyTorchJobSpec is not valid: Worker replicas "
+                f"({configured}) above elasticPolicy.maxReplicas ({max_r})"
+            )

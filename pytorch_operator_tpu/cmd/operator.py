@@ -132,6 +132,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(per-job override: the "
                         "pytorch.kubeflow.org/max-preemption-restarts "
                         "annotation)")
+    p.add_argument("--drain-deadline", default="30s",
+                   help="how long a doomed pod of an elastic job gets "
+                        "to acknowledge the checkpoint signal before the "
+                        "shrink deletes it anyway (duration string; the "
+                        "drain completes early once every doomed pod "
+                        "acked)")
+    p.add_argument("--max-elastic-resizes", type=int, default=3,
+                   help="checkpoint-drain shrinks allowed per elastic "
+                        "job before falling back to the full gang "
+                        "restart (per-job override: the "
+                        "pytorch.kubeflow.org/max-elastic-resizes "
+                        "annotation)")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for the /metrics, /push/v1/metrics, "
                         "/debug/traces, /healthz and /readyz endpoints "
@@ -264,6 +276,11 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         logger.info("connected to API server %s:%d",
                     kube_config.host, kube_config.port)
 
+    try:
+        drain_deadline = parse_duration(args.drain_deadline)
+    except ValueError as e:
+        logger.error("invalid --drain-deadline: %s", e)
+        return 1
     config = JobControllerConfig(
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
@@ -272,6 +289,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         resync_period_seconds=parse_duration(args.resync_period),
         enable_disruption_handling=args.enable_disruption_handling,
         max_preemption_restarts=args.max_preemption_restarts,
+        drain_deadline_seconds=drain_deadline,
+        max_elastic_resizes=args.max_elastic_resizes,
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)

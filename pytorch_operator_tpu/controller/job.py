@@ -216,5 +216,22 @@ def get_total_replicas(job: PyTorchJob) -> int:
     return sum(int(s.replicas or 0) for s in job.spec.pytorch_replica_specs.values())
 
 
+def get_total_effective_replicas(job: PyTorchJob) -> int:
+    """get_total_replicas with the elastic resize target applied: a
+    shrunken elastic job counts its Workers at status.desiredReplicas
+    (clamped to the configured count) so gang minMember, the
+    active-vs-total compare and the backoff math all track the size the
+    controller is actually reconciling toward."""
+    total = 0
+    for rtype, spec in job.spec.pytorch_replica_specs.items():
+        n = int(spec.replicas or 0)
+        if (rtype == constants.REPLICA_TYPE_WORKER
+                and job.spec.elastic_policy is not None
+                and job.status.desired_replicas is not None):
+            n = min(job.status.desired_replicas, n)
+        total += n
+    return total
+
+
 def get_total_failed_replicas(job: PyTorchJob) -> int:
     return sum(rs.failed for rs in job.status.replica_statuses.values())

@@ -42,10 +42,14 @@ class PodReconcilerMixin:
         rtype: str,
         spec: ReplicaSpec,
         gang_enabled: bool | None = None,
+        elastic_target: int | None = None,
     ) -> None:
         """pod.go:49-117.  ``gang_enabled`` lets the caller pass the
         per-sync gang decision down; None recomputes (compat for direct
-        callers in tests)."""
+        callers in tests).  ``elastic_target`` below the configured
+        count switches this replica set into the shrunken-elastic
+        reconcile (drained index holes are not recreated; survivors
+        keep their restart-policy semantics)."""
         if gang_enabled is None:
             gang_enabled = self.gang_scheduling_enabled(job)
         rt = rtype.lower()
@@ -69,6 +73,23 @@ class PodReconcilerMixin:
         creates, delete_rows, warns, counts, restart = (
             reconcile_plan.plan_replica_set(replicas, exit_code_policy, rows))
 
+        # Shrunken elastic gang: the surviving slice IS the gang, so
+        # index holes left by drained workers are NOT recreated
+        # wholesale (the grow path restores the full index space
+        # later).  Everything else is the normal reconcile — the spec's
+        # restart policy still applies to SURVIVORS (a retryably-failed
+        # worker's node outlived it, unlike the drained holes'), and
+        # only enough of the LOWEST empty indices are refilled to keep
+        # elastic_target workers occupied, so a restarted survivor's
+        # replacement appears on the next sync while the remaining
+        # holes wait for capacity.
+        shrunken = elastic_target is not None and elastic_target < replicas
+        allowed_creates = None
+        if shrunken:
+            occupied = replicas - len(creates)
+            need = max(0, elastic_target - occupied)
+            allowed_creates = frozenset(creates[:need])
+
         create_set = frozenset(creates)
         warn_set = frozenset(warns)
         delete_set = frozenset(delete_rows)
@@ -86,6 +107,9 @@ class PodReconcilerMixin:
         planned: List[dict] = []
         for index in range(replicas):
             if index in create_set:
+                if allowed_creates is not None and \
+                        index not in allowed_creates:
+                    continue  # drained hole: the grow path restores it
                 log.info("Need to create new pod: %s-%d", rt, index)
                 master_role = rtype == constants.REPLICA_TYPE_MASTER
                 planned.append(self.build_new_pod(
@@ -122,7 +146,9 @@ class PodReconcilerMixin:
 
         status_machine.apply_replica_counts(job.status, rtype, *counts)
 
-        self.update_status_single(job, job_dict, rtype, replicas, restart)
+        self.update_status_single(
+            job, job_dict, rtype,
+            elastic_target if shrunken else replicas, restart)
 
     # ------------------------------------------------------------------
     def create_new_pod(

@@ -34,7 +34,13 @@ from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from . import status as status_machine
-from .job import JobLifecycleMixin, get_total_failed_replicas, get_total_replicas, parse_time
+from .job import (
+    JobLifecycleMixin,
+    get_total_effective_replicas,
+    get_total_replicas,
+    get_total_failed_replicas,
+    parse_time,
+)
 from .pod import PodReconcilerMixin
 from .service import ServiceReconcilerMixin
 
@@ -368,6 +374,7 @@ class PyTorchController(
             # (nor fire against a same-key recreate)
             with self._disruption_lock:
                 self._pending_disruptions.pop(key, None)
+            self.clear_elastic_state(key)
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
@@ -445,6 +452,11 @@ class PyTorchController(
         if status_machine.is_succeeded(job.status) or status_machine.is_failed(job.status):
             self.delete_pods_and_services(job, job_dict, pods, services)
             self.cleanup_job(job)
+            # a terminal job keeps its key until deletion: drop its
+            # elastic notes NOW (shrunken registration, grow capacity
+            # claim) or its claim starves other shrunken jobs' grows
+            # and every capacity event keeps waking it pointlessly
+            self.clear_elastic_state(job_key)
             if gang:
                 self.delete_pod_group(job_dict)
             if status_machine.is_succeeded(job.status):
@@ -458,12 +470,24 @@ class PyTorchController(
 
         # Proactive disruption handling: an impending preemption noted by
         # the watcher consumes this sync for ONE gang restart (batched
-        # pod delete + TPUPreempted Restarting condition) instead of the
+        # pod delete + TPUPreempted Restarting condition) — or, for
+        # elastic jobs, begins a checkpoint-drain-shrink — instead of the
         # per-replica reconcile below; the deletion expectations then
         # gate re-syncs until the informer has observed every delete, and
-        # the following sync recreates the full gang.
+        # the following sync recreates the full gang (or reconciles the
+        # surviving slice).
         if self.disruption_handling_enabled() and \
                 self.maybe_handle_disruption(job, job_dict, pods):
+            if job.status != old_status:
+                self.update_status_handler(job)
+            return
+
+        # Elastic continuation: a pending drain consumes the sync
+        # (waiting for checkpoint acks or issuing the shrink deletes); a
+        # pending grow / resize completion updates status and falls
+        # through so this very sync reconciles toward the new target.
+        if self.disruption_handling_enabled() and \
+                self.maybe_continue_elastic(job, job_dict, pods):
             if job.status != old_status:
                 self.update_status_handler(job)
             return
@@ -477,7 +501,15 @@ class PyTorchController(
         failed = sum(
             1 for p in pods if (p.get("status") or {}).get("phase") == "Failed"
         )
-        total = get_total_replicas(job)
+        # the elastic target only binds while disruption handling is on:
+        # reconcile_pods below gates elastic_target the same way, and a
+        # disagreement (operator restarted with the flag off while
+        # status.desiredReplicas persists shrunken) would pin minMember
+        # and the active-vs-total compare at the stale shrunken size
+        # while the full gang is recreated
+        total = (get_total_effective_replicas(job)
+                 if self.disruption_handling_enabled()
+                 else get_total_replicas(job))
         prev_failed = get_total_failed_replicas(job)
 
         job_exceeds_limit = False
@@ -519,10 +551,17 @@ class PyTorchController(
             self.jobs_failed_counter.inc()
         else:
             if gang:
-                self.sync_pod_group(job_dict, get_total_replicas(job))
+                # gang minMember tracks the ELASTIC target: a shrunken
+                # 6-worker slice must not wait on 8 members
+                self.sync_pod_group(job_dict, total)
             for rtype, spec in job.spec.pytorch_replica_specs.items():
+                elastic_target = None
+                if rtype == constants.REPLICA_TYPE_WORKER and \
+                        self.disruption_handling_enabled():
+                    elastic_target = self.elastic_worker_target(job)
                 self.reconcile_pods(job, job_dict, pods, rtype, spec,
-                                    gang_enabled=gang)
+                                    gang_enabled=gang,
+                                    elastic_target=elastic_target)
                 # TPU deviation: services for EVERY replica type (the
                 # reference skips non-Master, controller.go:474-477) — all
                 # hosts need DNS for TPU_WORKER_HOSTNAMES.

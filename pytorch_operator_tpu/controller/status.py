@@ -108,6 +108,17 @@ def update_job_conditions(
     set_condition(status, new_condition(cond_type, reason, message))
 
 
+def clear_condition(
+    status: JobStatus, cond_type: str, reason: str, message: str
+) -> None:
+    """Set ``cond_type`` to status False (e.g. Resizing once actual
+    replicas match desired again).  Rides set_condition so the
+    (status, reason) dedup and the terminal-status freeze apply."""
+    cond = new_condition(cond_type, reason, message)
+    cond.status = CONDITION_FALSE
+    set_condition(status, cond)
+
+
 def initialize_replica_statuses(status: JobStatus, rtype: str) -> None:
     status.replica_statuses[rtype] = ReplicaStatus()
 

@@ -134,6 +134,65 @@ def set_cluster_spec(pod_template: dict, job: PyTorchJob, index: str, rtype: str
         )
 
 
+def elastic_rendezvous_annotations(
+    job: PyTorchJob, pods: List[dict]
+) -> dict:
+    """Re-rendered rendezvous for a resized gang, keyed by pod name.
+
+    A running pod cannot take new env vars, so when an elastic gang
+    shrinks or grows the surviving replicas' coordinates are republished
+    as annotations (the elastic rendezvous reads them via the downward
+    API): the effective ``WORLD_SIZE`` (master + surviving workers),
+    each pod's effective ``RANK`` (master 0, workers dense-ranked by
+    their replica index so ranks stay contiguous across index holes
+    left by drained replicas), and the surviving gang's hostname list in
+    rank order — the same ordering contract ``TPU_WORKER_HOSTNAMES``
+    carries at pod creation (libtpu hangs on a mismatch).
+    """
+    name = job.metadata.name
+    masters, workers = [], []
+    for pod in pods:
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        rtype = labels.get(constants.LABEL_REPLICA_TYPE)
+        if rtype == constants.REPLICA_TYPE_MASTER.lower():
+            masters.append(pod)
+        elif rtype == constants.REPLICA_TYPE_WORKER.lower():
+            try:
+                index = int(labels.get(constants.LABEL_REPLICA_INDEX))
+            except (TypeError, ValueError):
+                continue
+            workers.append((index, pod))
+    workers.sort(key=lambda pair: pair[0])
+
+    # Rank 0 is ALWAYS the master slot: its hostname anchors the list
+    # (and the count) even when the master pod is momentarily absent
+    # from the informer view — a master restart racing the render must
+    # not produce world_size == len(workers) while the hostnames
+    # annotation still lists the master first (ranks would fall out of
+    # range and the survivors' rendezvous would hang).
+    world_size = 1 + len(workers)
+    hostnames = [gen_general_name(name, constants.REPLICA_TYPE_MASTER.lower(), 0)]
+    hostnames += [
+        gen_general_name(name, constants.REPLICA_TYPE_WORKER.lower(), index)
+        for index, _ in workers
+    ]
+    hostnames_value = ",".join(hostnames)
+
+    def ann(rank: int) -> dict:
+        return {
+            constants.ANNOTATION_ELASTIC_WORLD_SIZE: str(world_size),
+            constants.ANNOTATION_ELASTIC_RANK: str(rank),
+            constants.ANNOTATION_ELASTIC_HOSTNAMES: hostnames_value,
+        }
+
+    out = {}
+    for pod in masters:
+        out[pod["metadata"].get("name", "")] = ann(0)
+    for rank, (_, pod) in enumerate(workers, start=1):
+        out[pod["metadata"].get("name", "")] = ann(rank)
+    return out
+
+
 def requests_tpu(pod_template: dict) -> bool:
     """True when any container requests google.com/tpu chips."""
     for container in (pod_template.get("spec") or {}).get("containers") or []:

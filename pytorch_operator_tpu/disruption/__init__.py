@@ -18,28 +18,38 @@ closes the gap:
     exactly one proactive gang restart (batched delete via the
     ``delete_many`` fan-out, a ``Restarting`` condition with reason
     ``TPUPreempted``, an event, and a bounded per-job restart budget);
-  * :mod:`chaos` — scripted preemption storms over the fake kubelet's
-    injection API for the sim tier.
+  * :mod:`chaos` — scripted preemption storms and capacity flaps over
+    the fake kubelet's injection API for the sim tier.
+
+Elastic gangs (jobs with ``spec.elasticPolicy``) take the
+checkpoint-drain-resize path instead of the full restart: doomed
+workers checkpoint and drain, the gang shrinks to the surviving slice
+and keeps training, and the :class:`CapacityWatcher` grows it back when
+schedulable TPU nodes return.
 
 Enabled by ``--enable-disruption-handling`` in ``cmd/operator.py``.
 """
 
-from .chaos import PreemptionStorm
+from .chaos import CapacityFlap, PreemptionStorm
 from .detector import (
     DISRUPTION_TAINT_KEYS,
     is_tpu_node,
     node_disruption_reason,
+    node_schedulable_tpu,
     pod_disruption_reason,
 )
 from .handler import DisruptionHandlingMixin
-from .watcher import DisruptionWatcher
+from .watcher import CapacityWatcher, DisruptionWatcher
 
 __all__ = [
     "DISRUPTION_TAINT_KEYS",
+    "CapacityFlap",
+    "CapacityWatcher",
     "DisruptionHandlingMixin",
     "DisruptionWatcher",
     "PreemptionStorm",
     "is_tpu_node",
     "node_disruption_reason",
+    "node_schedulable_tpu",
     "pod_disruption_reason",
 ]

@@ -74,6 +74,79 @@ class PreemptionStorm:
             self._timers.clear()
 
 
+class CapacityFlap:
+    """A capacity dip-and-return: taint ``nodes`` (killing their pods
+    after ``grace``, exactly like a spot reclaim), then restore them to
+    schedulable later — the scenario an elastic gang rides through by
+    shrinking to the survivors and growing back, where the legacy path
+    pays a full delete-recreate restart.
+
+    ``down()`` / ``restore()`` drive the two phases explicitly (tests
+    usually assert the shrunken steady state in between); ``run()`` arms
+    both on timers for scripted scenarios.
+    """
+
+    def __init__(self, kubelet, nodes: Sequence[str], grace: float = 0.05,
+                 exit_code: int = 143, taint_key: Optional[str] = None,
+                 freeze_capacity: bool = False):
+        self.kubelet = kubelet
+        self.nodes = list(nodes)
+        self.grace = grace
+        self.exit_code = exit_code
+        self.taint_key = taint_key
+        # freeze_capacity=True makes the dip REAL: the kubelet stops
+        # provisioning fresh nodes while the flap is down, so a
+        # delete-recreate gang genuinely waits for capacity instead of
+        # escaping onto lazily minted nodes (the honest A/B regime for
+        # bench_control_plane --elastic).  Default off: the e2e tests
+        # assert the controller-side grow gating alone.
+        self.freeze_capacity = freeze_capacity
+        self._timers: List[threading.Timer] = []
+        self._lock = threading.Lock()
+
+    def down(self) -> "CapacityFlap":
+        if self.freeze_capacity:
+            self.kubelet.freeze_capacity()
+        for node in self.nodes:
+            kwargs = {"grace": self.grace, "exit_code": self.exit_code}
+            if self.taint_key is not None:
+                kwargs["taint_key"] = self.taint_key
+            self.kubelet.inject_preemption(node, **kwargs)
+        return self
+
+    def restore(self) -> "CapacityFlap":
+        for node in self.nodes:
+            self.kubelet.untaint_node(node)
+            self.kubelet.set_node_ready(node, True)
+        if self.freeze_capacity:
+            self.kubelet.unfreeze_capacity()
+        return self
+
+    def run(self, down_at: float = 0.0,
+            restore_after: float = 1.0) -> "CapacityFlap":
+        """Taint at ``down_at``, restore ``restore_after`` seconds after
+        the taint."""
+        def arm(delay, fn):
+            if delay <= 0:
+                fn()
+                return
+            timer = threading.Timer(delay, fn)
+            timer.daemon = True
+            with self._lock:
+                self._timers.append(timer)
+            timer.start()
+
+        arm(down_at, self.down)
+        arm(down_at + restore_after, self.restore)
+        return self
+
+    def cancel(self) -> None:
+        with self._lock:
+            for timer in self._timers:
+                timer.cancel()
+            self._timers.clear()
+
+
 def preempt_node_of_pod(kubelet, cluster, namespace: str, pod_name: str,
                         grace: float = 0.05) -> Optional[str]:
     """Convenience for tests: preempt whichever node the named pod is

@@ -28,6 +28,8 @@ from ..api.v1 import constants
 IMPENDING_NODE_TERMINATION_TAINT = constants.IMPENDING_NODE_TERMINATION_TAINT
 NODE_UNREACHABLE_TAINT = constants.NODE_UNREACHABLE_TAINT
 NODE_NOT_READY_TAINT = constants.NODE_NOT_READY_TAINT
+NODE_OUT_OF_SERVICE_TAINT = constants.NODE_OUT_OF_SERVICE_TAINT
+CLOUD_NODE_SHUTDOWN_TAINT = constants.CLOUD_NODE_SHUTDOWN_TAINT
 DISRUPTION_TAINT_KEYS = constants.DISRUPTION_TAINT_KEYS
 
 
@@ -63,6 +65,18 @@ def node_disruption_reason(node: dict) -> Optional[str]:
     if is_tpu_node(node) and _node_ready(node) is False:
         return "TPUNodeNotReady"
     return None
+
+
+def node_schedulable_tpu(node: dict) -> bool:
+    """A TPU node that can take new work: Ready and carrying no taints
+    at all (unrelated NoSchedule taints keep it out of the pool exactly
+    like the fake kubelet's binding rule).  The capacity watcher's
+    definition of "capacity returned"."""
+    if not is_tpu_node(node):
+        return False
+    if (node.get("spec") or {}).get("taints"):
+        return False
+    return _node_ready(node) is True
 
 
 def pod_disruption_reason(pod: dict) -> Optional[str]:

@@ -1,4 +1,5 @@
-"""Controller-side disruption policy: one detection -> one gang restart.
+"""Controller-side disruption policy: one detection -> one gang restart,
+or — for elastic jobs — one checkpoint-drain-resize.
 
 Mixed into PyTorchController.  The watcher (and the pod informer's
 ``DisruptionTarget`` hook) note disruptions into a pending map keyed by
@@ -11,6 +12,30 @@ warning event, and the per-job preemption budget
 per-job annotation) decremented.  Jobs that opted out, non-gang jobs,
 and jobs over budget fall through to the legacy per-pod failure path
 unchanged.
+
+Elastic extension (jobs carrying ``spec.elasticPolicy``): when the
+disruption dooms a strict subset of the gang's workers and the
+survivors stay at/above ``minReplicas``, the handler runs the
+checkpoint-drain-resize path instead of the full restart:
+
+  1. **drain** — the doomed pods are signalled to checkpoint (the
+     ``checkpoint-requested`` annotation; the kubelet delivers SIGTERM
+     alongside, and the sim's fake kubelet answers the annotation),
+     ``status.desiredReplicas`` drops to the surviving worker count and
+     the ``Resizing`` condition carries ``ShrinkOnPreemption``;
+  2. **shrink** — once every doomed pod acked (``checkpointed``) or the
+     bounded drain deadline passed, ONLY the doomed pods are deleted
+     (deletion expectations up-front, so rebalance never double-creates)
+     and the surviving gang keeps reconciling at the reduced size with
+     its rendezvous re-rendered (elastic annotations, tpu_env);
+  3. **grow** — the capacity watcher wakes shrunken jobs when
+     schedulable TPU nodes return; desiredReplicas climbs back toward
+     the configured count (``Resizing``/``GrowOnCapacity``) and the
+     normal index reconcile recreates the missing workers.
+
+The shrink budget (``status.elasticResizes`` vs ``--max-elastic-resizes``
+or the per-job annotation) parallels the preemption-restart budget; an
+exhausted budget falls back to the legacy full-gang restart.
 """
 
 from __future__ import annotations
@@ -21,13 +46,14 @@ from typing import Dict, List, Optional
 
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob
+from ..k8s.errors import NotFoundError
 from ..runtime.expectations import expectation_pods_key
 from ..runtime.informer import meta_namespace_key
 from ..runtime.job_controller import _controller_ref_of
 from ..runtime.logger import logger_for_job
-from ..runtime.recorder import EVENT_TYPE_WARNING
-from .detector import pod_disruption_reason
-from .watcher import DisruptionWatcher, PodNodeIndex
+from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+from .detector import node_schedulable_tpu, pod_disruption_reason
+from .watcher import CapacityWatcher, DisruptionWatcher, PodNodeIndex
 
 
 class DisruptionHandlingMixin:
@@ -56,40 +82,104 @@ class DisruptionHandlingMixin:
             "Seconds from disruption detection to the gang restart's "
             "batched pod delete being issued",
         )
+        # Elastic-gang state: pending drains (shrink in progress, doomed
+        # pods checkpointing), pending grows (capacity returned, resize
+        # up not yet applied), and the shrunken-job registry the
+        # capacity watcher consults.  All keyed by job, uid-fenced like
+        # the disruption notes, guarded by the same lock.
+        self._pending_drains: Dict[str, dict] = {}
+        self._pending_grows: Dict[str, dict] = {}
+        self._shrunken_jobs: Dict[str, str] = {}
+        # capacity claimed by grows applied but not yet completed (pods
+        # not yet bound): one capacity event waking N shrunken jobs must
+        # not grow them all onto the same free nodes
+        self._growing_claims: Dict[str, int] = {}
+        # injectable clock so drain-deadline tests run on a fake clock
+        self._mono = time.monotonic
+        self.elastic_resizes_counter = registry.counter_vec(
+            "pytorch_operator_elastic_resizes_total",
+            "Counts elastic gang resizes, labeled direction: shrink "
+            "(checkpoint-drain on preemption) or grow (capacity "
+            "returned)",
+            ("direction",))
+        self.elastic_drain_seconds = registry.histogram(
+            "pytorch_operator_elastic_drain_seconds",
+            "Seconds from the checkpoint signal to the doomed pods' "
+            "batched delete being issued (ack-early or deadline-bound)",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                     120.0),
+        )
+        self.elastic_drain_timeouts_counter = registry.counter(
+            "pytorch_operator_elastic_drain_timeouts_total",
+            "Counts drains that hit the deadline with unacked doomed "
+            "pods (their checkpoint state is presumed lost)",
+        )
         self.disruption_watcher: Optional[DisruptionWatcher] = None
+        self.capacity_watcher: Optional[CapacityWatcher] = None
         if self.config.enable_disruption_handling and \
                 self.node_informer is not None:
             # nodeName index over the pod informer (ROADMAP scalability
             # item): a disrupted node resolves its pods in one dict hit
             # instead of a cluster-wide LIST per node event
+            pod_index = PodNodeIndex(self.pod_informer)
             self.disruption_watcher = DisruptionWatcher(
-                self.cluster, self.node_informer, self._note_disruption,
-                kind=self.KIND,
-                pod_index=PodNodeIndex(self.pod_informer))
+                self.cluster, self.node_informer,
+                self._note_node_disruption, kind=self.KIND,
+                pod_index=pod_index)
+            self.capacity_watcher = CapacityWatcher(
+                self.node_informer, self._on_capacity_returned,
+                pod_index=pod_index, cluster=self.cluster)
 
     def disruption_handling_enabled(self) -> bool:
         return self.config.enable_disruption_handling
 
     # -- detection intake --------------------------------------------------
     def _note_disruption(self, job_key: str, reason: str, source: str,
-                         uid: Optional[str] = None) -> None:
+                         uid: Optional[str] = None,
+                         node: Optional[str] = None,
+                         pod: Optional[str] = None) -> None:
         """Record a disruption for the job and wake its sync.  Multiple
         signals for the same job coalesce while one note is pending —
         the whole point is ONE restart per disruption, not one per
         signal (taint + DisruptionTarget + N pod failures).  ``uid``
         fences the note to the job incarnation it was observed against:
-        a delete-recreate under the same key drops it at sync time."""
+        a delete-recreate under the same key drops it at sync time.
+        ``node``/``pod`` scope the doomed set for the elastic drain path
+        (unscoped notes always take the legacy full-gang restart)."""
         with self._disruption_lock:
-            if job_key in self._pending_disruptions:
+            existing = self._pending_disruptions.get(job_key)
+            if existing is not None:
+                # coalesce — but a scoped signal for a DIFFERENT node
+                # or pod must widen the pending note's doomed set (a
+                # capacity dip tainting two nodes back-to-back, or an
+                # eviction marking a pod while a node note is pending,
+                # is one disruption, not two), or the later signal's
+                # pods would be silently dropped from the elastic drain
+                # and never told to checkpoint
+                if existing.get("uid") == uid:
+                    if node and node not in existing["nodes"]:
+                        existing["nodes"].append(node)
+                    elif pod and pod not in existing["pods"]:
+                        existing["pods"].append(pod)
                 return
             self._pending_disruptions[job_key] = {
                 "reason": reason,
                 "source": source,
                 "uid": uid,
+                "nodes": [node] if node else [],
+                "pods": [pod] if pod else [],
                 "detected_at": time.monotonic(),
             }
         self.preemptions_detected_counter.inc()
         self.work_queue.add(job_key)
+
+    def _note_node_disruption(self, job_key: str, reason: str,
+                              node_name: str,
+                              uid: Optional[str] = None) -> None:
+        """DisruptionWatcher callback: a node-scoped note (the elastic
+        path dooms exactly the pods bound to that node)."""
+        self._note_disruption(job_key, reason, node_name, uid=uid,
+                              node=node_name)
 
     def note_pod_disruption(self, pod: dict) -> None:
         """Pod-informer hook (detection source 2): a ``DisruptionTarget``
@@ -131,7 +221,8 @@ class DisruptionHandlingMixin:
                 return
         self._note_disruption(
             job_key, reason, f'pod/{meta.get("name", "")}',
-            uid=(job.get("metadata") or {}).get("uid"))
+            uid=(job.get("metadata") or {}).get("uid"),
+            pod=meta.get("name", ""))
 
     # -- the proactive restart --------------------------------------------
     def maybe_handle_disruption(
@@ -163,6 +254,18 @@ class DisruptionHandlingMixin:
                      note["source"])
             self.preemption_restarts_suppressed_counter.inc()
             return False
+        if job.spec.elastic_policy is not None:
+            # Elastic path: shrink to the surviving slice instead of the
+            # full restart.  An ineligible disruption (whole gang doomed,
+            # master doomed, below minReplicas, budget spent, unscoped
+            # note) falls through to the legacy restart below.
+            try:
+                if self._begin_elastic_drain(job, job_dict, pods, note):
+                    return True
+            except Exception:
+                with self._disruption_lock:
+                    self._pending_disruptions.setdefault(job.key, note)
+                raise
         budget = self._preemption_budget(job)
         used = job.status.preemption_restarts or 0
         if used >= budget:
@@ -222,14 +325,569 @@ class DisruptionHandlingMixin:
         return True
 
     def _preemption_budget(self, job: PyTorchJob) -> int:
+        return self._annotation_budget(
+            job, constants.ANNOTATION_MAX_PREEMPTION_RESTARTS,
+            self.config.max_preemption_restarts)
+
+    def _elastic_budget(self, job: PyTorchJob) -> int:
+        return self._annotation_budget(
+            job, constants.ANNOTATION_MAX_ELASTIC_RESIZES,
+            self.config.max_elastic_resizes)
+
+    def _annotation_budget(self, job: PyTorchJob, annotation: str,
+                           default: int) -> int:
         annotations = job.metadata.annotations or {}
-        override = annotations.get(
-            constants.ANNOTATION_MAX_PREEMPTION_RESTARTS)
+        override = annotations.get(annotation)
         if override:
             try:
                 return max(0, int(override))
             except ValueError:
                 logger_for_job(self.logger, job).warning(
                     "invalid %s annotation %r; using operator default",
-                    constants.ANNOTATION_MAX_PREEMPTION_RESTARTS, override)
-        return self.config.max_preemption_restarts
+                    annotation, override)
+        return default
+
+    # -- the elastic checkpoint-drain-resize path --------------------------
+    def elastic_worker_target(self, job: PyTorchJob) -> Optional[int]:
+        """The Worker count this sync reconciles toward: None for
+        non-elastic jobs; otherwise status.desiredReplicas clamped to
+        the configured count (the grow ceiling)."""
+        if job.spec.elastic_policy is None:
+            return None
+        spec = job.spec.pytorch_replica_specs.get(
+            constants.REPLICA_TYPE_WORKER)
+        configured = int(spec.replicas or 0) if spec else 0
+        desired = job.status.desired_replicas
+        if desired is None:
+            return configured
+        return min(desired, configured)
+
+    def _begin_elastic_drain(self, job: PyTorchJob, job_dict: dict,
+                             pods: List[dict], note: dict) -> bool:
+        """Phase 1 of a shrink: signal the doomed pods to checkpoint,
+        move desiredReplicas to the surviving count, arm the drain
+        deadline.  Returns False when the disruption is not elastically
+        survivable (caller falls back to the legacy full restart)."""
+        log = logger_for_job(self.logger, job)
+        key = job.key
+        with self._disruption_lock:
+            in_flight = key in self._pending_drains
+        if in_flight:
+            # a second disruption landing mid-drain widens the SAME
+            # drain (one capacity change, one Resizing transition) — or,
+            # if the extra loss breaks the survivable floor, abandons the
+            # shrink so the legacy full restart takes over
+            return self._merge_into_drain(job, job_dict, pods, note)
+        doomed = self._doomed_pods(pods, note)
+        if not doomed or len(doomed) >= len(pods):
+            return False  # unscoped, pre-create, or the whole gang
+        for pod in doomed:
+            labels = (pod.get("metadata") or {}).get("labels") or {}
+            if labels.get(constants.LABEL_REPLICA_TYPE) != \
+                    constants.REPLICA_TYPE_WORKER.lower():
+                # the Master (or an unlabeled stray) is going down with
+                # the node: rank 0 anchors the rendezvous, shrink can't
+                # save this gang
+                return False
+        current = self.elastic_worker_target(job) or 0
+        new_target = current - len(doomed)
+        policy = job.spec.elastic_policy
+        min_replicas = policy.min_replicas or 1
+        if new_target < min_replicas:
+            log.warning(
+                "elastic shrink of %s would leave %d worker(s), below "
+                "minReplicas %d; falling back to the full gang restart",
+                key, new_target, min_replicas)
+            return False
+        budget = self._elastic_budget(job)
+        used = job.status.elastic_resizes or 0
+        if used >= budget:
+            msg = (f"PyTorchJob {job.metadata.name}: elastic resize "
+                   f"budget ({budget}) exhausted; falling back to the "
+                   f"full gang restart")
+            log.warning(msg)
+            self.recorder.event(
+                job_dict, EVENT_TYPE_WARNING,
+                constants.ELASTIC_RESIZES_EXHAUSTED_REASON, msg)
+            return False
+
+        self._signal_checkpoint(doomed)
+
+        deadline = self.config.drain_deadline_seconds
+        drain = {
+            "doomed": [p["metadata"].get("name", "") for p in doomed],
+            "uid": job.metadata.uid,
+            "target": new_target,
+            # the shrink's status payload rides in the note so a sync
+            # whose end-of-sync write failed can re-assert it (the note
+            # is the retry memory for the STATUS too, not just the
+            # deletes — see _continue_drain)
+            "resizes": used + 1,
+            "signaled_at": self._mono(),
+            "deadline": self._mono() + deadline,
+        }
+        with self._disruption_lock:
+            self._pending_drains[key] = drain
+        # a fresh shrink supersedes any not-yet-completed grow; the
+        # claimed nodes (if still free) become claimable by siblings
+        self._release_grow_claim(key)
+
+        job.status.desired_replicas = new_target
+        job.status.elastic_resizes = used + 1
+        msg = (f"PyTorchJob {job.metadata.name} is resizing: impending "
+               f"TPU preemption on {note['source']} ({note['reason']}) "
+               f"dooms {len(doomed)} worker(s); draining them "
+               f"(checkpoint signal sent, deadline {deadline:g}s) and "
+               f"shrinking the gang to {new_target} worker(s) "
+               f"[resize {used + 1}/{budget}]")
+        log.warning(msg)
+        from ..controller import status as status_machine
+
+        status_machine.update_job_conditions(
+            job.status, constants.JOB_RESIZING,
+            constants.RESIZE_SHRINK_REASON, msg)
+        self.recorder.event(
+            job_dict, EVENT_TYPE_WARNING, constants.RESIZE_SHRINK_REASON,
+            msg)
+        self.elastic_resizes_counter.labels(direction="shrink").inc()
+        drain["message"] = msg
+        # wake the sync at the deadline even if no ack ever arrives
+        self.work_queue.add_after(key, deadline)
+        return True
+
+    def _merge_into_drain(self, job: PyTorchJob, job_dict: dict,
+                          pods: List[dict], note: dict) -> bool:
+        """Fold a disruption that landed mid-drain into the in-flight
+        drain: the newly doomed pods join the checkpoint signal and the
+        target drops further — still ONE Resizing transition (the
+        condition dedups on status+reason).  Returns False (and cancels
+        the drain) when the widened loss can't be elastically survived,
+        handing the note to the legacy full restart."""
+        key = job.key
+        with self._disruption_lock:
+            drain = self._pending_drains.get(key)
+            if drain is None:
+                return False  # raced drain completion: retry as fresh
+        log = logger_for_job(self.logger, job)
+        already = set(drain["doomed"])
+        fresh = [p for p in self._doomed_pods(pods, note)
+                 if (p.get("metadata") or {}).get("name") not in already]
+        if not fresh:
+            return True  # nothing new; the in-flight drain covers it
+        worker_rt = constants.REPLICA_TYPE_WORKER.lower()
+        all_workers = all(
+            ((p.get("metadata") or {}).get("labels") or {}).get(
+                constants.LABEL_REPLICA_TYPE) == worker_rt
+            for p in fresh)
+        new_target = drain["target"] - len(fresh)
+        min_replicas = job.spec.elastic_policy.min_replicas or 1
+        if not all_workers or new_target < min_replicas:
+            log.warning(
+                "disruption widened mid-drain beyond the survivable "
+                "floor for %s (target would be %d, min %d); abandoning "
+                "the shrink for a full gang restart", key, new_target,
+                min_replicas)
+            with self._disruption_lock:
+                self._pending_drains.pop(key, None)
+            # the restart recreates the FULL gang; a stale shrunken
+            # target would strand the recreated workers
+            spec = job.spec.pytorch_replica_specs.get(
+                constants.REPLICA_TYPE_WORKER)
+            job.status.desired_replicas = int(spec.replicas or 0) \
+                if spec else 0
+            # the shrink never happened: return its budget slot and
+            # clear the Resizing condition the full restart supersedes
+            # (otherwise N abandoned drains silently exhaust the budget
+            # a later, genuinely survivable preemption needs)
+            job.status.elastic_resizes = max(
+                0, (job.status.elastic_resizes or 0) - 1)
+            from ..controller import status as status_machine
+
+            status_machine.clear_condition(
+                job.status, constants.JOB_RESIZING,
+                constants.RESIZE_ABANDONED_REASON,
+                f"PyTorchJob {job.metadata.name}: shrink abandoned "
+                f"mid-drain (widened below minReplicas "
+                f"{min_replicas}); restarting the full gang")
+            return False
+        self._signal_checkpoint(fresh)
+        with self._disruption_lock:
+            drain["doomed"].extend(
+                (p.get("metadata") or {}).get("name", "") for p in fresh)
+            drain["target"] = new_target
+            # the late-doomed pods get a FULL drain window: their
+            # node's termination grace started now, not when the drain
+            # began — the original deadline could be moments away
+            drain["deadline"] = max(
+                drain["deadline"],
+                self._mono() + self.config.drain_deadline_seconds)
+        job.status.desired_replicas = new_target
+        log.warning(
+            "disruption on %s widened the in-flight drain of %s: %d more "
+            "doomed worker(s), target now %d", note["source"], key,
+            len(fresh), new_target)
+        return True
+
+    def _signal_checkpoint(self, doomed: List[dict]) -> None:
+        """Signal every doomed pod to checkpoint now.  The annotation is
+        the durable signal (the kubelet's SIGTERM rides beside it); a
+        pod deleted out from under us is already as drained as it
+        gets."""
+        from ..controller import status as status_machine
+
+        now_iso = status_machine.now_iso()
+        for pod in doomed:
+            meta = pod.get("metadata") or {}
+            try:
+                self.cluster.pods.patch(
+                    meta.get("namespace", ""), meta.get("name", ""),
+                    {"metadata": {"annotations": {
+                        constants.ANNOTATION_CHECKPOINT_REQUESTED: now_iso,
+                    }}})
+            except NotFoundError:
+                pass
+
+    @staticmethod
+    def _doomed_pods(pods: List[dict], note: dict) -> List[dict]:
+        """Union of the note's node-bound and directly-named pods: a
+        coalesced note can carry both scopes (a taint plus a pod-level
+        DisruptionTarget), and neither set may be dropped."""
+        nodes = set(note.get("nodes") or ())
+        names = set(note.get("pods") or ())
+        if not nodes and not names:
+            return []
+        return [p for p in pods
+                if (p.get("spec") or {}).get("nodeName") in nodes
+                or (p.get("metadata") or {}).get("name") in names]
+
+    def maybe_continue_elastic(self, job: PyTorchJob, job_dict: dict,
+                               pods: List[dict]) -> bool:
+        """Per-sync elastic step, after disruption intake: advances a
+        pending drain (returns True — the sync is consumed waiting for
+        acks or issuing the shrink deletes), applies a pending grow, and
+        completes a finished resize (condition cleared, rendezvous
+        re-rendered).  Grow and completion fall through (return False)
+        so the same sync's normal reconcile acts on the new target."""
+        if job.spec.elastic_policy is None:
+            return False
+        key = job.key
+        uid = job.metadata.uid or ""
+        with self._disruption_lock:
+            drain = self._pending_drains.get(key)
+            if drain is not None and drain.get("uid") and uid and \
+                    drain["uid"] != uid:
+                # stale drain from a previous incarnation of this key
+                self._pending_drains.pop(key, None)
+                drain = None
+        if drain is not None:
+            return self._continue_drain(job, job_dict, pods, drain)
+        with self._disruption_lock:
+            grow = self._pending_grows.get(key)
+        if grow is not None and not self._try_grow(job, job_dict, pods):
+            # The note is the grow's retry memory (symmetric with the
+            # drain note): an APPLIED grow (True) only lives in this
+            # sync's in-memory status until the end-of-sync write lands,
+            # and a failed write rebuilds the next sync's job from the
+            # store at the shrunken size — with the created workers
+            # already live and this job's capacity claim still held.
+            # The surviving note re-runs _try_grow (idempotent against
+            # its own creates) until the store shows the grown target.
+            # A DECLINED grow (capacity short, or already at goal)
+            # drops the note; the next capacity event re-adds it.
+            with self._disruption_lock:
+                self._pending_grows.pop(key, None)
+        self._elastic_bookkeeping(job, job_dict, pods)
+        return False
+
+    def _continue_drain(self, job: PyTorchJob, job_dict: dict,
+                        pods: List[dict], drain: dict) -> bool:
+        """Phase 2 of a shrink: wait (bounded) for checkpoint acks, then
+        delete only the doomed pods.  The drain note stays in the map
+        until the deletes were issued, so a failed delete retries on the
+        requeued sync without re-consuming budget."""
+        from ..controller import status as status_machine
+
+        key = job.key
+        # Re-assert the shrink onto THIS sync's status: the intake
+        # sync's end-of-sync write can fail after the note was armed,
+        # and the requeued sync rebuilds the job from the store at the
+        # pre-shrink size — without this the drain would still delete
+        # the doomed pods while the store never learns the shrunken
+        # target, and the next reconcile recreates the very indices it
+        # just drained.  Idempotent: no counter/event re-fires, and a
+        # job whose write landed sees its own values back.
+        job.status.desired_replicas = drain["target"]
+        if (job.status.elastic_resizes or 0) < drain.get("resizes", 0):
+            job.status.elastic_resizes = drain["resizes"]
+        cond = status_machine.get_condition(job.status,
+                                            constants.JOB_RESIZING)
+        if cond is None or cond.status != status_machine.CONDITION_TRUE:
+            status_machine.update_job_conditions(
+                job.status, constants.JOB_RESIZING,
+                constants.RESIZE_SHRINK_REASON,
+                drain.get("message", ""))
+        doomed_names = set(drain["doomed"])
+        alive = [p for p in pods
+                 if (p.get("metadata") or {}).get("name") in doomed_names]
+
+        def acked(pod: dict) -> bool:
+            meta = pod.get("metadata") or {}
+            if constants.ANNOTATION_CHECKPOINTED in (
+                    meta.get("annotations") or {}):
+                return True
+            # a pod the preemption already killed can't checkpoint any
+            # more; waiting on it would just burn the whole deadline
+            return ((pod.get("status") or {}).get("phase")
+                    in ("Succeeded", "Failed"))
+
+        now = self._mono()
+        pending = [p for p in alive if not acked(p)]
+        if pending and now < drain["deadline"]:
+            # keep the sync warm without busy-looping: re-check soon,
+            # and the ack patches themselves also enqueue the job
+            self.work_queue.add_after(
+                key, max(0.02, min(0.25, drain["deadline"] - now)))
+            return True
+        if pending:
+            self.elastic_drain_timeouts_counter.inc()
+            logger_for_job(self.logger, job).warning(
+                "drain deadline passed with %d unacked doomed pod(s) on "
+                "%s; deleting anyway (their step state is presumed lost)",
+                len(pending), key)
+
+        from ..controller.job import _group_by_replica_type
+
+        for rtype, group in sorted(_group_by_replica_type(alive).items()):
+            if rtype:
+                self.submit_pod_deletes(job, job_dict, rtype, group)
+            else:
+                for pod in group:
+                    self.pod_control.delete_pod(
+                        pod["metadata"].get("namespace", ""),
+                        pod["metadata"].get("name", ""), job_dict)
+
+        with self._disruption_lock:
+            self._pending_drains.pop(key, None)
+            self._shrunken_jobs[key] = job.metadata.uid or ""
+        self.elastic_drain_seconds.observe(now - drain["signaled_at"])
+        # count only REAL acks as checkpointed: a doomed pod the
+        # preemption killed first is treated as acked for pacing (it
+        # can't checkpoint any more) but its step state is lost — the
+        # event must not report the opposite
+        acked_ck = sum(
+            1 for p in alive
+            if constants.ANNOTATION_CHECKPOINTED in (
+                (p.get("metadata") or {}).get("annotations") or {}))
+        died = len(alive) - acked_ck - len(pending)
+        msg = (f"PyTorchJob {job.metadata.name} shrank to "
+               f"{drain['target']} worker(s): {len(alive)} drained pod(s) "
+               f"deleted ({acked_ck} checkpointed, {died} died before "
+               f"checkpointing, {len(pending)} timed out)")
+        logger_for_job(self.logger, job).info(msg)
+        self.recorder.event(job_dict, EVENT_TYPE_NORMAL,
+                            constants.RESIZE_SHRINK_REASON, msg)
+        return True
+
+    def _try_grow(self, job: PyTorchJob, job_dict: dict,
+                  pods: List[dict]) -> bool:
+        """Apply a pending grow: desiredReplicas back to the configured
+        count (bounded by maxReplicas) when enough schedulable TPU
+        capacity is free.  Not enough capacity simply leaves the job
+        shrunken — the next capacity event retries."""
+        policy = job.spec.elastic_policy
+        spec = job.spec.pytorch_replica_specs.get(
+            constants.REPLICA_TYPE_WORKER)
+        configured = int(spec.replicas or 0) if spec else 0
+        goal = min(configured, policy.max_replicas or configured)
+        current = self.elastic_worker_target(job) or 0
+        if current >= goal:
+            return False
+        existing = sum(
+            1 for p in pods
+            if ((p.get("metadata") or {}).get("labels") or {}).get(
+                constants.LABEL_REPLICA_TYPE)
+            == constants.REPLICA_TYPE_WORKER.lower())
+        # only workers this sync still has to CREATE need fresh
+        # capacity: a retried grow whose creates outlived a failed
+        # status write (or an operator restart) finds them in `pods` —
+        # bound ones already read as occupied in the free walk, pending
+        # ones are covered by the prior attempt's claim kept below
+        missing = goal - max(current, existing)
+        # the free-capacity walk is O(nodes) — keep it OUTSIDE the
+        # disruption lock so grow attempts never stall preemption
+        # intake; the lock covers only the claimed-sum check and the
+        # claim insertion, which is what serializes grow admission
+        free_raw = self._free_tpu_capacity() if missing > 0 else 0
+        with self._disruption_lock:
+            claimed = sum(v for k, v in self._growing_claims.items()
+                          if k != job.key)
+            free = free_raw - claimed
+            if missing > 0 and free >= missing:
+                # reserve the capacity until this grow's pods are live:
+                # sibling jobs woken by the same node event must see it
+                # as spoken for, or they all grow onto the same nodes
+                # and sit Pending forever.  A retry keeps a prior
+                # attempt's larger claim — its pods may still be
+                # Pending, so their nodes still LOOK free.
+                self._growing_claims[job.key] = max(
+                    self._growing_claims.get(job.key, 0), missing)
+        if missing > 0 and free < missing:
+            logger_for_job(self.logger, job).info(
+                "capacity event for shrunken %s, but only %d unclaimed "
+                "free schedulable TPU node(s) for %d missing worker(s); "
+                "staying at %d", job.key, free, missing, current)
+            return False
+        job.status.desired_replicas = goal
+        if missing > 0:
+            how = f"schedulable TPU capacity returned ({free} free node(s))"
+        else:
+            how = (f"{existing} worker(s) already live from a prior "
+                   f"grow attempt")
+        msg = (f"PyTorchJob {job.metadata.name} is resizing: {how}; "
+               f"growing the gang from {current} back to {goal} worker(s)")
+        from ..controller import status as status_machine
+
+        # the condition is re-asserted on EVERY apply (a failed write
+        # loses it with the rest of the status), but the event, the log
+        # line and the resize counter fire once per grow — the note
+        # remembers the announcement across write-failure retries, so
+        # one real resize is never counted N times
+        status_machine.update_job_conditions(
+            job.status, constants.JOB_RESIZING,
+            constants.RESIZE_GROW_REASON, msg)
+        with self._disruption_lock:
+            note = self._pending_grows.get(job.key)
+            announced = bool(note and note.get("announced"))
+            if note is not None:
+                note["announced"] = True
+        if not announced:
+            logger_for_job(self.logger, job).info(msg)
+            self.recorder.event(job_dict, EVENT_TYPE_NORMAL,
+                                constants.RESIZE_GROW_REASON, msg)
+            self.elastic_resizes_counter.labels(direction="grow").inc()
+        return True
+
+    def _elastic_bookkeeping(self, job: PyTorchJob, job_dict: dict,
+                             pods: List[dict]) -> None:
+        """Resize completion: once the live worker set matches the
+        target, clear the Resizing condition and re-render the gang's
+        rendezvous annotations (exactly once per resize — the render
+        rides the condition's True->False edge)."""
+        from ..controller import status as status_machine
+
+        key = job.key
+        target = self.elastic_worker_target(job)
+        spec = job.spec.pytorch_replica_specs.get(
+            constants.REPLICA_TYPE_WORKER)
+        configured = int(spec.replicas or 0) if spec else 0
+        with self._disruption_lock:
+            if target is not None and target < configured:
+                self._shrunken_jobs[key] = job.metadata.uid or ""
+            else:
+                self._shrunken_jobs.pop(key, None)
+        cond = status_machine.get_condition(job.status,
+                                            constants.JOB_RESIZING)
+        if cond is None or cond.status != status_machine.CONDITION_TRUE:
+            if target is not None and target < configured:
+                # steady shrunken state (no resize in flight): a
+                # survivor's replacement pod boots with the
+                # CONFIGURED-size env (build_cluster_env can't know the
+                # elastic target) and missed the completion-edge render
+                # — keep the gang's annotations fresh.  The render
+                # diffs in memory and patches only stale pods, so this
+                # is free once the annotations settle.
+                self._render_elastic_env(job, pods)
+            return
+        workers = [
+            p for p in pods
+            if ((p.get("metadata") or {}).get("labels") or {}).get(
+                constants.LABEL_REPLICA_TYPE)
+            == constants.REPLICA_TYPE_WORKER.lower()]
+        if len(workers) != target:
+            return
+        if any(not (p.get("spec") or {}).get("nodeName")
+               for p in workers):
+            # created but unplaced: a Pending pod occupies no node, so
+            # completing now would release the capacity claim while the
+            # nodes it reserved still LOOK free — the exact pile-up the
+            # claim exists to prevent
+            return
+        msg = (f"PyTorchJob {job.metadata.name} finished resizing: "
+               f"{target} worker(s) live")
+        status_machine.clear_condition(
+            job.status, constants.JOB_RESIZING,
+            constants.RESIZE_COMPLETED_REASON, msg)
+        # grown pods are live and bound: their nodes now show as
+        # occupied, so the reservation has served its purpose
+        self._release_grow_claim(key)
+        logger_for_job(self.logger, job).info(msg)
+        self._render_elastic_env(job, pods)
+
+    def _render_elastic_env(self, job: PyTorchJob,
+                            pods: List[dict]) -> None:
+        """Re-publish WORLD_SIZE/RANK/hostnames for the current gang as
+        pod annotations (tpu_env.elastic_rendezvous_annotations).
+        Idempotent: pods whose annotations already carry the computed
+        values are skipped, so steady-state re-renders patch nothing."""
+        from ..controller.tpu_env import elastic_rendezvous_annotations
+
+        namespace = job.metadata.namespace
+        current = {
+            (p.get("metadata") or {}).get("name", ""):
+                (p.get("metadata") or {}).get("annotations") or {}
+            for p in pods}
+        for pod_name, annotations in elastic_rendezvous_annotations(
+                job, pods).items():
+            have = current.get(pod_name, {})
+            if all(have.get(k) == v for k, v in annotations.items()):
+                continue
+            try:
+                self.cluster.pods.patch(
+                    namespace, pod_name,
+                    {"metadata": {"annotations": annotations}})
+            except NotFoundError:
+                pass
+
+    def _on_capacity_returned(self, node_name: str) -> None:
+        """CapacityWatcher callback: wake every shrunken elastic job so
+        its next sync can attempt the grow."""
+        with self._disruption_lock:
+            shrunken = dict(self._shrunken_jobs)
+            for key, uid in shrunken.items():
+                self._pending_grows.setdefault(
+                    key, {"node": node_name, "uid": uid})
+        for key in shrunken:
+            self.work_queue.add(key)
+
+    def _release_grow_claim(self, key: str) -> None:
+        """Release a grow's capacity reservation and — if one was
+        actually held — re-wake the still-shrunken jobs it was
+        starving: the capacity became claimable WITHOUT a node
+        transition (grow completed / job ended / job re-shrank), so
+        the CapacityWatcher, which only fires on node edges, would
+        never tell them."""
+        with self._disruption_lock:
+            released = self._growing_claims.pop(key, None)
+        if released:
+            self._on_capacity_returned(f"claim-released:{key}")
+
+    def _free_tpu_capacity(self) -> int:
+        if self.capacity_watcher is not None:
+            return self.capacity_watcher.free_capacity()
+        # no node informer (unit-test wiring): resolve straight from the
+        # cluster stores
+        occupied = {(p.get("spec") or {}).get("nodeName")
+                    for p in self.cluster.pods.list()}
+        return sum(
+            1 for n in self.cluster.nodes.list()
+            if node_schedulable_tpu(n)
+            and (n.get("metadata") or {}).get("name") not in occupied)
+
+    def clear_elastic_state(self, key: str) -> None:
+        """Drop every elastic note for a deleted job key (called from
+        sync_job's deleted branch beside the disruption-note cleanup)."""
+        with self._disruption_lock:
+            self._pending_drains.pop(key, None)
+            self._pending_grows.pop(key, None)
+            self._shrunken_jobs.pop(key, None)
+        self._release_grow_claim(key)

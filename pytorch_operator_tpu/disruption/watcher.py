@@ -22,7 +22,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Set
 
 from ..runtime.informer import meta_namespace_key
-from .detector import node_disruption_reason
+from .detector import node_disruption_reason, node_schedulable_tpu
 
 _log = logging.getLogger(__name__)
 
@@ -99,6 +99,90 @@ class PodNodeIndex:
     def node_count(self) -> int:
         with self._lock:
             return len(self._keys_by_node)
+
+
+class CapacityWatcher:
+    """Node informer -> "schedulable TPU capacity returned" events.
+
+    The inverse of :class:`DisruptionWatcher`: it tracks each node's
+    schedulable-TPU state (:func:`detector.node_schedulable_tpu`) and
+    fires ``on_capacity(node_name)`` once per transition INTO that state
+    — a tainted node restored, a NotReady node recovering, or a fresh
+    node joining after the initial sync.  The elastic-gang handler uses
+    the signal to wake shrunken jobs so they can grow back toward their
+    configured replica count.
+
+    ``free_capacity()`` answers the grow precondition: how many
+    schedulable TPU nodes currently host no pods (resolved through the
+    shared :class:`PodNodeIndex` when available, a cluster-wide LIST
+    otherwise).
+    """
+
+    def __init__(
+        self,
+        informer,
+        on_capacity: Callable[[str], None],
+        pod_index: Optional[PodNodeIndex] = None,
+        cluster=None,
+    ):
+        self.informer = informer
+        self.on_capacity = on_capacity
+        self.pod_index = pod_index
+        self.cluster = cluster
+        self._lock = threading.Lock()
+        self._schedulable: Dict[str, bool] = {}
+        informer.add_event_handler(
+            on_add=self._evaluate,
+            on_update=lambda _old, new: self._evaluate(new),
+            on_delete=self._node_deleted,
+        )
+
+    def _evaluate(self, node: dict) -> None:
+        name = (node.get("metadata") or {}).get("name", "")
+        if not name:
+            return
+        ok = node_schedulable_tpu(node)
+        with self._lock:
+            prev = self._schedulable.get(name)
+            self._schedulable[name] = ok
+        if not ok or prev is True:
+            return
+        # First sight during the initial LIST is existing capacity, not
+        # returning capacity; a node first seen after sync is a genuine
+        # join (scale-up) and does fire.
+        if prev is None and not self.informer.has_synced():
+            return
+        _log.info("schedulable TPU capacity returned on node %s", name)
+        self.on_capacity(name)
+
+    def _node_deleted(self, node: dict) -> None:
+        name = (node.get("metadata") or {}).get("name", "")
+        with self._lock:
+            self._schedulable.pop(name, None)
+
+    def free_capacity(self) -> int:
+        """Schedulable TPU nodes with no pods bound — the slots a
+        growing gang can actually land on."""
+        occupied_nodes = None
+        if self.pod_index is None and self.cluster is not None:
+            # no index: build the occupied set ONCE (O(pods)) instead
+            # of re-listing every pod per node (O(nodes x pods))
+            occupied_nodes = {(p.get("spec") or {}).get("nodeName")
+                              for p in self.cluster.pods.list()}
+        free = 0
+        for node in self.informer.store.list():
+            if not node_schedulable_tpu(node):
+                continue
+            name = (node.get("metadata") or {}).get("name", "")
+            if self.pod_index is not None:
+                occupied = bool(self.pod_index.pods_on(name))
+            elif occupied_nodes is not None:
+                occupied = name in occupied_nodes
+            else:
+                occupied = False
+            if not occupied:
+                free += 1
+        return free
 
 
 class DisruptionWatcher:

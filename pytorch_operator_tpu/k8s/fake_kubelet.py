@@ -89,12 +89,18 @@ class FakeKubelet:
         # server has a port).
         telemetry_url: Optional[str] = None,
         push_steps: int = 3,
+        # Elastic drain protocol: a pod annotated checkpoint-requested
+        # answers with the checkpointed ack after this delay (the sim's
+        # stand-in for SIGTERM -> orbax save -> exit readiness).  None
+        # never acks, so drains run to their deadline.
+        checkpoint_delay: Optional[float] = 0.02,
     ):
         self.cluster = cluster
         self.run_delay = run_delay
         self.complete_delay = complete_delay
         self.telemetry_url = telemetry_url
         self.push_steps = push_steps
+        self.checkpoint_delay = checkpoint_delay
         self.decide = decide or (lambda pod: ("Succeeded", 0))
         self.logs = logs or (
             lambda pod, phase, code:
@@ -110,6 +116,12 @@ class FakeKubelet:
         # reused (a preempted VM is replaced, not recycled).
         self._node_of_pod: Dict[str, str] = {}
         self._free_nodes: List[str] = []
+        # capacity freeze (a REAL dip, not just taints): while frozen,
+        # no fresh nodes are provisioned — pods beyond the freed-node
+        # pool wait here unbound/Pending until a node frees or the
+        # freeze lifts.  CapacityFlap(freeze_capacity=True) drives it.
+        self._capacity_frozen = False
+        self._bind_queue: List[tuple] = []
         self._timers: Dict[str, threading.Timer] = {}
         self._lock = threading.Lock()
         self._stopped = False
@@ -145,25 +157,36 @@ class FakeKubelet:
                 return cond.get("status") == "True"
         return False
 
-    def _pick_node(self) -> str:
+    def _pop_free_node(self) -> Optional[str]:
+        """The next still-schedulable freed node, or None when the pool
+        is dry."""
+        # never hold self._lock across a cluster-store call: store
+        # listeners run under the cluster lock and re-enter here
+        while True:
+            with self._lock:
+                candidate = (self._free_nodes.pop()
+                             if self._free_nodes else None)
+            if candidate is None:
+                return None
+            try:
+                node = self.cluster.nodes.get("default", candidate)
+            except NotFoundError:
+                continue
+            if self._schedulable(node):
+                return candidate
+
+    def _pick_node(self) -> Optional[str]:
         """A freed healthy node when one exists, else a fresh node
         (one per live pod — one worker per TPU VM); bounded round-robin
-        over healthy nodes when ``max_nodes`` caps the pool."""
+        over healthy nodes when ``max_nodes`` caps the pool; None while
+        the capacity freeze is on and no freed node is available."""
+        with self._lock:
+            frozen = self._capacity_frozen
+        if frozen:
+            return self._pop_free_node()
         if self.max_nodes is None:
-            # never hold self._lock across a cluster-store call: store
-            # listeners run under the cluster lock and re-enter here
-            while True:
-                with self._lock:
-                    candidate = (self._free_nodes.pop()
-                                 if self._free_nodes else None)
-                if candidate is None:
-                    return self._provision_node()
-                try:
-                    node = self.cluster.nodes.get("default", candidate)
-                except NotFoundError:
-                    continue
-                if self._schedulable(node):
-                    return candidate
+            reused = self._pop_free_node()
+            return reused if reused is not None else self._provision_node()
         healthy = sorted(
             n["metadata"]["name"]
             for n in self.cluster.nodes.list()
@@ -175,16 +198,25 @@ class FakeKubelet:
             self._bind_rr = (self._bind_rr + 1) % len(healthy)
             return healthy[self._bind_rr]
 
-    def _bind_pod(self, ns: str, name: str, pod: dict) -> None:
+    def _bind_pod(self, ns: str, name: str, pod: dict) -> bool:
+        """Bind the pod to a node.  Returns False only when the
+        capacity freeze left no node to bind to — the pod is queued and
+        stays Pending until a node frees or the freeze lifts."""
         if (pod.get("spec") or {}).get("nodeName"):
-            return
+            return True
         node = self._pick_node()
+        if node is None:
+            with self._lock:
+                if (ns, name) not in self._bind_queue:
+                    self._bind_queue.append((ns, name))
+            return False
         try:
             self.cluster.pods.patch(ns, name, {"spec": {"nodeName": node}})
         except NotFoundError:
-            return
+            return True  # pod raced deletion: downstream phase timers no-op
         with self._lock:
             self._node_of_pod[f"{ns}/{name}"] = node
+        return True
 
     def _release_node(self, ns: str, name: str) -> None:
         with self._lock:
@@ -199,6 +231,37 @@ class FakeKubelet:
         if healthy:
             with self._lock:
                 self._free_nodes.append(node)
+            # a node freed mid-freeze goes straight to a waiting pod —
+            # within a dip the surviving capacity keeps circulating
+            self._drain_bind_queue()
+
+    # -- capacity freeze ---------------------------------------------------
+    def freeze_capacity(self) -> None:
+        """Stop provisioning fresh nodes: the fleet's current healthy
+        nodes are ALL the capacity there is (a genuine dip).  Unbindable
+        pods stay Pending until a node frees or ``unfreeze_capacity``."""
+        with self._lock:
+            self._capacity_frozen = True
+
+    def unfreeze_capacity(self) -> None:
+        with self._lock:
+            self._capacity_frozen = False
+        self._drain_bind_queue()
+
+    def _drain_bind_queue(self) -> None:
+        while True:
+            with self._lock:
+                if not self._bind_queue:
+                    return
+                ns, name = self._bind_queue.pop(0)
+            try:
+                pod = self.cluster.pods.get(ns, name)
+            except NotFoundError:
+                continue  # deleted while waiting: just drop it
+            if not self._bind_pod(ns, name, pod):
+                return  # still no capacity: _bind_pod re-queued it
+            self._schedule(f"{ns}/{name}/run", self.run_delay,
+                           self._run_pod, ns, name)
 
     # -- chaos injection ---------------------------------------------------
     def taint_node(self, name: str, key: str = IMPENDING_PREEMPTION_TAINT,
@@ -281,6 +344,53 @@ class FakeKubelet:
         else:
             _taint()
 
+    def untaint_node(self, name: str, key: Optional[str] = None) -> None:
+        """Remove the node's taints (all of them, or just ``key``) — the
+        capacity-returns half of a CapacityFlap: a reclaimed spot VM
+        handed back to the pool."""
+        node = self.cluster.nodes.get("default", name)
+        taints = (node.get("spec") or {}).get("taints") or []
+        if key is not None:
+            taints = [t for t in taints if t.get("key") != key]
+        else:
+            taints = []
+        self.cluster.nodes.patch(
+            "default", name, {"spec": {"taints": taints or None}})
+
+    # -- elastic drain protocol --------------------------------------------
+    def _maybe_ack_checkpoint(self, ns: str, name: str, pod: dict) -> None:
+        """A pod the controller signalled to checkpoint answers with the
+        checkpointed ack after ``checkpoint_delay`` — the sim's stand-in
+        for the SIGTERM-driven orbax save a real trainer performs."""
+        if self.checkpoint_delay is None:
+            return
+        meta = pod.get("metadata") or {}
+        annotations = meta.get("annotations") or {}
+        if _api_constants.ANNOTATION_CHECKPOINT_REQUESTED not in annotations:
+            return
+        if _api_constants.ANNOTATION_CHECKPOINTED in annotations:
+            return
+        if ((pod.get("status") or {}).get("phase")) in ("Succeeded",
+                                                        "Failed"):
+            return  # already dead: nothing left to checkpoint
+        self._schedule(f"{ns}/{name}/checkpoint", self.checkpoint_delay,
+                       self._ack_checkpoint, ns, name)
+
+    def _ack_checkpoint(self, ns: str, name: str) -> None:
+        try:
+            pod = self.cluster.pods.get(ns, name)
+        except NotFoundError:
+            return
+        annotations = (pod.get("metadata") or {}).get("annotations") or {}
+        if _api_constants.ANNOTATION_CHECKPOINTED in annotations:
+            return
+        try:
+            self.cluster.pods.patch(ns, name, {"metadata": {"annotations": {
+                _api_constants.ANNOTATION_CHECKPOINTED: _now_iso(),
+            }}})
+        except NotFoundError:
+            pass
+
     def complete_pod_now(self, ns: str, name: str) -> None:
         """Test hook: run the completion decision for one pod
         immediately — pods parked Running by a ``decide`` that returned
@@ -294,11 +404,16 @@ class FakeKubelet:
         if event_type == "DELETED":
             self._release_node(ns, name)
             return
+        if event_type == "MODIFIED":
+            self._maybe_ack_checkpoint(ns, name, pod)
+            return
         if event_type != ADDED:
             return
-        self._bind_pod(ns, name, pod)
+        bound = self._bind_pod(ns, name, pod)
         self._set_phase(ns, name, "Pending")
-        self._schedule(f"{ns}/{name}/run", self.run_delay, self._run_pod, ns, name)
+        if bound:
+            self._schedule(f"{ns}/{name}/run", self.run_delay,
+                           self._run_pod, ns, name)
 
     def _run_pod(self, ns: str, name: str) -> None:
         self._set_phase(ns, name, "Running")

@@ -34,7 +34,10 @@ from pytorch_operator_tpu.parallel.train import (
     make_pp_train_step,
     make_sp_train_step,
     make_train_step,
+    reshard_state,
+    restore_on_mesh,
     sharded_init,
+    state_shardings,
 )
 
 __all__ = [
@@ -58,5 +61,8 @@ __all__ = [
     "make_pp_train_step",
     "make_sp_train_step",
     "make_train_step",
+    "reshard_state",
+    "restore_on_mesh",
     "sharded_init",
+    "state_shardings",
 ]

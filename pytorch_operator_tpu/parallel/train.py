@@ -70,35 +70,30 @@ def chunked_tied_ce(h: jax.Array, embed: jax.Array, targets: jax.Array,
     return -total / (B * T)
 
 
-def sharded_init(
+def state_shardings(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
-    seed: int = 0,
     specs: Any = None,
 ) -> TrainState:
-    """Initialise params + opt state directly into their shardings.
+    """The NamedSharding tree of a TrainState laid out on ``mesh`` —
+    ``get_sharding_tree`` (SNIPPETS.md [2]) generalised to the llama
+    layouts, and the single source the init, the cross-mesh reshard and
+    the checkpoint restore all draw from.
 
-    jit with out_shardings means each device materialises only its own
-    parameter shard — no host-side full copy, which is what lets 7B+
-    configs initialise on a v5p slice.  ``specs`` defaults to the
-    (dp, fsdp, tp) layout; pass llama.pp_param_specs(cfg) for the
-    pipeline layout.
+    Optimizer-state leaves that mirror a parameter (adam mu/nu subtrees
+    repeat the param pytree, so their key paths end with the param's key
+    path) inherit that parameter's sharding; scalars (counts) replicate.
+    Matching must be by path, not shape: wq (L,D,nh*hd) and wo
+    (L,nh*hd,D) have identical shapes for nh*hd == D but transposed
+    specs.  ``specs`` defaults to the (dp, fsdp, tp) layout; pass
+    llama.pp_param_specs(cfg) for the pipeline layout.
     """
     if specs is None:
         specs = llama.param_specs(cfg)
     p_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
     replicated = NamedSharding(mesh, P())
 
-    def init(key):
-        params = llama.init_params(key, cfg)
-        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
-
-    # Optimizer-state leaves that mirror a parameter (adam mu/nu subtrees
-    # repeat the param pytree, so their key paths end with the param's key
-    # path) inherit that parameter's sharding; scalars (counts) replicate.
-    # Matching must be by path, not shape: wq (L,D,nh*hd) and wo
-    # (L,nh*hd,D) have identical shapes for nh*hd == D but transposed specs.
     param_shapes = jax.eval_shape(
         partial(llama.init_params, cfg=cfg), jax.random.key(0)
     )
@@ -120,9 +115,73 @@ def sharded_init(
 
     opt_shape = jax.eval_shape(optimizer.init, param_shapes)
     opt_shardings = jax.tree_util.tree_map_with_path(leaf_sharding, opt_shape)
-    out_shardings = TrainState(p_shardings, opt_shardings, replicated)
+    return TrainState(p_shardings, opt_shardings, replicated)
+
+
+def sharded_init(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    seed: int = 0,
+    specs: Any = None,
+) -> TrainState:
+    """Initialise params + opt state directly into their shardings.
+
+    jit with out_shardings means each device materialises only its own
+    parameter shard — no host-side full copy, which is what lets 7B+
+    configs initialise on a v5p slice.  ``specs`` defaults to the
+    (dp, fsdp, tp) layout; pass llama.pp_param_specs(cfg) for the
+    pipeline layout.
+    """
+    out_shardings = state_shardings(cfg, mesh, optimizer, specs=specs)
+
+    def init(key):
+        params = llama.init_params(key, cfg)
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
     return jax.jit(init, out_shardings=out_shardings)(jax.random.key(seed))
+
+
+def reshard_state(
+    state: TrainState,
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    specs: Any = None,
+) -> TrainState:
+    """Move a live TrainState onto a different mesh shape.
+
+    The elastic-resize data-plane primitive: a state built on an
+    N-device mesh re-lays itself out for an M-device mesh by
+    device_put-ing every leaf through the new mesh's sharding tree —
+    values are bit-identical, only the device layout changes, so a gang
+    that shrank from 8 to 6 workers (or a checkpoint-resume at a new
+    world size) keeps training without a numeric discontinuity.
+    """
+    shardings = state_shardings(cfg, mesh, optimizer, specs=specs)
+    # one batched device_put over the whole pytree (not a per-leaf
+    # tree.map): the runtime can overlap the cross-mesh transfers,
+    # which is the elastic-shrink critical path on a real fleet
+    return jax.device_put(state, shardings)
+
+
+def restore_on_mesh(mngr, step: int, target_state: TrainState) -> TrainState:
+    """Orbax restore onto ``target_state``'s own shardings.
+
+    ``target_state`` is a freshly initialised state on the CURRENT mesh
+    (any world size); the checkpoint may have been written from a
+    different mesh shape — orbax reshards each array onto the abstract
+    tree's shardings during restore, which is what lets run 2 of a
+    checkpoint-resume legally run at a different world size than the
+    run that saved.
+    """
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        target_state,
+    )
+    return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
 
 
 def _make_step(

@@ -58,6 +58,8 @@ class JobControllerConfig:
         resync_period_seconds: float = 0.0,
         enable_disruption_handling: bool = False,
         max_preemption_restarts: int = 3,
+        drain_deadline_seconds: float = 30.0,
+        max_elastic_resizes: int = 3,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -69,6 +71,13 @@ class JobControllerConfig:
         # proactive restarts per job (annotation-overridable per job).
         self.enable_disruption_handling = enable_disruption_handling
         self.max_preemption_restarts = max_preemption_restarts
+        # Elastic gangs (--drain-deadline / --max-elastic-resizes): how
+        # long a doomed pod gets to checkpoint before the shrink deletes
+        # it anyway, and how many shrinks a job may consume before
+        # falling back to the legacy full-gang restart
+        # (annotation-overridable per job).
+        self.drain_deadline_seconds = drain_deadline_seconds
+        self.max_elastic_resizes = max_elastic_resizes
         # Periodic informer relist-and-diff (reference --resyc-period,
         # options.go:24, default 12h; the job informer additionally resyncs
         # every 30s, informer.go:24).  0 disables (unit-test default);

@@ -179,7 +179,13 @@ class PushGateway:
         # must not mint a series (or burn a budget slot) for its job
         child = self._vecs[name].labels(job=job)
         if kind == "histogram":
-            child.observe(float(value))
+            # exemplar: the bucket remembers the pushing job, so a slow
+            # step bucket on an OpenMetrics scrape resolves straight to
+            # the job the way reconcile exemplars resolve to traces —
+            # and, budget-capped, the shared over-budget child's buckets
+            # still name WHICH job filled them.  Plain text-0.0.4
+            # scrapes stay byte-identical (exemplars are OM-only).
+            child.observe(float(value), exemplar={"job": job})
         elif kind == "gauge":
             child.set(float(value))
         else:

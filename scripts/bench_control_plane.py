@@ -65,10 +65,28 @@ count, so the expectations ledger is proven intact under fault
 injection, not assumed.  ``--out`` rewrites only the delimited
 chaos-apiserver section of BENCH_CONTROL_PLANE.md.
 
+``--elastic`` runs the elastic-gang tier STANDALONE (ISSUE 6): J
+elastic jobs (1 Master + W workers, ``elasticPolicy``) brought to
+Running, then a ``disruption.CapacityFlap(freeze_capacity=True)``
+taints K worker nodes per job with fresh-node provisioning frozen for
+a fixed ``dip_s`` — a genuine capacity hole both variants ride — then
+restores.  The ``elastic`` variant checkpoint-drains the doomed
+workers, shrinks to the survivors, keeps training THROUGH the dip and
+grows back when the nodes return; the ``legacy`` variant (no
+elasticPolicy) pays the PR 2 full gang restart and cannot field a
+whole gang until the dip ends.  Reported per
+variant: recovery wall (back to a steady training size), full
+convergence wall, pods whose state was LOST (replaced without a
+checkpoint ack) vs checkpointed vs kept-running-untouched, and the
+running-pod-seconds deficit over the scenario window (the lost-step
+accounting).  ``--out`` rewrites only the delimited elastic section of
+BENCH_CONTROL_PLANE.md.
+
 Run:  python scripts/bench_control_plane.py --out BENCH_CONTROL_PLANE.md
       python scripts/bench_control_plane.py --chaos
       python scripts/bench_control_plane.py --churn-pods
       python scripts/bench_control_plane.py --chaos-apiserver --out BENCH_CONTROL_PLANE.md
+      python scripts/bench_control_plane.py --elastic --out BENCH_CONTROL_PLANE.md
 """
 
 from __future__ import annotations
@@ -499,6 +517,319 @@ def run_chaos_ab(jobs: int, workers: int) -> dict:
     retries) under the identical storm shape."""
     return {"chaos_proactive": run_chaos(jobs, workers, proactive=True),
             "chaos_legacy": run_chaos(jobs, workers, proactive=False)}
+
+
+def new_elastic_job(name: str, workers: int, min_replicas: int = 1) -> dict:
+    """new_chaos_job + an elasticPolicy opting into
+    checkpoint-drain-resize."""
+    job = new_chaos_job(name, workers)
+    job["spec"]["elasticPolicy"] = {"minReplicas": min_replicas,
+                                    "maxReplicas": workers}
+    return job
+
+
+def run_elastic(jobs: int, workers: int, kill: int = 2,
+                elastic: bool = True, timeout: float = 120.0,
+                drain_deadline: float = 2.0,
+                dip_s: float = 1.2) -> dict:
+    """One CapacityFlap round: all jobs Running, then ``kill`` worker
+    nodes per job tainted (pods killed after grace) with fresh-node
+    provisioning FROZEN for ``dip_s`` seconds — a genuine capacity dip,
+    the same for both variants — then capacity restored.  The elastic
+    variant shrinks to the survivors and grows back; the legacy variant
+    pays the full gang restart and cannot reach a trainable fleet until
+    the dip ends (a rigid gang trains at full size or not at all), so
+    its recovery wall is floored by ``dip_s``.
+
+    Lost-step accounting: every pod that died or was deleted WITHOUT a
+    checkpoint ack lost its step state; pods surviving the whole
+    scenario untouched never stopped training.  The running-pod-seconds
+    deficit integrates how much training capacity the scenario burned
+    versus an undisrupted fleet.
+    """
+    from pytorch_operator_tpu.api.v1 import constants as api_constants
+    from pytorch_operator_tpu.disruption.chaos import CapacityFlap
+
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(
+            enable_disruption_handling=True,
+            drain_deadline_seconds=drain_deadline),
+        registry=registry)
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None,
+                          checkpoint_delay=0.01)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=4, stop_event=stop)
+    expected = jobs * (workers + 1)
+    out: dict = {"variant": "elastic" if elastic else "legacy",
+                 "jobs": jobs, "workers": workers, "killed_per_job": kill,
+                 "pods": expected, "dip_s": dip_s}
+    ack_ann = api_constants.ANNOTATION_CHECKPOINTED
+
+    # flight recorder: every pod that left the Running state, with or
+    # without a checkpoint ack
+    lost_state = [0]
+    checkpointed = [0]
+    seen_gone = set()
+
+    def _pod_gone(et, obj):
+        meta = obj.get("metadata") or {}
+        uid = meta.get("uid", "")
+        phase = (obj.get("status") or {}).get("phase")
+        if et == "DELETED" or phase == "Failed":
+            if uid in seen_gone:
+                return
+            seen_gone.add(uid)
+            if ack_ann in (meta.get("annotations") or {}):
+                checkpointed[0] += 1
+            else:
+                lost_state[0] += 1
+
+    cluster.pods.add_listener(_pod_gone)
+
+    def running_pods():
+        return [p for p in cluster.pods.list("default")
+                if (p.get("status") or {}).get("phase") == "Running"]
+
+    try:
+        for j in range(jobs):
+            body = (new_elastic_job(f"el-{j}", workers) if elastic
+                    else new_chaos_job(f"el-{j}", workers))
+            cluster.jobs.create("default", body)
+        deadline = time.perf_counter() + timeout
+        while len(running_pods()) < expected:
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = (f"only {len(running_pods())}/{expected} "
+                                f"Running before the flap")
+                return out
+            time.sleep(0.01)
+        gen1_uids = {p["metadata"]["uid"] for p in running_pods()}
+
+        victims, victim_uids = [], set()
+        for j in range(jobs):
+            for w in range(kill):
+                pod = cluster.pods.get("default", f"el-{j}-worker-{w}")
+                victims.append(pod["spec"]["nodeName"])
+                victim_uids.add(pod["metadata"]["uid"])
+
+        shrunk_size = expected - kill * jobs
+        t0 = time.perf_counter()
+        flap = CapacityFlap(kubelet, victims, grace=0.6,
+                            freeze_capacity=True)
+        flap.down()
+
+        # recovery = back to a steady TRAINING size: the shrunken fleet
+        # for elastic, the fully restarted fleet for legacy (which can
+        # only exist once the dip ends — restore fires at t0 + dip_s
+        # for BOTH variants, scenario-controlled).  The running-pod
+        # integral samples throughout for the lost-step accounting.
+        integral = 0.0
+        last = t0
+        recovery_wall = None
+        restored = False
+        deadline = t0 + timeout
+
+        def sample():
+            nonlocal integral, last
+            now = time.perf_counter()
+            integral += len(running_pods()) * (now - last)
+            last = now
+            return now
+
+        while True:
+            now = sample()
+            if not restored and now - t0 >= dip_s:
+                flap.restore()
+                restored = True
+            pods = running_pods()
+            uids = {p["metadata"]["uid"] for p in pods}
+            if recovery_wall is None:
+                if elastic:
+                    done = (len(pods) >= shrunk_size
+                            and not (victim_uids & uids)
+                            and all(not _pod_alive(cluster,
+                                                   f"el-{j}-worker-{w}")
+                                    for j in range(jobs)
+                                    for w in range(kill)))
+                else:
+                    done = (len(pods) >= expected
+                            and not (victim_uids & uids))
+                if done:
+                    recovery_wall = now - t0
+            if recovery_wall is not None and restored:
+                # full fleet back (for legacy, the same instant as
+                # recovery; for elastic, after the post-restore grow)
+                if len(pods) >= expected and not (victim_uids & uids):
+                    break
+            if now > deadline:
+                out["converged"] = False
+                phase = ("recovery" if recovery_wall is None else "grow")
+                out["error"] = (
+                    f"{len(pods)}/{expected} Running at {phase} timeout "
+                    f"({'elastic' if elastic else 'legacy'})")
+                flap.cancel()
+                if not restored:
+                    flap.restore()
+                return out
+            time.sleep(0.01)
+        wall = time.perf_counter() - t0
+
+        kept = len(gen1_uids
+                   & {p["metadata"]["uid"] for p in running_pods()})
+        creates = len([e for e in cluster.events.list()
+                       if e["reason"] == "SuccessfulCreatePod"])
+        out.update({
+            "converged": True,
+            "recovery_wall_s": round(recovery_wall, 3),
+            "convergence_wall_s": round(wall, 3),
+            "pods_state_lost": lost_state[0],
+            "pods_checkpointed": checkpointed[0],
+            "pods_kept_running": kept,
+            "pod_seconds_deficit": round(expected * wall - integral, 2),
+            "creates_total": creates,
+            "duplicate_creates": creates - expected - len(seen_gone),
+        })
+        if elastic:
+            out["resizes"] = {
+                "shrink": ctl.elastic_resizes_counter.labels(
+                    direction="shrink").value,
+                "grow": ctl.elastic_resizes_counter.labels(
+                    direction="grow").value,
+                "drain_timeouts":
+                    ctl.elastic_drain_timeouts_counter.value,
+            }
+        else:
+            out["gang_restarts"] = \
+                ctl.preemption_gang_restarts_counter.value
+        return out
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+
+
+def _pod_alive(cluster, name: str) -> bool:
+    try:
+        cluster.pods.get("default", name)
+        return True
+    except NotFoundError:
+        return False
+
+
+def run_elastic_ab(jobs: int, workers: int, kill: int = 2,
+                   timeout: float = 120.0) -> dict:
+    """Elastic shrink-resume vs legacy full-gang restart under the same
+    CapacityFlap plan."""
+    return {
+        "elastic": run_elastic(jobs, workers, kill=kill, elastic=True,
+                               timeout=timeout),
+        "elastic_legacy": run_elastic(jobs, workers, kill=kill,
+                                      elastic=False, timeout=timeout),
+    }
+
+
+ELASTIC_BEGIN = "<!-- elastic:begin -->"
+ELASTIC_END = "<!-- elastic:end -->"
+
+
+def _elastic_reading(res: dict) -> str:
+    e = res["elastic"]
+    lg = res["elastic_legacy"]
+    if not (e.get("converged") and lg.get("converged")):
+        return ("  **Elastic verdict: a variant did not converge on this "
+                f"run** — elastic: {e.get('error', 'ok')}; legacy: "
+                f"{lg.get('error', 'ok')} — re-run before citing either "
+                "direction.")
+    lines = [
+        f"elastic: recovery {e['recovery_wall_s']}s (shrunken fleet "
+        f"training again), full re-grow {e['convergence_wall_s']}s, "
+        f"{e['pods_state_lost']} pods lost state, "
+        f"{e['pods_checkpointed']} checkpointed, "
+        f"{e['pods_kept_running']} never stopped, "
+        f"{e['pod_seconds_deficit']} running-pod-seconds lost, "
+        f"{e['duplicate_creates']} duplicate creates",
+        f"legacy: recovery {lg['recovery_wall_s']}s (full gang restart, "
+        f"floored by the {lg['dip_s']}s dip — a rigid gang cannot train "
+        f"at reduced size, so it waits out the capacity hole), "
+        f"{lg['pods_state_lost']} pods lost state, "
+        f"{lg['pods_kept_running']} never stopped, "
+        f"{lg['pod_seconds_deficit']} running-pod-seconds lost, "
+        f"{lg['duplicate_creates']} duplicate creates",
+    ]
+    detail = "; ".join(lines)
+    clean = (e["duplicate_creates"] == 0 and lg["duplicate_creates"] == 0)
+    kept_win = e["pods_kept_running"] > lg["pods_kept_running"]
+    state_win = e["pods_state_lost"] < lg["pods_state_lost"]
+    if clean and kept_win and state_win:
+        # phrase the checkpoint claim from the counts: a winning run
+        # can still have lost unacked pods to the drain deadline
+        ck = ("every doomed pod checkpointed"
+              if e["pods_state_lost"] == 0 else
+              f"{e['pods_checkpointed']} doomed pod(s) checkpointed and "
+              f"{e['pods_state_lost']} lost to the drain deadline")
+        return (f"  **Elastic verdict: checkpoint-drain-resize preserves "
+                f"the surviving slice** — {detail}.  The elastic gang "
+                f"keeps {e['pods_kept_running']} pods training through "
+                f"the dip with {ck}; the legacy "
+                f"restart replaces the whole fleet and loses every pod's "
+                f"step state.  Recovery-wall comparison on this box: "
+                f"{e['recovery_wall_s']}s to resume at reduced size "
+                f"DURING the dip vs {lg['recovery_wall_s']}s for the "
+                f"restarted gang, which cannot be whole until capacity "
+                f"returns at {lg['dip_s']}s — the elastic side's win "
+                f"scales with dip length, and on a real TPU fleet the "
+                f"restart side additionally pays scheduling + image pull "
+                f"+ re-init per pod, with the lost-step column as the "
+                f"re-trained work.")
+    return (f"  **Elastic verdict: inconclusive on this run** — {detail}.")
+
+
+def render_elastic_md(res: dict, jobs: int, workers: int,
+                      kill: int) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+
+    def row(label, d):
+        if not d.get("converged"):
+            return f"| {label} | **NO** | — | — | — | — | — | — |"
+        return (f"| {label} | yes | {d['recovery_wall_s']} | "
+                f"{d['convergence_wall_s']} | {d['pods_state_lost']} | "
+                f"{d['pods_checkpointed']} | {d['pods_kept_running']} | "
+                f"{d['pod_seconds_deficit']} |")
+
+    return "\n".join([
+        ELASTIC_BEGIN,
+        f"## Elastic gangs ({jobs} jobs x (1+{workers}), CapacityFlap: "
+        f"{kill} worker nodes per job tainted then restored)",
+        "",
+        f"Generated {now} by `python scripts/bench_control_plane.py "
+        f"--elastic`.  `elastic` jobs carry an elasticPolicy and ride "
+        f"checkpoint-drain-resize (shrink to the survivors, grow back "
+        f"when the nodes return); `legacy` jobs pay the PR 2 full gang "
+        f"restart.  `state lost` counts pods that died or were deleted "
+        f"WITHOUT a checkpoint ack (their step state must be retrained); "
+        f"`kept running` counts pods that never stopped training; the "
+        f"pod-seconds deficit integrates the running-pod gap versus an "
+        f"undisrupted fleet over the whole scenario.",
+        "",
+        "| variant | converged | recovery s | full convergence s | "
+        "state lost | checkpointed | kept running | pod-seconds "
+        "deficit |",
+        "|---|---|---|---|---|---|---|---|",
+        row("elastic", res["elastic"]),
+        row("legacy", res["elastic_legacy"]),
+        "",
+        _elastic_reading(res),
+        "",
+        "```json",
+        json.dumps(res, indent=2),
+        "```",
+        ELASTIC_END,
+    ])
 
 
 def chaos_apiserver_plan(seed: int = 11, outage_s: float = 1.5,
@@ -1284,6 +1615,18 @@ def main() -> None:
     ap.add_argument("--chaos-apiserver-rate", type=float, default=0.10,
                     help="transient-error rate on mutating verbs for "
                          "the apiserver fault plan")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run ONLY the elastic-gang tier (elastic "
+                         "checkpoint-drain-resize vs legacy full gang "
+                         "restart under the same CapacityFlap plan), "
+                         "print one JSON line per variant, and with "
+                         "--out update only the delimited elastic "
+                         "section")
+    ap.add_argument("--elastic-jobs", type=int, default=4)
+    ap.add_argument("--elastic-workers", type=int, default=8)
+    ap.add_argument("--elastic-kill", type=int, default=2,
+                    help="worker nodes tainted per job by the flap")
+    ap.add_argument("--elastic-timeout", type=float, default=120.0)
     ap.add_argument("--churn-pods", action="store_true",
                     help="run ONLY the pod-informer MODIFIED-burst "
                          "measurement (delivered vs coalescible) and "
@@ -1301,6 +1644,26 @@ def main() -> None:
         res = run_churn_pods(args.churn_pods_jobs, args.churn_pods_workers,
                              bursts=args.churn_pods_bursts)
         print(json.dumps({"tier": "churn_pods", **res}))
+        return
+
+    if args.elastic:
+        print(f"[bench_cp] elastic ({args.elastic_jobs} jobs x "
+              f"(1+{args.elastic_workers}), flap kills "
+              f"{args.elastic_kill} nodes/job, elastic vs legacy)...",
+              file=sys.stderr)
+        res = run_elastic_ab(args.elastic_jobs, args.elastic_workers,
+                             kill=args.elastic_kill,
+                             timeout=args.elastic_timeout)
+        for tier, r in res.items():
+            print(json.dumps({"tier": tier, **r}))
+        if args.out:
+            update_md_section(
+                args.out, ELASTIC_BEGIN, ELASTIC_END,
+                render_elastic_md(res, args.elastic_jobs,
+                                  args.elastic_workers,
+                                  args.elastic_kill))
+            print(f"[bench_cp] updated elastic section of {args.out}",
+                  file=sys.stderr)
         return
 
     if args.chaos_apiserver:

@@ -25,7 +25,10 @@ from pytorch_operator_tpu.disruption import (
     pod_disruption_reason,
 )
 from pytorch_operator_tpu.disruption.detector import (
+    CLOUD_NODE_SHUTDOWN_TAINT,
+    DISRUPTION_TAINT_KEYS,
     IMPENDING_NODE_TERMINATION_TAINT,
+    NODE_OUT_OF_SERVICE_TAINT,
     NODE_UNREACHABLE_TAINT,
 )
 from pytorch_operator_tpu.k8s.errors import ApiError
@@ -69,6 +72,11 @@ class TestDetector:
         IMPENDING_NODE_TERMINATION_TAINT,
         NODE_UNREACHABLE_TAINT,
         "node.kubernetes.io/not-ready",
+        # graceful-node-shutdown spellings (ISSUE 6 satellite): the
+        # out-of-service taint an operator applies to a shut-down node,
+        # and the cloud provider's VM-powering-down taint
+        NODE_OUT_OF_SERVICE_TAINT,
+        CLOUD_NODE_SHUTDOWN_TAINT,
     ])
     def test_disruption_taints_detected(self, key):
         node = _mk_node(taints=[{"key": key, "effect": "NoSchedule"}])
@@ -141,6 +149,30 @@ class TestWatcher:
         cluster.nodes.patch("default", "n1", {"spec": {"taints": None}})
         cluster.nodes.patch("default", "n1", {"spec": {"taints": taint}})
         assert len(fired) == 2
+
+    @pytest.mark.parametrize("key", DISRUPTION_TAINT_KEYS)
+    def test_fires_exactly_once_per_taint_variant_in_sim(self, key):
+        """ISSUE 6 satellite: every recognized taint spelling —
+        graceful-node-shutdown variants included — fires the watcher
+        exactly once per node transition, injected through the fake
+        kubelet the way a sim scenario would."""
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+        cluster.nodes.create("default", _mk_node("n1"))
+        cluster.pods.create("default", _bound_pod("j-worker-0", "j", "n1"))
+        fired = []
+        informer = Informer(cluster.nodes)
+        DisruptionWatcher(cluster, informer,
+                          lambda jk, reason, node, uid=None: fired.append(
+                              (jk, reason)))
+        informer.start()
+        kubelet.taint_node("n1", key=key)
+        assert fired == [("default/j", key)]
+        # taint churn on the already-flagged node stays silent
+        kubelet.taint_node("n1", key=key)  # idempotent re-apply
+        cluster.nodes.patch("default", "n1",
+                            {"metadata": {"labels": {"x": "y"}}})
+        assert len(fired) == 1
 
     def test_resolves_only_jobs_on_the_node(self):
         cluster = FakeCluster()

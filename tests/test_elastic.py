@@ -1,0 +1,1172 @@
+"""Elastic gangs: checkpoint-drain-resize instead of delete-recreate
+(ISSUE 6).
+
+Acceptance (control plane): an elastic 8-worker job under a
+CapacityFlap shrinks to 6 via drain — the doomed pods checkpoint before
+deletion — keeps reconciling with its rendezvous re-rendered, grows
+back to 8 when the nodes return, and reaches ``Succeeded`` with zero
+duplicate creates and exactly one ``Resizing`` transition per capacity
+change; non-elastic jobs keep the PR 2 full-restart behavior.
+
+Acceptance (data plane): params checkpointed on a 4-device virtual CPU
+mesh restore onto a 2-device mesh (and back) numerically identical, and
+the llama example resumes training at the new world size.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.api.v1.defaults import set_defaults
+from pytorch_operator_tpu.api.v1.types import ElasticPolicy, PyTorchJob
+from pytorch_operator_tpu.api.v1.validation import (
+    ValidationError,
+    validate_spec,
+)
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.controller.tpu_env import (
+    elastic_rendezvous_annotations,
+)
+from pytorch_operator_tpu.disruption import CapacityFlap, CapacityWatcher
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet, new_tpu_node
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import (
+    FakePodControl,
+    FakeServiceControl,
+    Informer,
+    JobControllerConfig,
+)
+from pytorch_operator_tpu.runtime.expectations import (
+    expectation_pods_key,
+    expectation_services_key,
+)
+
+from testutil import job_condition, new_job, wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def elastic_job(name="elastic-job", workers=8, min_replicas=4,
+                max_replicas=None) -> PyTorchJob:
+    job = new_job(workers=workers, name=name, tpu_chips=4)
+    job.spec.elastic_policy = ElasticPolicy(
+        min_replicas=min_replicas, max_replicas=max_replicas or workers)
+    set_defaults(job)
+    return job
+
+
+# ---------------------------------------------------------------------------
+# API layer
+# ---------------------------------------------------------------------------
+
+
+class TestElasticPolicyApi:
+    def test_valid_policy_passes(self):
+        validate_spec(elastic_job().spec)
+
+    def test_policy_requires_workers(self):
+        job = new_job(workers=0, name="no-workers", tpu_chips=4)
+        job.spec.elastic_policy = ElasticPolicy(min_replicas=1,
+                                                max_replicas=2)
+        with pytest.raises(ValidationError, match="Worker"):
+            validate_spec(job.spec)
+
+    @pytest.mark.parametrize("min_r,max_r,needle", [
+        (0, 4, "minReplicas"),
+        (-1, 4, "minReplicas"),
+        (1, 0, "maxReplicas"),
+        (6, 4, "exceeds maxReplicas"),
+        # bools pass isinstance(int) — a YAML `minReplicas: true` must
+        # not silently become a floor of 1
+        (True, 4, "minReplicas"),
+        (2, True, "maxReplicas"),
+    ])
+    def test_bad_bounds_rejected(self, min_r, max_r, needle):
+        job = elastic_job(workers=4, min_replicas=4)
+        job.spec.elastic_policy = ElasticPolicy(min_replicas=min_r,
+                                                max_replicas=max_r)
+        with pytest.raises(ValidationError, match=needle):
+            validate_spec(job.spec)
+
+    def test_configured_count_must_sit_inside_bounds(self):
+        job = elastic_job(workers=2, min_replicas=4, max_replicas=8)
+        with pytest.raises(ValidationError, match="below"):
+            validate_spec(job.spec)
+        job = elastic_job(workers=8, min_replicas=1, max_replicas=4)
+        with pytest.raises(ValidationError, match="above"):
+            validate_spec(job.spec)
+
+    def test_wire_round_trip(self):
+        job = elastic_job(min_replicas=3, max_replicas=8)
+        job.status.desired_replicas = 6
+        job.status.elastic_resizes = 2
+        wire = job.to_dict()
+        assert wire["spec"]["elasticPolicy"] == {
+            "minReplicas": 3, "maxReplicas": 8}
+        assert wire["status"]["desiredReplicas"] == 6
+        assert wire["status"]["elasticResizes"] == 2
+        back = PyTorchJob.from_dict(wire)
+        assert back.spec.elastic_policy.min_replicas == 3
+        assert back.status.desired_replicas == 6
+        # an untouched non-elastic job serializes no elastic fields
+        plain = new_job(workers=2, name="plain").to_dict()
+        assert "elasticPolicy" not in plain["spec"]
+        assert "desiredReplicas" not in plain.get("status", {})
+
+
+class TestElasticAnnotations:
+    def test_dense_ranks_across_index_holes(self):
+        job = elastic_job(name="j", workers=8)
+        pods = [_bound_pod("j-master-0", "j", "n0", rtype="master")]
+        # survivors at indices 0,1,2,4,5,7 (3 and 6 drained)
+        for i in (0, 1, 2, 4, 5, 7):
+            pods.append(_bound_pod(f"j-worker-{i}", "j", f"n{i+1}",
+                                   index=str(i)))
+        anns = elastic_rendezvous_annotations(job, pods)
+        ws = constants.ANNOTATION_ELASTIC_WORLD_SIZE
+        rank = constants.ANNOTATION_ELASTIC_RANK
+        hosts = constants.ANNOTATION_ELASTIC_HOSTNAMES
+        assert anns["j-master-0"][rank] == "0"
+        assert all(a[ws] == "7" for a in anns.values())
+        # dense, index-ordered: worker-4 is rank 4 (after 0,1,2), not 5
+        assert anns["j-worker-0"][rank] == "1"
+        assert anns["j-worker-4"][rank] == "4"
+        assert anns["j-worker-7"][rank] == "6"
+        hostnames = anns["j-master-0"][hosts].split(",")
+        assert hostnames[0] == "j-master-0"
+        assert hostnames[4] == "j-worker-4"
+        assert len(hostnames) == 7
+
+    def test_master_absent_keeps_master_slot_in_world_size(self):
+        # a master restart racing the render must not shrink WORLD_SIZE
+        # to len(workers) while the hostname list still leads with the
+        # master — ranks would fall out of range and the rendezvous hang
+        job = elastic_job(name="j", workers=8)
+        pods = [_bound_pod(f"j-worker-{i}", "j", f"n{i}", index=str(i))
+                for i in (0, 1, 2)]
+        anns = elastic_rendezvous_annotations(job, pods)
+        ws = constants.ANNOTATION_ELASTIC_WORLD_SIZE
+        hosts = constants.ANNOTATION_ELASTIC_HOSTNAMES
+        assert all(a[ws] == "4" for a in anns.values())
+        assert anns["j-worker-2"][constants.ANNOTATION_ELASTIC_RANK] == "3"
+        hostnames = anns["j-worker-0"][hosts].split(",")
+        assert hostnames[0] == "j-master-0"
+        assert len(hostnames) == 4
+
+
+# ---------------------------------------------------------------------------
+# Handler units (drain / grow state machine)
+# ---------------------------------------------------------------------------
+
+
+def _bound_pod(name, job_name, node, rtype="worker", index="0",
+               uid="test-uid-elastic-job", phase="Running"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "labels": {constants.LABEL_REPLICA_TYPE: rtype,
+                       constants.LABEL_REPLICA_INDEX: index},
+            "ownerReferences": [{
+                "apiVersion": constants.API_VERSION, "kind": constants.KIND,
+                "name": job_name, "uid": uid, "controller": True}],
+        },
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "pytorch", "image": "i"}]},
+        "status": {"phase": phase},
+    }
+
+
+def _elastic_world(drain_deadline=10.0, max_resizes=3):
+    cluster = FakeCluster()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(
+            enable_disruption_handling=True,
+            drain_deadline_seconds=drain_deadline,
+            max_elastic_resizes=max_resizes),
+        registry=Registry())
+    ctl.pod_control = FakePodControl()
+    ctl.service_control = FakeServiceControl()
+    return cluster, ctl
+
+
+def _gang_pods(cluster, job, nodes=None):
+    """Create the job's gang in the fake cluster, one worker per node
+    (master on its own node), and return the live pod dicts."""
+    name = job.metadata.name
+    uid = job.metadata.uid
+    workers = int(job.spec.pytorch_replica_specs["Worker"].replicas or 0)
+    pods = [_bound_pod(f"{name}-master-0", name, "node-m", rtype="master",
+                       uid=uid)]
+    for i in range(workers):
+        node = nodes[i] if nodes else f"node-{i}"
+        pods.append(_bound_pod(f"{name}-worker-{i}", name, node,
+                               index=str(i), uid=uid))
+    for pod in pods:
+        cluster.pods.create("default", pod)
+    return [cluster.pods.get("default", p["metadata"]["name"])
+            for p in pods]
+
+
+class TestDrainStateMachine:
+    def test_shrink_signals_checkpoint_and_waits(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        # phase 1: nothing deleted yet, doomed pod signalled, status moved
+        assert ctl.pod_control.delete_pod_names == []
+        doomed = cluster.pods.get("default", "elastic-job-worker-3")
+        anns = doomed["metadata"]["annotations"]
+        assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+        assert job.status.desired_replicas == 7
+        assert job.status.elastic_resizes == 1
+        conds = {c.type: c for c in job.status.conditions}
+        assert conds[constants.JOB_RESIZING].status == "True"
+        assert conds[constants.JOB_RESIZING].reason == \
+            constants.RESIZE_SHRINK_REASON
+        assert ctl.elastic_resizes_counter.labels(
+            direction="shrink").value == 1
+        # survivors untouched; no preemption-restart budget spent
+        assert not job.status.preemption_restarts
+
+    def test_ack_completes_drain_early(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        # not acked yet: the sync waits
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == []
+        # the pod acks -> the next sync deletes ONLY the doomed pod
+        cluster.pods.patch("default", "elastic-job-worker-3",
+                           {"metadata": {"annotations": {
+                               constants.ANNOTATION_CHECKPOINTED: "now"}}})
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == ["elastic-job-worker-3"]
+        assert ctl.expectations.get(
+            expectation_pods_key(job.key, "worker")).dels == 1
+        assert ctl.elastic_drain_seconds.count == 1
+        assert ctl.elastic_drain_timeouts_counter.value == 0
+
+    def test_drain_reasserts_status_after_failed_write(self):
+        # The intake sync's end-of-sync status write can fail AFTER the
+        # drain note was armed: the requeued sync rebuilds the job from
+        # the store at the PRE-shrink size.  The note must re-assert
+        # the shrunken target/budget/condition onto that sync's status
+        # — else the drain deletes the doomed pods while the store
+        # never learns the target, and the next reconcile recreates
+        # the very indices it just drained.
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        # the write failed: a FRESH job object plays the store's stale
+        # status (no desiredReplicas, no Resizing condition, no budget)
+        retry_job = elastic_job()
+        cluster.pods.patch("default", "elastic-job-worker-3",
+                           {"metadata": {"annotations": {
+                               constants.ANNOTATION_CHECKPOINTED: "now"}}})
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(retry_job, retry_job.to_dict(),
+                                          pods) is True
+        assert ctl.pod_control.delete_pod_names == ["elastic-job-worker-3"]
+        assert retry_job.status.desired_replicas == 7
+        assert retry_job.status.elastic_resizes == 1
+        conds = {c.type: c for c in retry_job.status.conditions}
+        assert conds[constants.JOB_RESIZING].status == "True"
+        assert conds[constants.JOB_RESIZING].reason == \
+            constants.RESIZE_SHRINK_REASON
+        # the shrink was still counted exactly once
+        assert ctl.elastic_resizes_counter.labels(
+            direction="shrink").value == 1
+
+    def test_drain_deadline_deletes_unacked_pods(self):
+        cluster, ctl = _elastic_world(drain_deadline=10.0)
+        clock = [100.0]
+        ctl._mono = lambda: clock[0]  # fake clock: no real sleeping
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-5",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == []
+        clock[0] += 10.1  # deadline passes, still no ack
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == ["elastic-job-worker-5"]
+        assert ctl.elastic_drain_timeouts_counter.value == 1
+
+    def test_already_dead_doomed_pod_counts_as_acked(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        cluster.pods.set_status("default", "elastic-job-worker-2",
+                                {"phase": "Failed"})
+        ctl._note_node_disruption(job.key, "taint", "node-2",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        pods = cluster.pods.list("default")
+        # dead pods can't checkpoint: the drain proceeds immediately
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == ["elastic-job-worker-2"]
+
+    def test_second_node_merges_into_inflight_drain(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert job.status.desired_replicas == 7
+        # a second node dies mid-drain: SAME drain widens, budget and
+        # the Resizing transition stay single
+        ctl._note_node_disruption(job.key, "taint", "node-6",
+                                  uid=job.metadata.uid)
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert job.status.desired_replicas == 6
+        assert job.status.elastic_resizes == 1
+        anns = cluster.pods.get(
+            "default", "elastic-job-worker-6")["metadata"]["annotations"]
+        assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+        # both acked -> one batched delete of exactly the two doomed pods
+        for name in ("elastic-job-worker-3", "elastic-job-worker-6"):
+            cluster.pods.patch("default", name,
+                               {"metadata": {"annotations": {
+                                   constants.ANNOTATION_CHECKPOINTED: "t"}}})
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert sorted(ctl.pod_control.delete_pod_names) == [
+            "elastic-job-worker-3", "elastic-job-worker-6"]
+
+    def test_pod_scoped_signal_coalesces_into_pending_note(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        # an eviction marks a pod on ANOTHER node before the sync runs:
+        # the coalesced note must doom BOTH, or the marked pod is
+        # killed without ever being told to checkpoint
+        ctl._note_disruption(job.key, "evict",
+                             "pod/elastic-job-worker-5",
+                             uid=job.metadata.uid,
+                             pod="elastic-job-worker-5")
+        assert ctl.preemptions_detected_counter.value == 1  # coalesced
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert job.status.desired_replicas == 6
+        for name in ("elastic-job-worker-3", "elastic-job-worker-5"):
+            anns = cluster.pods.get(
+                "default", name)["metadata"]["annotations"]
+            assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+
+    def test_merge_extends_deadline_for_late_doomed_pods(self):
+        cluster, ctl = _elastic_world(drain_deadline=10.0)
+        clock = [0.0]
+        ctl._mono = lambda: clock[0]
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        # a second node dies just before the original deadline: its
+        # pods must get a FULL checkpoint window, not 0.1s
+        clock[0] = 9.9
+        ctl._note_node_disruption(job.key, "taint", "node-6",
+                                  uid=job.metadata.uid)
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        clock[0] = 10.1  # past the ORIGINAL deadline
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert ctl.pod_control.delete_pod_names == []  # still draining
+        clock[0] = 20.0  # past the extended deadline (9.9 + 10)
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is True
+        assert sorted(ctl.pod_control.delete_pod_names) == [
+            "elastic-job-worker-3", "elastic-job-worker-6"]
+
+    def test_abandoned_drain_returns_budget_and_clears_condition(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job(workers=8, min_replicas=6)
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert job.status.elastic_resizes == 1
+        # two more nodes die mid-drain: target would be 5 < min 6, the
+        # shrink is abandoned for the legacy full restart — which must
+        # NOT keep the budget slot or the True Resizing condition
+        ctl._note_node_disruption(job.key, "taint", "node-0",
+                                  uid=job.metadata.uid)
+        ctl._note_node_disruption(job.key, "taint", "node-1",
+                                  uid=job.metadata.uid)
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert len(ctl.pod_control.delete_pod_names) == 9  # full gang
+        assert job.status.elastic_resizes == 0  # slot returned
+        assert job.status.desired_replicas == 8
+        from pytorch_operator_tpu.controller import status as sm
+
+        cond = sm.get_condition(job.status, constants.JOB_RESIZING)
+        assert cond.status == "False"
+        assert cond.reason == constants.RESIZE_ABANDONED_REASON
+        assert job.status.preemption_restarts == 1
+
+    def test_intake_coalesces_second_node_into_pending_note(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-1",
+                                  uid=job.metadata.uid)
+        ctl._note_node_disruption(job.key, "taint", "node-4",
+                                  uid=job.metadata.uid)
+        assert ctl.preemptions_detected_counter.value == 1  # coalesced
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        # BOTH nodes' workers are in the doomed set of the one drain
+        assert job.status.desired_replicas == 6
+        for name in ("elastic-job-worker-1", "elastic-job-worker-4"):
+            anns = cluster.pods.get(
+                "default", name)["metadata"]["annotations"]
+            assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+
+
+class TestElasticFallbacks:
+    def test_below_min_replicas_falls_back_to_full_restart(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job(workers=4, min_replicas=4)
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-0",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        # the legacy path fired: whole gang deleted, TPUPreempted set
+        assert len(ctl.pod_control.delete_pod_names) == 5
+        assert job.status.preemption_restarts == 1
+        assert not job.status.elastic_resizes
+        conds = {c.type: c for c in job.status.conditions}
+        assert conds[constants.JOB_RESTARTING].reason == \
+            constants.TPU_PREEMPTED_REASON
+
+    def test_master_doomed_falls_back_to_full_restart(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-m",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert len(ctl.pod_control.delete_pod_names) == 9
+        assert job.status.preemption_restarts == 1
+
+    def test_resize_budget_exhausted_falls_back(self):
+        cluster, ctl = _elastic_world(max_resizes=1)
+        job = elastic_job()
+        job.status.elastic_resizes = 1
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert len(ctl.pod_control.delete_pod_names) == 9
+        reasons = {e["reason"] for e in cluster.events.list()}
+        assert constants.ELASTIC_RESIZES_EXHAUSTED_REASON in reasons
+
+    def test_annotation_overrides_resize_budget(self):
+        cluster, ctl = _elastic_world(max_resizes=1)
+        job = elastic_job()
+        job.metadata.annotations[
+            constants.ANNOTATION_MAX_ELASTIC_RESIZES] = "5"
+        job.status.elastic_resizes = 3
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods)
+        assert job.status.elastic_resizes == 4
+        assert ctl.pod_control.delete_pod_names == []  # draining, not killing
+
+    def test_unscoped_note_falls_back(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        pods = _gang_pods(cluster, job)
+        ctl._note_disruption(job.key, "taint", "node/n1")  # no node scope
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert len(ctl.pod_control.delete_pod_names) == 9
+
+    def test_non_elastic_job_never_enters_elastic_path(self):
+        cluster, ctl = _elastic_world()
+        job = new_job(workers=8, name="plain-gang", tpu_chips=4)
+        set_defaults(job)
+        pods = _gang_pods(cluster, job)
+        ctl._note_node_disruption(job.key, "taint", "node-3",
+                                  uid=job.metadata.uid)
+        assert ctl.maybe_handle_disruption(job, job.to_dict(), pods) is True
+        assert len(ctl.pod_control.delete_pod_names) == 9
+        assert job.status.desired_replicas is None
+        assert not job.status.elastic_resizes
+
+
+class TestGrow:
+    def test_grow_restores_target_when_capacity_free(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        # two free schedulable TPU nodes + the note the capacity
+        # watcher would have left
+        cluster.nodes.create("default", new_tpu_node("free-1"))
+        cluster.nodes.create("default", new_tpu_node("free-2"))
+        ctl.node_informer.start()  # free_capacity reads the informer store
+        ctl._shrunken_jobs[job.key] = job.metadata.uid
+        ctl._pending_grows[job.key] = {"node": "free-1",
+                                       "uid": job.metadata.uid}
+        # grow falls through (False) so the SAME sync reconciles creates
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), []) is False
+        assert job.status.desired_replicas == 8
+        conds = {c.type: c for c in job.status.conditions}
+        assert conds[constants.JOB_RESIZING].reason == \
+            constants.RESIZE_GROW_REASON
+        assert ctl.elastic_resizes_counter.labels(
+            direction="grow").value == 1
+
+    def test_grow_waits_for_enough_capacity(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        cluster.nodes.create("default", new_tpu_node("free-1"))  # need 2
+        ctl.node_informer.start()
+        ctl._pending_grows[job.key] = {"node": "free-1",
+                                       "uid": job.metadata.uid}
+        ctl.maybe_continue_elastic(job, job.to_dict(), [])
+        assert job.status.desired_replicas == 6  # still shrunken
+
+    def test_completion_clears_condition_and_rerenders(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        from pytorch_operator_tpu.controller import status as sm
+
+        sm.update_job_conditions(job.status, constants.JOB_RESIZING,
+                                 constants.RESIZE_SHRINK_REASON, "x")
+        # gang at exactly the target: master + 6 survivors (3, 6 drained)
+        pods = [_bound_pod("elastic-job-master-0", "elastic-job", "node-m",
+                           rtype="master")]
+        for i in (0, 1, 2, 4, 5, 7):
+            pods.append(_bound_pod(f"elastic-job-worker-{i}", "elastic-job",
+                                   f"node-{i}", index=str(i)))
+        for p in pods:
+            cluster.pods.create("default", dict(p))
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is False
+        cond = sm.get_condition(job.status, constants.JOB_RESIZING)
+        assert cond.status == "False"
+        assert cond.reason == constants.RESIZE_COMPLETED_REASON
+        survivor = cluster.pods.get("default", "elastic-job-worker-4")
+        anns = survivor["metadata"]["annotations"]
+        assert anns[constants.ANNOTATION_ELASTIC_WORLD_SIZE] == "7"
+        assert anns[constants.ANNOTATION_ELASTIC_RANK] == "4"
+
+
+    def test_grow_claims_stop_siblings_taking_the_same_nodes(self):
+        # one capacity event wakes every shrunken job; only as many may
+        # grow as there is UNCLAIMED capacity — the rest stay shrunken
+        # until the first grow completes and releases its reservation
+        cluster, ctl = _elastic_world()
+        job_a = elastic_job(name="job-a")
+        job_b = elastic_job(name="job-b")
+        job_a.status.desired_replicas = 6
+        job_b.status.desired_replicas = 6
+        cluster.nodes.create("default", new_tpu_node("free-1"))
+        cluster.nodes.create("default", new_tpu_node("free-2"))
+        ctl.node_informer.start()
+        for job in (job_a, job_b):
+            ctl._pending_grows[job.key] = {"node": "free-1",
+                                           "uid": job.metadata.uid}
+        assert ctl.maybe_continue_elastic(job_a, job_a.to_dict(), []) is False
+        assert job_a.status.desired_replicas == 8  # claimed both nodes
+        ctl.maybe_continue_elastic(job_b, job_b.to_dict(), [])
+        assert job_b.status.desired_replicas == 6  # capacity spoken for
+        # job-a's resize completes -> its claim releases -> job-b can grow
+        pods = [_bound_pod("job-a-master-0", "job-a", "node-m",
+                           rtype="master", uid=job_a.metadata.uid)]
+        for i in range(8):
+            pods.append(_bound_pod(f"job-a-worker-{i}", "job-a",
+                                   f"node-{i}", index=str(i),
+                                   uid=job_a.metadata.uid))
+        for p in pods:
+            cluster.pods.create("default", dict(p))
+        assert ctl.maybe_continue_elastic(job_a, job_a.to_dict(),
+                                          pods) is False
+        # releasing the claim re-woke job-b by itself (no node
+        # transition happened, so the CapacityWatcher stayed silent)
+        assert job_b.key in ctl._pending_grows
+        ctl.maybe_continue_elastic(job_b, job_b.to_dict(), [])
+        assert job_b.status.desired_replicas == 8
+
+    def test_replacement_pod_annotated_in_steady_shrunken_state(self):
+        # A survivor's replacement created AFTER the shrink completed
+        # boots with the CONFIGURED-size env (build_cluster_env can't
+        # know the elastic target) and missed the completion-edge
+        # render: the steady-state re-render must annotate it, or the
+        # replacement waits for a full-size rendezvous its 6 peers'
+        # annotations contradict.
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6  # shrink completed: no condition
+        pods = [_bound_pod("elastic-job-master-0", "elastic-job", "node-m",
+                           rtype="master")]
+        for i in (0, 1, 2, 4, 5, 7):
+            pods.append(_bound_pod(f"elastic-job-worker-{i}", "elastic-job",
+                                   f"node-{i}", index=str(i)))
+        for p in pods:
+            cluster.pods.create("default", dict(p))
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is False
+        anns = cluster.pods.get(
+            "default", "elastic-job-worker-4")["metadata"]["annotations"]
+        assert anns[constants.ANNOTATION_ELASTIC_WORLD_SIZE] == "7"
+        # the replacement scenario proper: worker-4 is recreated bare
+        # (a survivor restart refilled the index) — the next sync's
+        # steady-state render freshens it
+        cluster.pods.delete("default", "elastic-job-worker-4")
+        cluster.pods.create("default", _bound_pod(
+            "elastic-job-worker-4", "elastic-job", "node-4b", index="4"))
+        pods = cluster.pods.list("default")
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), pods) is False
+        anns = cluster.pods.get(
+            "default", "elastic-job-worker-4")["metadata"]["annotations"]
+        assert anns[constants.ANNOTATION_ELASTIC_WORLD_SIZE] == "7"
+        assert anns[constants.ANNOTATION_ELASTIC_RANK] == "4"
+
+    def test_grow_survives_failed_status_write(self):
+        # The end-of-sync status write can fail AFTER _try_grow claimed
+        # capacity and the same sync's reconcile created the missing
+        # workers: the requeued sync rebuilds the job from the store at
+        # the SHRUNKEN size while the full gang is already live.  The
+        # grow note is the retry memory (symmetric with the drain
+        # note): it must survive an applied grow, and the retry must
+        # re-apply desiredReplicas WITHOUT demanding fresh capacity for
+        # workers that already exist — else the claim strands forever,
+        # deducting nodes from every sibling's free-capacity check.
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        cluster.nodes.create("default", new_tpu_node("free-1"))
+        cluster.nodes.create("default", new_tpu_node("free-2"))
+        ctl.node_informer.start()
+        ctl._shrunken_jobs[job.key] = job.metadata.uid
+        ctl._pending_grows[job.key] = {"node": "free-1",
+                                       "uid": job.metadata.uid}
+        assert ctl.maybe_continue_elastic(job, job.to_dict(), []) is False
+        assert job.status.desired_replicas == 8
+        assert ctl._growing_claims[job.key] == 2
+        # applied but not yet durably written: the note must survive
+        assert job.key in ctl._pending_grows
+
+        # the write failed; the requeued sync sees the STORE's job
+        # (still shrunken) but the creates went through — full gang
+        # live and bound on the freed nodes
+        retry_job = elastic_job()
+        retry_job.status.desired_replicas = 6
+        pods = [_bound_pod("elastic-job-master-0", "elastic-job", "node-m",
+                           rtype="master")]
+        for i in range(8):
+            node = ("free-1", "free-2")[i - 6] if i >= 6 else f"node-{i}"
+            pods.append(_bound_pod(f"elastic-job-worker-{i}", "elastic-job",
+                                   node, index=str(i)))
+        for p in pods:
+            cluster.pods.create("default", dict(p))
+        assert ctl.maybe_continue_elastic(retry_job, retry_job.to_dict(),
+                                          pods) is False
+        # the retry re-applied the grow and the completed resize
+        # released the claim — and ONE real resize stayed one counter
+        # increment across the retries (the note remembers the
+        # announcement)
+        assert retry_job.status.desired_replicas == 8
+        assert ctl.elastic_resizes_counter.labels(
+            direction="grow").value == 1
+        assert job.key not in ctl._growing_claims
+        from pytorch_operator_tpu.controller import status as sm
+
+        cond = sm.get_condition(retry_job.status, constants.JOB_RESIZING)
+        assert cond.status == "False"
+        assert cond.reason == constants.RESIZE_COMPLETED_REASON
+        # once the store shows the grown target, the note drains
+        grown_job = elastic_job()
+        grown_job.status.desired_replicas = 8
+        assert ctl.maybe_continue_elastic(grown_job, grown_job.to_dict(),
+                                          pods) is False
+        assert job.key not in ctl._pending_grows
+
+    def test_terminal_job_releases_claim_and_grow_wakes(self):
+        # a job that ends mid-grow must not keep its capacity claim (it
+        # would starve every other shrunken job) nor its shrunken
+        # registration (pointless grow wakes on each capacity event)
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        cluster.jobs.create("default", job.to_dict())
+        ctl._growing_claims[job.key] = 2
+        ctl._shrunken_jobs[job.key] = job.metadata.uid
+        from pytorch_operator_tpu.controller import status as sm
+
+        sm.update_job_conditions(job.status, constants.JOB_SUCCEEDED,
+                                 "r", "m")
+        ctl.reconcile(job, job.to_dict())
+        assert job.key not in ctl._growing_claims
+        assert job.key not in ctl._shrunken_jobs
+
+
+class TestShrunkenReconcile:
+    def _shrunken_worker_pods(self, survivors=(0, 1, 2, 4, 5, 7)):
+        # survivors of an 8-gang shrunken to 6 (indices 3 and 6 drained)
+        return [_bound_pod(f"elastic-job-worker-{i}", "elastic-job",
+                           f"node-{i}", index=str(i)) for i in survivors]
+
+    def test_failed_survivor_restarts_instead_of_failing_job(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        spec = job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_WORKER]
+        spec.restart_policy = constants.RESTART_POLICY_EXIT_CODE
+        pods = self._shrunken_worker_pods()
+        pods[3]["status"] = {  # worker-4 dies retryably (SIGKILL)
+            "phase": "Failed",
+            "containerStatuses": [{
+                "name": constants.DEFAULT_CONTAINER_NAME,
+                "state": {"terminated": {"exitCode": 137}}}]}
+        ctl.reconcile_pods(job, job.to_dict(), pods, "Worker", spec,
+                           gang_enabled=False, elastic_target=6)
+        # the survivor restarts (its node outlived it, unlike the
+        # drained holes') — the job must NOT terminally fail
+        assert ctl.pod_control.delete_pod_names == ["elastic-job-worker-4"]
+        conds = {c.type: c for c in job.status.conditions}
+        assert constants.JOB_FAILED not in conds
+        assert conds[constants.JOB_RESTARTING].status == "True"
+
+    def test_replacement_fills_lowest_hole_only_up_to_target(self):
+        cluster, ctl = _elastic_world()
+        job = elastic_job()
+        job.status.desired_replicas = 6
+        spec = job.spec.pytorch_replica_specs[constants.REPLICA_TYPE_WORKER]
+        # worker-4's restarted pod is gone this sync: occupancy 5 < 6,
+        # so exactly ONE replacement fills the lowest empty index; the
+        # remaining drained holes are left for the grow path
+        pods = self._shrunken_worker_pods(survivors=(0, 1, 2, 5, 7))
+        ctl.reconcile_pods(job, job.to_dict(), pods, "Worker", spec,
+                           gang_enabled=False, elastic_target=6)
+        created = [
+            p["metadata"]["labels"][constants.LABEL_REPLICA_INDEX]
+            for p in ctl.pod_control.templates]
+        assert created == ["3"]
+        assert ctl.pod_control.delete_pod_names == []
+
+
+class TestCapacityWatcher:
+    def test_fires_once_per_schedulable_transition(self):
+        cluster = FakeCluster()
+        cluster.nodes.create("default", new_tpu_node("n1"))
+        informer = Informer(cluster.nodes)
+        fired = []
+        CapacityWatcher(informer, fired.append)
+        informer.start()
+        assert fired == []  # initial LIST is existing, not returning
+        taint = [{"key": constants.IMPENDING_NODE_TERMINATION_TAINT,
+                  "effect": "NoSchedule"}]
+        cluster.nodes.patch("default", "n1", {"spec": {"taints": taint}})
+        assert fired == []
+        cluster.nodes.patch("default", "n1", {"spec": {"taints": None}})
+        assert fired == ["n1"]
+        # churn on an already-schedulable node stays silent
+        cluster.nodes.patch("default", "n1",
+                            {"metadata": {"labels": {"x": "y"}}})
+        assert fired == ["n1"]
+        # a fresh node joining AFTER sync is returning capacity
+        cluster.nodes.create("default", new_tpu_node("n2"))
+        assert fired == ["n1", "n2"]
+
+    def test_free_capacity_counts_empty_schedulable_tpu_nodes(self):
+        cluster = FakeCluster()
+        cluster.nodes.create("default", new_tpu_node("empty"))
+        busy = new_tpu_node("busy")
+        cluster.nodes.create("default", busy)
+        tainted = new_tpu_node("tainted")
+        tainted["spec"]["taints"] = [{
+            "key": constants.NODE_UNREACHABLE_TAINT, "effect": "NoExecute"}]
+        cluster.nodes.create("default", tainted)
+        cluster.pods.create("default",
+                            _bound_pod("p1", "j", "busy"))
+        informer = Informer(cluster.nodes)
+        watcher = CapacityWatcher(informer, lambda n: None, cluster=cluster)
+        informer.start()
+        assert watcher.free_capacity() == 1
+
+
+def _unbound_pod(name):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "pytorch", "image": "i"}]},
+            "status": {}}
+
+
+class TestCapacityFreeze:
+    def test_freeze_queues_pods_and_reuses_freed_nodes(self):
+        """CapacityFlap(freeze_capacity=True)'s kubelet side: while
+        frozen no fresh node is minted — a pod beyond the freed-node
+        pool waits Pending, binds the moment a node frees mid-dip, and
+        provisioning resumes at unfreeze.  This is what makes the
+        --elastic bench's legacy variant genuinely ride the dip instead
+        of escaping onto lazily provisioned nodes."""
+        import time as _time
+
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster, decide=lambda pod: None)
+        kubelet.start()
+        try:
+            cluster.pods.create("default", _unbound_pod("warm"))
+            assert wait_for(lambda: (cluster.pods.get("default", "warm")
+                                     .get("spec") or {}).get("nodeName"))
+            warm_node = cluster.pods.get(
+                "default", "warm")["spec"]["nodeName"]
+            kubelet.freeze_capacity()
+            cluster.pods.create("default", _unbound_pod("starved"))
+            _time.sleep(0.1)
+            pod = cluster.pods.get("default", "starved")
+            assert not (pod.get("spec") or {}).get("nodeName")
+            assert (pod.get("status") or {}).get("phase") == "Pending"
+            # a node freed mid-dip goes straight to the waiting pod
+            cluster.pods.delete("default", "warm")
+            assert wait_for(
+                lambda: (cluster.pods.get("default", "starved")
+                         .get("spec") or {}).get("nodeName") == warm_node)
+            assert wait_for(
+                lambda: (cluster.pods.get("default", "starved")
+                         .get("status") or {}).get("phase") == "Running")
+            # still frozen: the next pod has nothing to bind to...
+            cluster.pods.create("default", _unbound_pod("starved-2"))
+            _time.sleep(0.1)
+            assert not (cluster.pods.get("default", "starved-2")
+                        .get("spec") or {}).get("nodeName")
+            # ...until the freeze lifts and provisioning resumes
+            kubelet.unfreeze_capacity()
+            assert wait_for(
+                lambda: (cluster.pods.get("default", "starved-2")
+                         .get("status") or {}).get("phase") == "Running")
+        finally:
+            kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sim e2e: the acceptance CapacityFlap scenario.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def flap_world():
+    cluster = FakeCluster()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(enable_disruption_handling=True,
+                                   drain_deadline_seconds=5.0),
+        registry=registry)
+    # pods run forever until the test flips the decision; drained pods
+    # ack their checkpoint after checkpoint_delay
+    kubelet = FakeKubelet(cluster, decide=lambda pod: None,
+                          checkpoint_delay=0.02)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    yield cluster, ctl, registry, kubelet
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+
+
+def _running_pods(cluster):
+    return [p for p in cluster.pods.list()
+            if (p.get("status") or {}).get("phase") == "Running"]
+
+
+def _finish(cluster, kubelet):
+    kubelet.decide = lambda pod: ("Succeeded", 0)
+    for pod in _running_pods(cluster):
+        kubelet.complete_pod_now("default", pod["metadata"]["name"])
+
+
+def test_capacity_flap_shrink_then_grow(flap_world):
+    """ISSUE 6 acceptance: elastic 8-worker job under a CapacityFlap
+    shrinks to 6 via drain (doomed pods checkpoint before deletion),
+    keeps reconciling with re-rendered WORLD_SIZE, grows back to 8 when
+    the nodes return, reaches Succeeded with zero duplicate creates and
+    exactly one Resizing transition per capacity change."""
+    cluster, ctl, registry, kubelet = flap_world
+    job = elastic_job(name="flap-job", workers=8, min_replicas=4)
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(_running_pods(cluster)) == 9), \
+        [p.get("status") for p in cluster.pods.list()]
+    gen1 = {p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in cluster.pods.list()}
+
+    # flight recorder: every job-status write (Resizing transitions) and
+    # every pod delete (checkpoint-before-deletion proof)
+    seen_conditions = []
+    cluster.jobs.add_listener(
+        lambda et, obj: seen_conditions.extend(
+            (obj.get("status") or {}).get("conditions") or []))
+    deleted_pods = []
+    cluster.pods.add_listener(
+        lambda et, obj: deleted_pods.append(obj) if et == "DELETED" else None)
+
+    victims = ["flap-job-worker-3", "flap-job-worker-6"]
+    victim_nodes = [cluster.pods.get("default", v)["spec"]["nodeName"]
+                    for v in victims]
+    assert all(victim_nodes)
+    flap = CapacityFlap(kubelet, victim_nodes, grace=1.0)
+    flap.down()
+
+    # shrink: exactly the two doomed pods drained away, 7 keep running
+    assert wait_for(lambda: (
+        len(_running_pods(cluster)) == 7
+        and not any(_pod_exists(cluster, v) for v in victims)), timeout=20), \
+        [p["metadata"]["name"] for p in _running_pods(cluster)]
+    # the doomed pods checkpointed BEFORE deletion
+    drained = [p for p in deleted_pods
+               if p["metadata"]["name"] in victims]
+    assert len(drained) == 2
+    for pod in drained:
+        anns = pod["metadata"].get("annotations") or {}
+        assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+        assert constants.ANNOTATION_CHECKPOINTED in anns
+    # survivors are the ORIGINAL pods (no full restart) and keep running
+    for p in _running_pods(cluster):
+        assert gen1[p["metadata"]["name"]] == p["metadata"]["uid"]
+    # the job keeps reconciling at the reduced size: desired persisted,
+    # survivors' rendezvous re-rendered to WORLD_SIZE 7
+    assert wait_for(lambda: cluster.jobs.get("default", "flap-job")
+                    ["status"].get("desiredReplicas") == 6)
+    assert wait_for(lambda: all(
+        (cluster.pods.get("default", p["metadata"]["name"])["metadata"]
+         .get("annotations") or {}).get(
+             constants.ANNOTATION_ELASTIC_WORLD_SIZE) == "7"
+        for p in _running_pods(cluster)), timeout=20)
+    assert ctl.elastic_drain_timeouts_counter.value == 0
+
+    # capacity returns: the gang grows back to 8 workers
+    flap.restore()
+    assert wait_for(lambda: len(_running_pods(cluster)) == 9, timeout=20), \
+        [p["metadata"]["name"] for p in _running_pods(cluster)]
+    assert wait_for(lambda: all(
+        (cluster.pods.get("default", p["metadata"]["name"])["metadata"]
+         .get("annotations") or {}).get(
+             constants.ANNOTATION_ELASTIC_WORLD_SIZE) == "9"
+        for p in _running_pods(cluster)), timeout=20)
+
+    _finish(cluster, kubelet)
+    assert wait_for(lambda: job_condition(
+        cluster, "default", "flap-job", constants.JOB_SUCCEEDED)), \
+        cluster.jobs.get("default", "flap-job")["status"]
+
+    # zero duplicate creates: 9 initial + exactly the 2 regrown
+    events = cluster.events.list()
+    creates = [e for e in events if e["reason"] == "SuccessfulCreatePod"]
+    assert len(creates) == 11
+    deletes = [e for e in events if e["reason"] == "SuccessfulDeletePod"]
+    assert len(deletes) == 2
+    # never the legacy full restart
+    assert not [e for e in events
+                if e["reason"] == constants.TPU_PREEMPTED_REASON]
+    # exactly one Resizing transition per capacity change: one
+    # ShrinkOnPreemption and one GrowOnCapacity True-transition
+    transitions = []
+    for c in seen_conditions:
+        if c.get("type") != constants.JOB_RESIZING:
+            continue
+        key = (c.get("status"), c.get("reason"),
+               c.get("lastTransitionTime"))
+        if key not in transitions:
+            transitions.append(key)
+    shrinks = [t for t in transitions
+               if t[0] == "True"
+               and t[1] == constants.RESIZE_SHRINK_REASON]
+    grows = [t for t in transitions
+             if t[0] == "True" and t[1] == constants.RESIZE_GROW_REASON]
+    assert len(shrinks) == 1, transitions
+    assert len(grows) == 1, transitions
+    assert ctl.elastic_resizes_counter.labels(
+        direction="shrink").value == 1
+    assert ctl.elastic_resizes_counter.labels(direction="grow").value == 1
+    # budget persisted; preemption-restart budget untouched
+    status = cluster.jobs.get("default", "flap-job")["status"]
+    assert status.get("elasticResizes") == 1
+    assert not status.get("preemptionRestarts")
+    # no expectation leaks
+    for rtype in ("master", "worker"):
+        assert ctl.expectations.satisfied(
+            expectation_pods_key("default/flap-job", rtype))
+        assert ctl.expectations.satisfied(
+            expectation_services_key("default/flap-job", rtype))
+
+
+def _pod_exists(cluster, name) -> bool:
+    from pytorch_operator_tpu.k8s.errors import NotFoundError
+
+    try:
+        cluster.pods.get("default", name)
+        return True
+    except NotFoundError:
+        return False
+
+
+def test_capacity_flap_non_elastic_keeps_full_restart(flap_world):
+    """The same flap against a NON-elastic gang job keeps the PR 2
+    behavior byte-identically: one proactive full-gang restart with
+    reason TPUPreempted, no Resizing machinery anywhere."""
+    cluster, ctl, registry, kubelet = flap_world
+    job = new_job(workers=4, name="rigid-job", tpu_chips=4)
+    cluster.jobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(_running_pods(cluster)) == 5)
+    gen1 = {p["metadata"]["uid"] for p in cluster.pods.list()}
+
+    victim = cluster.pods.get("default", "rigid-job-worker-1")
+    flap = CapacityFlap(kubelet, [victim["spec"]["nodeName"]], grace=0.5)
+    flap.down()
+
+    assert wait_for(
+        lambda: ctl.preemption_gang_restarts_counter.value == 1)
+    assert wait_for(lambda: (
+        len(_running_pods(cluster)) == 5
+        and not gen1 & {p["metadata"]["uid"]
+                        for p in cluster.pods.list()}), timeout=20)
+    flap.restore()
+    _finish(cluster, kubelet)
+    assert wait_for(lambda: job_condition(
+        cluster, "default", "rigid-job", constants.JOB_SUCCEEDED))
+    status = cluster.jobs.get("default", "rigid-job")["status"]
+    assert status.get("preemptionRestarts") == 1
+    assert "desiredReplicas" not in status
+    assert "elasticResizes" not in status
+    assert not [c for c in status.get("conditions", [])
+                if c["type"] == constants.JOB_RESIZING]
+    assert ctl.elastic_resizes_counter.labels(
+        direction="shrink").value == 0
+
+
+# ---------------------------------------------------------------------------
+# Data plane: mesh-shape-flexible state (the reshard acceptance).
+# ---------------------------------------------------------------------------
+
+
+class TestReshard:
+    @pytest.fixture(scope="class")
+    def tiny_world(self):
+        import jax
+        import optax
+
+        from pytorch_operator_tpu.models import llama
+        from pytorch_operator_tpu.parallel import make_mesh, sharded_init
+
+        cfg = llama.tiny(max_seq_len=64, use_flash=False,
+                         use_fused_norm=False, remat=False)
+        opt = optax.adamw(3e-4)
+        devs = jax.devices()
+        mesh4 = make_mesh(1, 4, 1, devices=devs[:4])
+        mesh2 = make_mesh(1, 2, 1, devices=devs[:2])
+        state4 = sharded_init(cfg, mesh4, opt)
+        return cfg, opt, mesh4, mesh2, state4
+
+    @staticmethod
+    def _gathered(tree):
+        import jax
+        import numpy as np
+
+        return [np.asarray(jax.device_get(leaf))
+                for leaf in jax.tree.leaves(tree)]
+
+    def test_params_identical_across_mesh_shapes_and_back(self, tiny_world):
+        """The data-plane acceptance: a 4-device state reshards onto a
+        2-device mesh (and back) with the gathered param tree
+        numerically identical — shrink loses layout, never values."""
+        from pytorch_operator_tpu.parallel import reshard_state
+
+        cfg, opt, mesh4, mesh2, state4 = tiny_world
+        state2 = reshard_state(state4, cfg, mesh2, opt)
+        for a, b in zip(self._gathered(state4), self._gathered(state2)):
+            assert (a == b).all()
+        back = reshard_state(state2, cfg, mesh4, opt)
+        for a, b in zip(self._gathered(state4), self._gathered(back)):
+            assert (a == b).all()
+
+    def test_resharded_state_trains_on_the_new_mesh(self, tiny_world):
+        import numpy as np
+
+        from pytorch_operator_tpu.parallel import (
+            make_train_step,
+            reshard_state,
+        )
+
+        cfg, opt, mesh4, mesh2, state4 = tiny_world
+        state2 = reshard_state(state4, cfg, mesh2, opt)
+        step2 = make_train_step(cfg, mesh2, opt)
+        batch = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 65)).astype(np.int32)
+        state2, metrics = step2(state2, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state2.step) == 1
+
+    def test_sharding_tree_matches_mesh(self, tiny_world):
+        from pytorch_operator_tpu.parallel import state_shardings
+
+        cfg, opt, mesh4, mesh2, _ = tiny_world
+        import jax
+
+        tree2 = state_shardings(cfg, mesh2, opt)
+        for sh in jax.tree.leaves(tree2.params):
+            assert sh.mesh.devices.size == 2
+
+
+def _run_llama(steps: int, device_count: int, extra: list[str]) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={device_count}")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/llama/train_llama.py"),
+         "--model", "tiny", "--batch-size", "4", "--seq-len", "64",
+         "--steps", str(steps), "--no-flash", "--no-fused-norm",
+         "--no-remat", *extra],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_llama_resumes_at_new_world_size(tmp_path):
+    """Run 1 trains and checkpoints on a 4-device mesh; run 2 restores
+    onto a 2-device mesh and continues from the saved step — the
+    elastic checkpoint-resume flow a shrunken gang executes."""
+    ckpt = ["--checkpoint-dir", str(tmp_path / "ckpt"),
+            "--checkpoint-every", "2"]
+    out1 = _run_llama(steps=2, device_count=4, extra=ckpt)
+    assert "checkpointed step 2" in out1
+
+    out2 = _run_llama(steps=4, device_count=2, extra=ckpt)
+    assert "restored checkpoint at step 2 onto 2 device(s)" in out2
+    steps_run = [int(m) for m in re.findall(r"^step (\d+):", out2,
+                                            re.MULTILINE)]
+    assert steps_run and min(steps_run) >= 2, steps_run
+    assert "training complete" in out2

@@ -314,6 +314,74 @@ def _post(port: int, body: bytes, path: str = "/push/v1/metrics"):
     return urllib.request.urlopen(req, timeout=5)
 
 
+class TestPushExemplars:
+    """ISSUE 6 satellite: per-job step exemplars on the push path — a
+    slow step bucket resolves to the pushing job the way reconcile
+    exemplars resolve to traces, and plain scrapes stay byte-identical."""
+
+    def test_pushed_step_carries_job_exemplar_openmetrics_only(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        gw.ingest({"job": "default/slow-job", "samples": [
+            {"name": STEP_DURATION, "op": "observe", "value": 0.7}]})
+        om = registry.expose(openmetrics=True)
+        assert ('pytorch_operator_job_step_duration_seconds_bucket'
+                '{job="default/slow-job",le="1"} 1 '
+                '# {job="default/slow-job"} 0.7') in om
+        # plain text-0.0.4 scrape carries no exemplar syntax at all
+        assert "# {" not in registry.expose()
+
+    def test_plain_scrape_byte_identical_to_exemplar_free_family(self):
+        registry = Registry()
+        gw = PushGateway(registry)
+        for value in (0.02, 0.7, 40.0):
+            gw.ingest({"job": "default/j1", "samples": [
+                {"name": STEP_DURATION, "op": "observe", "value": value}]})
+        # the same observations on a bare vec with no exemplars attached
+        from pytorch_operator_tpu.telemetry.push import _STEP_BUCKETS
+
+        bare_registry = Registry()
+        bare = bare_registry.histogram_vec(
+            STEP_DURATION,
+            "Distribution of one training step's wall time, pushed per "
+            "step by the job",
+            ("job",), buckets=_STEP_BUCKETS)
+        for value in (0.02, 0.7, 40.0):
+            bare.labels(job="default/j1").observe(value)
+        pushed_text = gw._vecs[STEP_DURATION].expose()
+        assert pushed_text == bare.expose()
+
+    def test_push_endpoint_content_negotiation(self):
+        """The PR 4 negotiation contract extended over the push path:
+        plain scrape = text 0.0.4 (no exemplars), OpenMetrics Accept =
+        job exemplars + # EOF + the OM content type."""
+        registry = Registry()
+        gw = PushGateway(registry)
+        server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                      push_gateway=gw)
+        port = server.server_address[1]
+        try:
+            body = json.dumps({"job": "default/j9", "samples": [
+                {"name": STEP_DURATION, "op": "observe", "value": 0.3}]})
+            assert _post(port, body.encode()).status == 200
+            plain = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=5).read().decode()
+            assert "# {" not in plain and "# EOF" not in plain
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "application/openmetrics-text; "
+                                   "version=1.0.0"})
+            resp = urllib.request.urlopen(req, timeout=5)
+            om = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            assert '# {job="default/j9"} 0.3' in om
+            assert om.rstrip().endswith("# EOF")
+        finally:
+            server.shutdown()
+
+
 class TestPushEndpoint:
     def test_post_roundtrip_and_reexport(self):
         registry = Registry()
