@@ -68,6 +68,16 @@ CONTROLLER_NAME = "pytorch-operator"
 # Gang scheduling annotation (reference: pod.go:37).
 GANG_SCHEDULING_POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
 
+# --- Sharded control plane --------------------------------------------------
+# Shard assignment label stamped on a PyTorchJob at admission (consistent
+# hash of namespace/uid modulo --shard-count) and copied onto every child
+# pod/service, so each replica's informers can list+watch with a shard
+# label selector and never deserialize another shard's objects.  The
+# value is the decimal shard index; it never changes for a job's
+# lifetime — rebalancing moves shard OWNERSHIP (per-shard Leases), not
+# job assignments.
+LABEL_SHARD = "pytorch.kubeflow.org/shard"
+
 # --- Rendezvous environment ------------------------------------------------
 # Reference c10d wiring (pod.go:234-281), kept for backend='xla'
 # MASTER_ADDR compatibility in torch_xla workloads:

@@ -197,6 +197,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "one half-open probe through (duration string)")
     p.add_argument("--leader-elect", type=lambda s: s.lower() != "false",
                    default=True, nargs="?", const=True)
+    p.add_argument("--shard-count", type=int, default=1,
+                   help="active-active sharded control plane: jobs hash "
+                        "to this many shards (consistent hash of "
+                        "namespace/uid, stamped as the "
+                        "pytorch.kubeflow.org/shard label at admission), "
+                        "each owned via its own Lease "
+                        "(pytorch-operator-shard-<i>); every replica "
+                        "acquires its fair share and runs shard-filtered "
+                        "informers, so reconcile throughput scales with "
+                        "replicas instead of idling hot standbys.  1 "
+                        "(default) keeps classic leader election")
+    p.add_argument("--replica-id", default="",
+                   help="stable identity for shard Leases and the "
+                        "membership heartbeat (default: hostname + "
+                        "random suffix; set to the pod name in a "
+                        "StatefulSet/Deployment via the downward API)")
     p.add_argument("--fake-cluster", action="store_true",
                    help="run against the in-memory API server + fake kubelet")
     p.add_argument("--fake-cluster-seed-job", default="",
@@ -291,6 +307,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         max_preemption_restarts=args.max_preemption_restarts,
         drain_deadline_seconds=drain_deadline,
         max_elastic_resizes=args.max_elastic_resizes,
+        shard_count=max(1, args.shard_count),
+        replica_id=args.replica_id,
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)
@@ -378,7 +396,20 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         logger.warning("lost leadership, shutting down")
         stop_event.set()
 
-    if args.leader_elect:
+    if config.shard_count > 1:
+        # Active-active sharded control plane: NO leader election —
+        # every replica is live, owning its fair share of shard Leases
+        # (the ShardManager inside the controller handles acquisition,
+        # heartbeat membership and rebalancing).  Readiness reports the
+        # owned shards' informer sync.
+        is_leader_gauge.set(1)
+        leader_state["leading"] = True
+        logger.info(
+            "sharded control plane: %d shards, replica id %s, "
+            "%d workers", config.shard_count,
+            config.replica_id or "(generated)", args.threadiness)
+        controller.run(threadiness=args.threadiness, stop_event=stop_event)
+    elif args.leader_elect:
         identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
         elector = LeaderElector(
             cluster.resource("leases"), identity,
@@ -397,7 +428,7 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         pass
     finally:
         stop_event.set()
-        controller.work_queue.shutdown()
+        controller.shutdown()
         if metrics_server:
             metrics_server.shutdown()
         if kubelet is not None:

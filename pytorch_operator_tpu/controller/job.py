@@ -110,7 +110,8 @@ class JobLifecycleMixin:
         if old_ads is None or old_ads != new_ads:
             start = parse_time(new_job.status.start_time) or time.time()
             passed = time.time() - start
-            self.work_queue.add_after(new_job.key, new_ads - passed)
+            self._queue_for_key(new_job.key).add_after(
+                new_job.key, new_ads - passed)
             logger_for_job(self.logger, new_job).info(
                 "job ActiveDeadlineSeconds updated, will rsync after %s seconds",
                 new_ads - passed,
@@ -186,7 +187,7 @@ class JobLifecycleMixin:
                     "Cleanup PyTorchJob error: %s", e)
                 raise
             return
-        self.work_queue.add_after(job.key, remaining)
+        self._queue_for_key(job.key).add_after(job.key, remaining)
 
     def _delete_job(self, job: PyTorchJob) -> None:
         try:

@@ -223,6 +223,13 @@ class PodReconcilerMixin:
         labels[constants.LABEL_REPLICA_INDEX] = index
         if master_role:
             labels[constants.LABEL_JOB_ROLE] = "master"
+        # sharded control plane: children inherit the job's shard label
+        # so the owning replica's shard-filtered pod informer sees them
+        # (absent on unsharded operators — existing pods byte-identical)
+        shard = ((job_dict.get("metadata") or {}).get("labels")
+                 or {}).get(constants.LABEL_SHARD)
+        if shard is not None:
+            labels[constants.LABEL_SHARD] = shard
 
         template = serde.to_dict(spec.template)
         pod = {

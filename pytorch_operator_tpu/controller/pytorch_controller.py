@@ -21,7 +21,12 @@ from ..api.v1.types import PyTorchJob
 from ..api.v1.validation import ValidationError, validate_spec
 from ..disruption.handler import DisruptionHandlingMixin
 from ..k8s import serde
-from ..k8s.errors import CircuitOpenError, ConflictError, NotFoundError
+from ..k8s.errors import (
+    ApiError,
+    CircuitOpenError,
+    ConflictError,
+    NotFoundError,
+)
 from ..k8s.resilience import RetryPolicy
 from ..metrics import default_registry
 from ..runtime.expectations import (
@@ -33,6 +38,8 @@ from ..runtime.informer import Informer, split_meta_namespace_key
 from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+from ..runtime.sharding import ShardManager, shard_of, sharded_source
+from ..runtime.workqueue import WorkQueue, WorkQueueMetrics
 from . import status as status_machine
 from .job import (
     JobLifecycleMixin,
@@ -129,6 +136,43 @@ class PyTorchController(
         # Disruption subsystem (metrics always registered; the watcher
         # only when --enable-disruption-handling built a node informer).
         self.init_disruption_handling(registry)
+        # Active-active sharded control plane (--shard-count > 1): no
+        # leader election — every replica owns as many shard Leases as
+        # fairness allows and runs informers + a workqueue per owned
+        # shard.  The global job/pod/service informers above are never
+        # STARTED in sharded mode; the admission informer (all jobs, no
+        # selector) only stamps the shard label on new jobs whose hash
+        # lands in an owned shard and never enqueues.
+        self.shard_manager = None
+        self._admission_informer = None
+        self._stop_event = None
+        self._shard_workers = 1
+        if self.config.shard_count > 1:
+            import uuid as _uuid
+
+            self.replica_id = (self.config.replica_id
+                               or f"replica-{_uuid.uuid4().hex[:8]}")
+            registry.gauge(
+                "pytorch_operator_owned_shards",
+                "Shard Leases this replica currently holds "
+                "(sums to --shard-count across live replicas)",
+            ).set_function(lambda: len(self.owned_shards()))
+            self._shard_jobs_gauge = registry.gauge_vec(
+                "pytorch_operator_shard_jobs",
+                "PyTorchJobs in this replica's per-shard informer cache "
+                "(0 for shards it does not own)",
+                ("shard",))
+            self._admission_informer = Informer(cluster.jobs)
+            self._admission_informer.add_event_handler(
+                on_add=self._admit_job,
+                on_update=lambda _old, new: self._admit_job(new))
+            self.shard_manager = ShardManager(
+                cluster.resource("leases"), self.replica_id,
+                self.config.shard_count,
+                lease_duration=self.config.shard_lease_duration,
+                renew_interval=self.config.shard_renew_interval,
+                on_acquired=self._on_shard_acquired,
+                on_released=self._on_shard_released)
         # Handlers are attributes so tier-2 tests can stub the status write
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
@@ -145,7 +189,8 @@ class PyTorchController(
         return self.config.tpu_auto_gang and job_requests_tpu(job)
 
     # -- plumbing ----------------------------------------------------------
-    def _coalesce_job_event(self, key: str, old: dict, new: dict) -> bool:
+    def _coalesce_job_event(self, key: str, old: dict, new: dict,
+                            queue=None) -> bool:
         """Informer burst coalescing for the job informer: a MODIFIED
         event for a key that is already dirty in the workqueue updates
         the store but skips the handler dispatch — the pending sync reads
@@ -153,13 +198,123 @@ class PyTorchController(
         queue would dedup anyway.  Events that change .spec or the
         deletionTimestamp are never coalesced: update_job reschedules the
         ActiveDeadlineSeconds wake-up on spec changes, and that timer
-        must not be lost to a burst."""
+        must not be lost to a burst.  ``queue`` is the shard queue when
+        a per-shard informer consults the hook."""
         if old.get("spec") != new.get("spec"):
             return False
         if (old.get("metadata") or {}).get("deletionTimestamp") != (
                 (new.get("metadata") or {}).get("deletionTimestamp")):
             return False
-        return self.work_queue.is_dirty(key)
+        return (queue or self.work_queue).is_dirty(key)
+
+    # -- sharding ----------------------------------------------------------
+    def owned_shards(self):
+        if self.shard_manager is None:
+            return set()
+        return self.shard_manager.owned_shards()
+
+    def _admit_job(self, obj: dict) -> None:
+        """Admission stamping: a job without a shard label is assigned
+        ``shard_of(namespace, uid)`` — by the replica that OWNS that
+        shard (every replica computes the same index, so exactly one
+        stamps; a lost race is a no-op merge patch).  The label then
+        routes the job into the owner's shard-filtered informers, which
+        is where reconciliation begins."""
+        meta = obj.get("metadata") or {}
+        if constants.LABEL_SHARD in (meta.get("labels") or {}):
+            return
+        shard = shard_of(meta.get("namespace", "default"),
+                         meta.get("uid", ""), self.config.shard_count)
+        if shard not in self.owned_shards():
+            return
+        try:
+            self.cluster.jobs.patch(
+                meta.get("namespace", "default"), meta.get("name", ""),
+                {"metadata": {"labels": {constants.LABEL_SHARD:
+                                         str(shard)}}})
+        except ApiError:
+            return  # job gone / apiserver blip: the next event retries
+        self._stamp_existing_children(meta, shard)
+
+    def _stamp_existing_children(self, job_meta: dict, shard: int) -> None:
+        """Migration path: a job admitted BEFORE sharding was enabled
+        (or before this shard had an owner) already has unsharded
+        children, which the shard-filtered pod/service informers would
+        never see — their status transitions would stop re-enqueuing
+        the job.  Stamp the shard label onto every existing child once,
+        at job-stamp time (new children inherit it at creation; for
+        freshly admitted jobs this LIST finds nothing)."""
+        namespace = job_meta.get("namespace", "default")
+        selector = self.gen_labels(job_meta.get("name", ""))
+        patch = {"metadata": {"labels": {constants.LABEL_SHARD:
+                                         str(shard)}}}
+        for client in (self.cluster.pods, self.cluster.services):
+            try:
+                children = client.list(namespace=namespace,
+                                       label_selector=selector)
+            except ApiError:
+                continue
+            for child in children:
+                child_meta = child.get("metadata") or {}
+                if constants.LABEL_SHARD in (child_meta.get("labels")
+                                             or {}):
+                    continue
+                try:
+                    client.patch(namespace, child_meta.get("name", ""),
+                                 patch)
+                except ApiError:
+                    pass  # child raced deletion / blip: resync heals
+
+    def _stamp_pending_jobs(self, shard: int) -> None:
+        """Label sweep on shard acquisition: jobs admitted while the
+        shard had no owner (or whose owner died before stamping) are in
+        the admission informer's store unlabeled — stamp the ones that
+        hash here."""
+        informer = self._admission_informer
+        if informer is None:
+            return
+        for obj in informer.store.list():
+            meta = obj.get("metadata") or {}
+            if constants.LABEL_SHARD in (meta.get("labels") or {}):
+                continue
+            if shard_of(meta.get("namespace", "default"),
+                        meta.get("uid", ""),
+                        self.config.shard_count) == shard:
+                self._admit_job(obj)
+
+    def _on_shard_acquired(self, shard: int) -> None:
+        runtime = _ShardRuntime(self, shard, workers=self._shard_workers)
+        with self._shard_lock:
+            self._shard_runtimes[shard] = runtime
+        # registered BEFORE informers start: the very first ADDED must
+        # already route into this shard's queue
+        runtime.start(self._stop_event or threading.Event())
+        self._shard_jobs_gauge.labels(shard=str(shard)).set_function(
+            lambda s=shard: self._shard_store_size(s))
+        self.logger.info("replica %s acquired shard %d",
+                         self.replica_id, shard)
+        self._stamp_pending_jobs(shard)
+        # disruptions that struck while this shard had NO owner were
+        # dropped by every replica's ownership gate — replay current
+        # node state so the newly-owned jobs get their proactive
+        # restart (live-resolved, so already-handled gangs don't match)
+        if self.disruption_watcher is not None:
+            self.disruption_watcher.replay_flagged()
+
+    def _on_shard_released(self, shard: int) -> None:
+        with self._shard_lock:
+            runtime = self._shard_runtimes.pop(shard, None)
+        if runtime is not None:
+            runtime.stop()
+            self.logger.info("replica %s released shard %d",
+                             self.replica_id, shard)
+
+    def _shard_store_size(self, shard: int) -> int:
+        with self._shard_lock:
+            runtime = self._shard_runtimes.get(shard)
+        if runtime is None:
+            return 0
+        return len(runtime.job_informer.store.keys())
 
     def _job_from_unstructured(self, obj: dict) -> PyTorchJob:
         """informer.go:83-104: convert + validate."""
@@ -168,7 +323,14 @@ class PyTorchController(
         return job
 
     def _get_job_from_cache(self, namespace: str, name: str) -> Optional[dict]:
-        return self.job_informer.store.get_by_key(f"{namespace}/{name}")
+        key = f"{namespace}/{name}"
+        obj = self.job_informer.store.get_by_key(key)
+        if obj is None:
+            for runtime in self._shard_runtime_snapshot():
+                obj = runtime.job_informer.store.get_by_key(key)
+                if obj is not None:
+                    break
+        return obj
 
     def _job_deleted(self, obj: dict) -> None:
         # Clear the dead incarnation's expectations HERE, in the DELETED
@@ -287,7 +449,17 @@ class PyTorchController(
         """True once every informer completed its initial LIST — the
         readiness condition /readyz reports (a controller reconciling
         from an unsynced cache would delete pods it simply hasn't seen
-        yet)."""
+        yet).  Sharded: the admission informer plus every OWNED shard's
+        informer set (a replica owning nothing is vacuously synced)."""
+        if self.shard_manager is not None:
+            informers = []
+            if self._admission_informer is not None:
+                informers.append(self._admission_informer)
+            if self.node_informer is not None:
+                informers.append(self.node_informer)
+            return (all(i.has_synced() for i in informers)
+                    and all(rt.synced()
+                            for rt in self._shard_runtime_snapshot()))
         informers = [self.job_informer, self.pod_informer,
                      self.service_informer]
         if self.node_informer is not None:
@@ -295,8 +467,22 @@ class PyTorchController(
         return all(i.has_synced() for i in informers)
 
     def run(self, threadiness: int = 1, stop_event: Optional[threading.Event] = None):
-        """controller.go:185-213."""
+        """controller.go:185-213.  Sharded mode starts the admission
+        informer + shard manager instead of the global informers and
+        worker pool; each acquired shard brings its own informers,
+        workqueue and workers (``ceil(threadiness / shard_count)``
+        each, so a replica owning every shard fields ~threadiness
+        workers total)."""
         stop_event = stop_event or threading.Event()
+        if self.shard_manager is not None:
+            self._stop_event = stop_event
+            self._shard_workers = max(
+                1, -(-threadiness // self.config.shard_count))
+            self._admission_informer.start()
+            if self.node_informer is not None:
+                self.node_informer.start()
+            self.shard_manager.start(stop_event)
+            return []
         self.start_informers()
         workers = []
         for _ in range(threadiness):
@@ -310,9 +496,13 @@ class PyTorchController(
             if not self.process_next_work_item(timeout=0.5):
                 return
 
-    def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
-        """controller.go:222-274."""
-        key, shutdown = self.work_queue.get(timeout=timeout)
+    def process_next_work_item(self, timeout: Optional[float] = None,
+                               queue=None) -> bool:
+        """controller.go:222-274.  ``queue`` selects a shard's
+        workqueue (sharded workers pass their own); default is the
+        controller-wide queue."""
+        queue = queue if queue is not None else self.work_queue
+        key, shutdown = queue.get(timeout=timeout)
         if shutdown:
             return False
         if key is None:
@@ -331,7 +521,7 @@ class PyTorchController(
                 time.monotonic() - start,
                 exemplar={"trace_id": tspan.trace_id})
             if err is None and forget:
-                self.work_queue.forget(key)
+                queue.forget(key)
             elif isinstance(err, CircuitOpenError):
                 # the apiserver breaker is open: pace this key at the
                 # breaker's half-open cadence instead of rate-limited —
@@ -341,15 +531,15 @@ class PyTorchController(
                 logger_for_key(self.logger, key).warning(
                     "apiserver circuit open; requeueing %s in %.2fs",
                     key, err.retry_in or 1.0)
-                self.work_queue.forget(key)
-                self.work_queue.add_after(key, max(0.05, err.retry_in
-                                                   or 1.0))
+                queue.forget(key)
+                queue.add_after(key, max(0.05, err.retry_in
+                                         or 1.0))
             elif err is not None:
                 logger_for_key(self.logger, key).warning(
                     "reconcile error for %s: %s", key, err)
-                self.work_queue.add_rate_limited(key)
+                queue.add_rate_limited(key)
         finally:
-            self.work_queue.done(key)
+            queue.done(key)
         return True
 
     # -- sync --------------------------------------------------------------
@@ -492,7 +682,7 @@ class PyTorchController(
                 self.update_status_handler(job)
             return
 
-        previous_retry = self.work_queue.num_requeues(job_key)
+        previous_retry = self._queue_for_key(job_key).num_requeues(job_key)
         active = sum(
             1
             for p in pods
@@ -586,7 +776,8 @@ class PyTorchController(
                     "Job with ActiveDeadlineSeconds will sync after %s seconds",
                     job.spec.active_deadline_seconds,
                 )
-                self.work_queue.add_after(job.key, job.spec.active_deadline_seconds)
+                self._queue_for_key(job.key).add_after(
+                    job.key, job.spec.active_deadline_seconds)
 
         if constants.REPLICA_TYPE_MASTER not in job.spec.pytorch_replica_specs:
             raise ValueError("invalid config: Job must contain master replica spec")
@@ -669,3 +860,80 @@ class PyTorchController(
         if start is None:
             return False
         return time.time() - start >= job.spec.active_deadline_seconds
+
+
+class _ShardRuntime:
+    """Everything one OWNED shard needs on this replica: a job/pod/
+    service informer trio whose list+watch is confined to the shard's
+    label selector (a FRESH ListWatch per acquisition — the handoff
+    fence: live lists precede any create, so a rebalance mid-churn
+    cannot double-create), its own workqueue (client-go metric families
+    labeled ``pytorchjob-shard<i>``), and its worker threads.  Built by
+    ``PyTorchController._on_shard_acquired`` from the shard manager's
+    tick thread; torn down on release/shutdown."""
+
+    def __init__(self, controller: PyTorchController, shard: int,
+                 workers: int = 1):
+        self.shard = shard
+        self.controller = controller
+        self.queue = WorkQueue()
+        self.queue.set_metrics(WorkQueueMetrics(
+            controller.registry, f"pytorchjob-shard{shard}"))
+        cluster = controller.cluster
+        self._sources = [sharded_source(cluster, plural, shard)
+                         for plural in ("pytorchjobs", "pods", "services")]
+        jobs_src, pods_src, services_src = self._sources
+        self.job_informer = Informer(
+            jobs_src,
+            coalesce=lambda key, old, new:
+                controller._coalesce_job_event(key, old, new,
+                                               queue=self.queue))
+        self.job_informer.add_event_handler(
+            on_add=controller.add_job, on_update=controller.update_job,
+            on_delete=controller._job_deleted)
+        self.pod_informer = Informer(pods_src)
+        self.pod_informer.add_event_handler(
+            on_add=controller.add_pod, on_update=controller.update_pod,
+            on_delete=controller.delete_pod)
+        self.service_informer = Informer(services_src)
+        self.service_informer.add_event_handler(
+            on_add=controller.add_service,
+            on_delete=controller.delete_service)
+        self.workers = max(1, int(workers))
+        self._threads: List[threading.Thread] = []
+
+    def start(self, stop_event: threading.Event) -> None:
+        for informer in (self.job_informer, self.pod_informer,
+                         self.service_informer):
+            informer.start()
+        for n in range(self.workers):
+            t = threading.Thread(
+                target=self._work, args=(stop_event,), daemon=True,
+                name=f"shard{self.shard}-worker{n}")
+            t.start()
+            self._threads.append(t)
+
+    def _work(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            if not self.controller.process_next_work_item(
+                    timeout=0.5, queue=self.queue):
+                return
+
+    def synced(self) -> bool:
+        return all(i.has_synced() for i in (
+            self.job_informer, self.pod_informer, self.service_informer))
+
+    def stop(self) -> None:
+        for informer in (self.job_informer, self.pod_informer,
+                         self.service_informer):
+            informer.stop()
+        release = getattr(self.controller.cluster, "release_filtered",
+                          None)
+        for source in self._sources:
+            if release is not None:
+                release(source)  # stop watch AND drop the tracking ref
+            else:
+                stop_watch = getattr(source, "stop_watch", None)
+                if stop_watch is not None:
+                    stop_watch()
+        self.queue.shutdown()

@@ -94,13 +94,23 @@ class ServiceReconcilerMixin:
         labels[constants.LABEL_REPLICA_TYPE] = rt
         labels[constants.LABEL_REPLICA_INDEX] = index
 
+        # sharded control plane: the service's METADATA carries the
+        # job's shard label (so shard-filtered informers see it); the
+        # pod selector stays shard-free — it already names exactly one
+        # replica, and widening it would strand pods created before the
+        # job was stamped
+        metadata_labels = dict(labels)
+        shard = (job.metadata.labels or {}).get(constants.LABEL_SHARD)
+        if shard is not None:
+            metadata_labels[constants.LABEL_SHARD] = shard
+
         port = get_port_from_job(job, constants.REPLICA_TYPE_MASTER)
         return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {
                 "name": gen_general_name(job.metadata.name, rt, index),
-                "labels": dict(labels),
+                "labels": metadata_labels,
             },
             "spec": {
                 "clusterIP": "None",

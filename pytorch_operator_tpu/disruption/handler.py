@@ -120,8 +120,13 @@ class DisruptionHandlingMixin:
                 self.node_informer is not None:
             # nodeName index over the pod informer (ROADMAP scalability
             # item): a disrupted node resolves its pods in one dict hit
-            # instead of a cluster-wide LIST per node event
-            pod_index = PodNodeIndex(self.pod_informer)
+            # instead of a cluster-wide LIST per node event.  Sharded
+            # replicas never START the global pod informer (each shard
+            # runs its own filtered one), so an index over it would be
+            # permanently empty and silently hide every disruption —
+            # they fall back to the cluster-wide LIST instead.
+            pod_index = (PodNodeIndex(self.pod_informer)
+                         if self.config.shard_count <= 1 else None)
             self.disruption_watcher = DisruptionWatcher(
                 self.cluster, self.node_informer,
                 self._note_node_disruption, kind=self.KIND,
@@ -145,7 +150,18 @@ class DisruptionHandlingMixin:
         fences the note to the job incarnation it was observed against:
         a delete-recreate under the same key drops it at sync time.
         ``node``/``pod`` scope the doomed set for the elastic drain path
-        (unscoped notes always take the legacy full-gang restart)."""
+        (unscoped notes always take the legacy full-gang restart).
+
+        Sharded mode: the node watcher is global (nodes are not
+        sharded), so every replica sees every disruption — but only the
+        replica OWNING the job may note it (a sharded replica owning
+        zero shards owns zero jobs).  Without this gate the non-owners
+        would overcount the detection metric N-fold, park the key (plus
+        its note) on their workerless global queue, and replay the
+        stale note as a second gang restart if they later acquire the
+        job's shard."""
+        if not self._owns_job_key(job_key):
+            return
         with self._disruption_lock:
             existing = self._pending_disruptions.get(job_key)
             if existing is not None:
@@ -171,7 +187,7 @@ class DisruptionHandlingMixin:
                 "detected_at": time.monotonic(),
             }
         self.preemptions_detected_counter.inc()
-        self.work_queue.add(job_key)
+        self._queue_for_key(job_key).add(job_key)
 
     def _note_node_disruption(self, job_key: str, reason: str,
                               node_name: str,
@@ -452,7 +468,7 @@ class DisruptionHandlingMixin:
         self.elastic_resizes_counter.labels(direction="shrink").inc()
         drain["message"] = msg
         # wake the sync at the deadline even if no ack ever arrives
-        self.work_queue.add_after(key, deadline)
+        self._queue_for_key(key).add_after(key, deadline)
         return True
 
     def _merge_into_drain(self, job: PyTorchJob, job_dict: dict,
@@ -645,7 +661,7 @@ class DisruptionHandlingMixin:
         if pending and now < drain["deadline"]:
             # keep the sync warm without busy-looping: re-check soon,
             # and the ack patches themselves also enqueue the job
-            self.work_queue.add_after(
+            self._queue_for_key(key).add_after(
                 key, max(0.02, min(0.25, drain["deadline"] - now)))
             return True
         if pending:
@@ -857,7 +873,7 @@ class DisruptionHandlingMixin:
                 self._pending_grows.setdefault(
                     key, {"node": node_name, "uid": uid})
         for key in shrunken:
-            self.work_queue.add(key)
+            self._queue_for_key(key).add(key)
 
     def _release_grow_claim(self, key: str) -> None:
         """Release a grow's capacity reservation and — if one was

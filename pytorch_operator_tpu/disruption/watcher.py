@@ -245,6 +245,22 @@ class DisruptionWatcher:
             self._flagged[name] = reason
         self._fire(name, reason)
 
+    def replay_flagged(self) -> None:
+        """Re-fire the callback for every node still flagged disrupted.
+
+        Sharded handoff path: a disruption that struck while a shard had
+        no owner was dropped by every replica's ownership gate (the
+        node watcher fires once per transition, so nobody re-sees it).
+        The replica ACQUIRING a shard replays current node state so
+        those jobs get their proactive restart after all.  Safe against
+        double-restarts: affected jobs are resolved LIVE, so a gang the
+        previous owner already restarted has no pods left on the
+        disrupted node and simply does not match."""
+        with self._lock:
+            flagged = dict(self._flagged)
+        for name, reason in flagged.items():
+            self._fire(name, reason)
+
     def _fire(self, node_name: str, reason: str) -> None:
         fired = 0
         for job_key, uid in self._affected_jobs(node_name):

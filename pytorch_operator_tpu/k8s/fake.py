@@ -19,6 +19,7 @@ import copy
 import threading
 import time
 import uuid
+from collections import deque, namedtuple
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .errors import AlreadyExistsError, ConflictError, InvalidError, NotFoundError
@@ -29,6 +30,17 @@ WatchEvent = Tuple[str, dict]  # ("ADDED"|"MODIFIED"|"DELETED", object)
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+#: One relist answer (``FakeResourceStore.list_changes`` /
+#: ``RestResourceStore.list_changes``): ``windowed=True`` means *items*
+#: holds only the objects changed since the requested resourceVersion
+#: and *deleted* the objects removed since it (a delta the informer
+#: applies over its store); ``windowed=False`` is a plain full LIST
+#: (the requested RV fell out of the watch-cache window, or none was
+#: given).  ``resource_version`` is the listing's high-water mark —
+#: the RV the next delta request should pass.
+ListChanges = namedtuple(
+    "ListChanges", ("windowed", "items", "deleted", "resource_version"))
 
 
 def _now_iso() -> str:
@@ -50,14 +62,82 @@ class FakeResourceStore:
         self.kind = kind
         self._objects: Dict[Tuple[str, str], dict] = {}
         self._listeners: List[Callable[[str, dict], None]] = []
+        # Watch cache (ROADMAP direction 2, first slice): a bounded
+        # window of recent mutations so a LIST carrying the caller's
+        # last-seen resourceVersion can be answered as a DELTA instead
+        # of the full collection.  Entries are (rv, event_type, obj);
+        # _cache_floor is the highest rv already evicted — a request
+        # below it cannot be answered from the window.
+        self._watch_cache: deque = deque()
+        self._cache_floor = 0
 
     # -- internal helpers --------------------------------------------------
     def _key(self, namespace: str, name: str) -> Tuple[str, str]:
         return (namespace or "default", name)
 
     def _notify(self, event_type: str, obj: dict) -> None:
+        self._record_event(event_type, obj)
         for listener in list(self._listeners):
             listener(event_type, copy.deepcopy(obj))
+
+    def _record_event(self, event_type: str, obj: dict) -> None:
+        # called with the cluster lock held (every mutation notifies
+        # under it), so the window and floor advance atomically
+        try:
+            rv = int((obj.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            return
+        # stored BY REFERENCE, deliberately: every store mutation
+        # REPLACES the stored dict (update/patch/set_status build a new
+        # object; GC below is copy-on-write), so a cached reference is
+        # immutable once recorded — a deepcopy per mutation here would
+        # tax every fake-cluster test in the suite.  changes_since
+        # deep-copies on the way OUT.
+        self._watch_cache.append((rv, event_type, obj))
+        window = self._cluster.watch_cache_window
+        while len(self._watch_cache) > window:
+            evicted_rv, _, _ = self._watch_cache.popleft()
+            self._cache_floor = max(self._cache_floor, evicted_rv)
+
+    # -- windowed relist ---------------------------------------------------
+    def changes_since(self, resource_version) -> Optional[tuple]:
+        """``(changed_objects, deleted_objects, current_rv)`` covering
+        everything after ``resource_version``, or None when the RV has
+        fallen out of the watch-cache window (caller must full-LIST).
+        Each key appears at most once, at its latest state — a delete
+        followed by a recreate shows up as a change, not both."""
+        try:
+            rv = int(resource_version)
+        except (TypeError, ValueError):
+            return None
+        with self._cluster.lock:
+            if rv < self._cache_floor:
+                return None
+            latest: Dict[Tuple[str, str], Tuple[str, dict]] = {}
+            for event_rv, event_type, obj in self._watch_cache:
+                if event_rv <= rv:
+                    continue
+                meta = obj.get("metadata") or {}
+                key = (meta.get("namespace", "default"),
+                       meta.get("name", ""))
+                latest[key] = (event_type, obj)
+            changed = [copy.deepcopy(obj) for et, obj in latest.values()
+                       if et != DELETED]
+            deleted = [copy.deepcopy(obj) for et, obj in latest.values()
+                       if et == DELETED]
+            return changed, deleted, self._cluster.current_rv()
+
+    def list_changes(self, since_rv) -> ListChanges:
+        """Informer-facing relist: a windowed delta when ``since_rv``
+        is still inside the watch cache, a full LIST (with the fresh
+        high-water RV) otherwise."""
+        delta = self.changes_since(since_rv)
+        if delta is not None:
+            changed, deleted, rv = delta
+            return ListChanges(True, changed, deleted, rv)
+        with self._cluster.lock:
+            rv = self._cluster.current_rv()
+        return ListChanges(False, self.list(), [], rv)
 
     # -- watch -------------------------------------------------------------
     def add_listener(self, fn: Callable[[str, dict], None]) -> None:
@@ -187,6 +267,11 @@ class FakeResourceStore:
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f'{self.kind} "{name}" not found')
+            # a real apiserver mints a fresh resourceVersion for the
+            # DELETED watch event; without it the watch cache could not
+            # place the delete after the object's last modification and
+            # windowed relists would silently resurrect deleted objects
+            obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
             self._notify(DELETED, obj)
         self._cluster._collect_garbage(obj)
 
@@ -239,9 +324,12 @@ class FakeCluster:
         "nodes": "Node",
     }
 
-    def __init__(self, fault_plan=None):
+    def __init__(self, fault_plan=None, watch_cache_window: int = 2048):
         self.lock = threading.RLock()
         self._rv = 0
+        # per-store watch-cache depth (see FakeResourceStore.changes_since):
+        # how many recent mutations stay answerable as a windowed relist
+        self.watch_cache_window = max(0, int(watch_cache_window))
         # k8s/faults.FaultPlan (assignable after construction): CRUD
         # calls consult it and raise the classified transient errors —
         # the sim tier's apiserver chaos.  "after" faults and watch
@@ -254,6 +342,11 @@ class FakeCluster:
 
     def next_rv(self) -> int:
         self._rv += 1
+        return self._rv
+
+    def current_rv(self) -> int:
+        """The cluster-wide resourceVersion high-water mark (RVs are a
+        single monotonic sequence, as on a real apiserver)."""
         return self._rv
 
     def maybe_fault(self, verb: str, resource: str) -> None:
@@ -333,7 +426,7 @@ class FakeCluster:
         for store in self.stores.values():
             doomed: List[Tuple[str, str]] = []
             with self.lock:
-                for (ns, name), obj in store._objects.items():
+                for (ns, name), obj in list(store._objects.items()):
                     meta = obj.get("metadata") or {}
                     refs = meta.get("ownerReferences") or []
                     if not any(r.get("uid") == owner_uid for r in refs):
@@ -342,8 +435,14 @@ class FakeCluster:
                     # object is only deleted once no owners remain.
                     remaining = [r for r in refs if r.get("uid") != owner_uid]
                     if remaining:
-                        meta["ownerReferences"] = remaining
-                        meta["resourceVersion"] = str(self.next_rv())
+                        # copy-on-write, never in place: past versions of
+                        # a stored object may be referenced by the watch
+                        # cache, which must keep the state AT its event
+                        new_obj = copy.deepcopy(obj)
+                        new_obj["metadata"]["ownerReferences"] = remaining
+                        new_obj["metadata"]["resourceVersion"] = str(
+                            self.next_rv())
+                        store._objects[(ns, name)] = new_obj
                     else:
                         doomed.append((ns, name))
             for ns, name in doomed:

@@ -345,10 +345,43 @@ class ResilienceMetrics:
             self.throttle_wait.observe(seconds)
 
 
-def build(config: Optional[ResilienceConfig], registry=None):
+#: process-wide breaker registry keyed by (endpoint, threshold, reset):
+#: every client of one apiserver endpoint shares one breaker — the
+#: endpoint being down is a fact about the ENDPOINT, so it should trip
+#: once per process, not once per RestCluster — while clients of other
+#: endpoints (a multi-cluster operator, the sharded bench's N replicas
+#: if ever pointed at N servers) cannot trip each other.  The config
+#: knobs are part of the key so a test with a different threshold never
+#: inherits another test's breaker state.
+_endpoint_breakers: dict = {}
+_endpoint_breakers_lock = threading.Lock()
+
+
+def breaker_for_endpoint(endpoint: str, threshold: int,
+                         reset_timeout: float) -> CircuitBreaker:
+    key = (endpoint, int(threshold), float(reset_timeout))
+    with _endpoint_breakers_lock:
+        breaker = _endpoint_breakers.get(key)
+        if breaker is None:
+            breaker = CircuitBreaker(threshold, reset_timeout)
+            _endpoint_breakers[key] = breaker
+        return breaker
+
+
+def reset_endpoint_breakers() -> None:
+    """Drop every shared per-endpoint breaker (test isolation hook)."""
+    with _endpoint_breakers_lock:
+        _endpoint_breakers.clear()
+
+
+def build(config: Optional[ResilienceConfig], registry=None,
+          endpoint: Optional[str] = None):
     """(retry_policy, rate_limiter, breaker, metrics) for one client —
     each piece independently None when its knob disables it.  ``None``
-    config means 'all defaults' (retries + breaker on, limiter off)."""
+    config means 'all defaults' (retries + breaker on, limiter off).
+    ``endpoint`` (``host:port``) keys the breaker into the process-wide
+    per-endpoint registry; without it the breaker is private to the
+    caller (the pre-PR-7 behavior, kept for direct construction)."""
     config = config or ResilienceConfig()
     policy = None
     if config.max_attempts > 1:
@@ -359,9 +392,14 @@ def build(config: Optional[ResilienceConfig], registry=None):
             deadline=config.deadline)
     limiter = TokenBucket(config.qps, config.burst) \
         if config.qps > 0 else None
-    breaker = CircuitBreaker(config.breaker_threshold,
-                             config.breaker_reset) \
-        if config.breaker_threshold > 0 else None
+    breaker = None
+    if config.breaker_threshold > 0:
+        if endpoint is not None:
+            breaker = breaker_for_endpoint(
+                endpoint, config.breaker_threshold, config.breaker_reset)
+        else:
+            breaker = CircuitBreaker(config.breaker_threshold,
+                                     config.breaker_reset)
     metrics = ResilienceMetrics(registry, breaker) \
         if registry is not None else None
     return policy, limiter, breaker, metrics
@@ -374,5 +412,7 @@ __all__ = [
     "ResilienceMetrics",
     "RetryPolicy",
     "TokenBucket",
+    "breaker_for_endpoint",
     "build",
+    "reset_endpoint_breakers",
 ]

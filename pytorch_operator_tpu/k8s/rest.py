@@ -480,7 +480,8 @@ class RestResourceStore:
     """One resource collection over REST; FakeResourceStore-compatible."""
 
     def __init__(self, cluster: "RestCluster", plural: str,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 label_selector: Optional[Dict[str, str]] = None):
         self._cluster = cluster
         self._client = cluster.client
         self.kind = plural
@@ -492,6 +493,12 @@ class RestResourceStore:
         # namespace-scoped mode: all lists/watches confined to one
         # namespace (operator --namespace flag; required for Role-only RBAC)
         self._namespace = namespace or None
+        # selector-scoped mode (RestCluster.filtered): the selector rides
+        # the list AND watch query strings, so the apiserver filters
+        # server-side — a sharded replica's informers never deserialize
+        # another shard's objects
+        self._label_selector = dict(label_selector) if label_selector \
+            else None
         self._listeners: List[Callable[[str, dict], None]] = []
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
@@ -538,16 +545,52 @@ class RestResourceStore:
             return self._client.request(
                 "GET", self._path(namespace or "default", name))
 
+    def _effective_selector(
+            self, label_selector: Optional[Dict[str, str]]
+    ) -> Optional[Dict[str, str]]:
+        if self._label_selector is None:
+            return label_selector
+        merged = dict(self._label_selector)
+        if label_selector:
+            merged.update(label_selector)
+        return merged
+
     def list(self, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[dict]:
         q = ""
-        sel = _selector_query(label_selector)
+        sel = _selector_query(self._effective_selector(label_selector))
         if sel:
             q = f"labelSelector={sel}"
         with self._timed("list"):
             res = self._client.request(
                 "GET", self._path(namespace or self._namespace, query=q))
         return res.get("items", [])
+
+    def list_changes(self, since_rv):
+        """Windowed relist: a LIST carrying our last-applied
+        resourceVersion.  A watch-cache-aware server (the stub; see
+        StubApiServer._windowed_list) answers with only the objects
+        changed/deleted since that RV (``windowed`` True); anything else
+        — a real kube-apiserver, or an RV that fell out of the window —
+        comes back as the full collection.  Either way the informer gets
+        one :class:`~pytorch_operator_tpu.k8s.fake.ListChanges` to apply."""
+        from .fake import ListChanges
+
+        parts = [f"resourceVersion={since_rv}"]
+        sel = _selector_query(self._effective_selector(None))
+        if sel:
+            parts.append(f"labelSelector={sel}")
+        with self._timed("list"):
+            res = self._client.request(
+                "GET", self._path(self._namespace, query="&".join(parts)))
+        try:
+            rv = int((res.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            rv = None
+        if res.get("windowed"):
+            return ListChanges(True, res.get("items", []),
+                               res.get("deleted", []), rv)
+        return ListChanges(False, res.get("items", []), [], rv)
 
     def update(self, obj: dict, subresource: Optional[str] = None) -> dict:
         meta = obj.get("metadata") or {}
@@ -642,6 +685,9 @@ class RestResourceStore:
 
     def _watch_once(self, rv: str) -> str:
         q = "watch=true&allowWatchBookmarks=true"
+        sel = _selector_query(self._effective_selector(None))
+        if sel:
+            q += f"&labelSelector={sel}"
         if rv:
             q += f"&resourceVersion={rv}"
         path = self._path(self._namespace, query=q)
@@ -731,13 +777,22 @@ class RestCluster:
         through here."""
         self.namespace = namespace or None
         self._stores: Dict[str, RestResourceStore] = {}
+        self._filtered_stores: List[RestResourceStore] = []
         self._lock = threading.Lock()
         if registry is None:
             from pytorch_operator_tpu.metrics import default_registry
             registry = default_registry
         self.resilience = resilience or ResilienceConfig()
+        # breaker keyed per ENDPOINT, not per cluster object (PR 5
+        # residue): every client talking to the same host:port shares
+        # one breaker (a down apiserver trips once for the process),
+        # while clients of different endpoints cannot trip each other —
+        # the multi-replica sharded bench runs one RestCluster per
+        # replica against one stub endpoint and a multi-cluster
+        # operator runs one per apiserver.
         policy, limiter, breaker, metrics = _resilience.build(
-            self.resilience, registry)
+            self.resilience, registry,
+            endpoint=f"{config.host}:{config.port}")
         self.breaker = breaker
         self.client = RestClient(config, retry_policy=policy,
                                  rate_limiter=limiter, breaker=breaker,
@@ -757,6 +812,32 @@ class RestCluster:
                 store = RestResourceStore(self, plural, self.namespace)
                 self._stores[plural] = store
             return store
+
+    def filtered(self, plural: str,
+                 label_selector: Dict[str, str]) -> RestResourceStore:
+        """A FRESH selector-scoped store for ``plural``: its list AND
+        watch carry ``label_selector`` server-side.  Deliberately never
+        cached — each call is a new ListWatch, which is exactly the
+        handoff fencing a shard acquisition needs (fresh LIST before
+        any create; a prior acquisition's stopped watch is never
+        resurrected).  Tracked for ``close()``; the owner should also
+        ``stop_watch()`` it when the shard is released."""
+        store = RestResourceStore(self, plural, self.namespace,
+                                  label_selector=label_selector)
+        with self._lock:
+            self._filtered_stores.append(store)
+        return store
+
+    def release_filtered(self, store: RestResourceStore) -> None:
+        """Stop and forget a ``filtered`` store (shard released): the
+        tracking list must not grow one entry per acquisition forever
+        under rebalance churn."""
+        store.stop_watch()
+        with self._lock:
+            try:
+                self._filtered_stores.remove(store)
+            except ValueError:
+                pass
 
     @property
     def pods(self) -> RestResourceStore:
@@ -826,4 +907,6 @@ class RestCluster:
     def close(self) -> None:
         with self._lock:
             for store in self._stores.values():
+                store.stop_watch()
+            for store in self._filtered_stores:
                 store.stop_watch()

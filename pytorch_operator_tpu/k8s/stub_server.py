@@ -154,21 +154,65 @@ class StubApiServer:
                     if name:
                         self._send(200, store.get(ns, name))
                         return
-                    if is_watch:
-                        if outer._drop_watch.is_set():
-                            self._send(500, {"message": "watch unavailable"})
-                            return
-                        self._watch(store)
-                        return
                     selector = None
                     if "labelSelector" in q:
                         selector = dict(
                             pair.split("=", 1)
                             for pair in q["labelSelector"][0].split(","))
+                    if is_watch:
+                        if outer._drop_watch.is_set():
+                            self._send(500, {"message": "watch unavailable"})
+                            return
+                        self._watch(store, selector)
+                        return
+                    rv_param = q.get("resourceVersion", [None])[0]
+                    if rv_param is not None:
+                        windowed = self._windowed_list(
+                            store, ns, selector, rv_param)
+                        if windowed is not None:
+                            self._send(200, windowed)
+                            return
                     items = store.list(namespace=ns, label_selector=selector)
-                    self._send(200, {"kind": "List", "items": items})
+                    self._send(200, {
+                        "kind": "List", "items": items,
+                        "metadata": {"resourceVersion":
+                                     str(outer.cluster.current_rv())}})
                 except ApiError as e:
                     self._error(e)
+
+            @staticmethod
+            def _windowed_list(store, ns, selector, rv_param):
+                """A LIST carrying the caller's last-seen resourceVersion
+                is answered from the watch cache when the RV is still in
+                the window: only the objects changed/deleted since it
+                travel (``windowed: true``), so a post-handoff or
+                post-GAP relist costs O(changes), not O(collection).
+                Returns None (caller serves a full LIST with a fresh RV)
+                when the RV fell out of the window — real kube-apiserver
+                watch-cache semantics, with the delta made explicit
+                because the stub's client is our own informer."""
+                changes_since = getattr(store, "changes_since", None)
+                if changes_since is None:
+                    return None
+                delta = changes_since(rv_param)
+                if delta is None:
+                    return None
+                changed, deleted, rv = delta
+
+                def keep(obj):
+                    meta = obj.get("metadata") or {}
+                    if ns and meta.get("namespace") != ns:
+                        return False
+                    if selector:
+                        labels = meta.get("labels") or {}
+                        return all(labels.get(k) == v
+                                   for k, v in selector.items())
+                    return True
+
+                return {"kind": "List", "windowed": True,
+                        "items": [o for o in changed if keep(o)],
+                        "deleted": [o for o in deleted if keep(o)],
+                        "metadata": {"resourceVersion": str(rv)}}
 
             def _follow_log(self, store, ns, name):
                 """GET .../pods/{name}/log?follow=true — chunked text
@@ -259,9 +303,22 @@ class StubApiServer:
                     except OSError:
                         pass
 
-            def _watch(self, store):
+            def _watch(self, store, selector=None):
+                """Streaming watch; with a ``labelSelector`` only events
+                whose object matches are serialized onto this stream —
+                the server-side filtering that lets a sharded replica's
+                informers never even receive another shard's objects."""
                 events: "queue.Queue" = queue.Queue()
-                listener = lambda et, obj: events.put((et, obj))
+
+                def listener(et, obj):
+                    if selector:
+                        labels = (obj.get("metadata") or {}).get(
+                            "labels") or {}
+                        if not all(labels.get(k) == v
+                                   for k, v in selector.items()):
+                            return
+                    events.put((et, obj))
+
                 store.add_listener(listener)
                 try:
                     self.send_response(200)
@@ -424,6 +481,18 @@ def main() -> int:
                              "/api/v1/nodes), so the dev sandbox can "
                              "exercise the disruption subsystem: taint one "
                              "with PATCH to simulate a preemption notice")
+    parser.add_argument("--seed-jobs", type=int, default=0, metavar="J",
+                        help="pre-create J small PyTorchJobs so a sharded "
+                             "operator fleet has work the moment it "
+                             "connects; with --seed-shard-count the jobs "
+                             "are admitted pre-stamped with their "
+                             "pytorch.kubeflow.org/shard label")
+    parser.add_argument("--seed-shard-count", type=int, default=0,
+                        metavar="S",
+                        help="stamp --seed-jobs with shard labels for an "
+                             "S-shard control plane (0 seeds unlabeled "
+                             "jobs, which the owning replica stamps at "
+                             "admission)")
     args = parser.parse_args()
     server = StubApiServer(host=args.host, port=args.port)
     if args.seed_nodes:
@@ -432,10 +501,41 @@ def main() -> int:
         for i in range(args.seed_nodes):
             server.cluster.nodes.create(
                 "default", new_tpu_node(f"stub-tpu-node-{i}"))
+    for j in range(args.seed_jobs):
+        tmpl = {"spec": {"containers": [{"name": "pytorch",
+                                         "image": "img:1"}]}}
+        job = {
+            "apiVersion": "kubeflow.org/v1", "kind": "PyTorchJob",
+            "metadata": {"name": f"seed-job-{j}", "namespace": "default"},
+            "spec": {"pytorchReplicaSpecs": {
+                "Master": {"replicas": 1, "restartPolicy": "OnFailure",
+                           "template": tmpl},
+                "Worker": {"replicas": 1, "restartPolicy": "OnFailure",
+                           "template": tmpl},
+            }},
+        }
+        created = server.cluster.jobs.create("default", job)
+        if args.seed_shard_count > 0:
+            from pytorch_operator_tpu.api.v1 import constants as _constants
+            from pytorch_operator_tpu.runtime.sharding import shard_of
+
+            shard = shard_of("default", created["metadata"]["uid"],
+                             args.seed_shard_count)
+            server.cluster.jobs.patch(
+                "default", created["metadata"]["name"],
+                {"metadata": {"labels": {_constants.LABEL_SHARD:
+                                         str(shard)}}})
     server.start()
+    seeded = []
+    if args.seed_nodes:
+        seeded.append(f"{args.seed_nodes} TPU nodes")
+    if args.seed_jobs:
+        seeded.append(f"{args.seed_jobs} jobs"
+                      + (f" over {args.seed_shard_count} shards"
+                         if args.seed_shard_count else ""))
     print(f"stub API server on {args.host}:{server.port}"
-          + (f" ({args.seed_nodes} TPU nodes seeded)" if args.seed_nodes
-             else ""), flush=True)
+          + (f" ({', '.join(seeded)} seeded)" if seeded else ""),
+          flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -8,6 +8,7 @@ logger,util} — SURVEY.md §2.2)."""
 from .controls import (
     FakePodControl,
     FakeServiceControl,
+    FanoutExecutor,
     PodControl,
     ServiceControl,
     run_batch,
@@ -22,11 +23,24 @@ from .expectations import (
 from .informer import Informer, Store, meta_namespace_key, split_meta_namespace_key
 from .job_controller import JobController, JobControllerConfig, gen_general_name
 from .recorder import EventRecorder, FakeRecorder
+from .sharding import (
+    LabelFilteredSource,
+    ShardManager,
+    shard_of,
+    shard_selector,
+    sharded_source,
+)
 from .workqueue import RateLimiter, WorkQueue
 
 __all__ = [
     "WorkQueue",
     "RateLimiter",
+    "FanoutExecutor",
+    "LabelFilteredSource",
+    "ShardManager",
+    "shard_of",
+    "shard_selector",
+    "sharded_source",
     "ControllerExpectations",
     "expectation_pods_key",
     "expectation_services_key",

@@ -74,6 +74,7 @@ def _fanout_pool_for(width: int) -> ThreadPoolExecutor:
 def run_batch(
     fn: Callable, items: List,
     width: Optional[int] = None,
+    pool: Optional[ThreadPoolExecutor] = None,
 ) -> List[Tuple[Optional[object], Optional[Exception]]]:
     """Apply ``fn`` to every item, concurrently up to the fan-out width.
 
@@ -83,7 +84,9 @@ def run_batch(
     Width 1 (or a single item) stays on the calling thread, preserving
     the sequential path byte-for-byte; pass ``width=1`` explicitly for
     deterministic ordering (the fake controls do).  Shared by the create
-    and delete fan-outs — ``fn`` is any per-item API call.
+    and delete fan-outs — ``fn`` is any per-item API call.  ``pool``
+    overrides the shared width-keyed module pool (a
+    :class:`FanoutExecutor`'s privately owned pool).
     """
     if width is None:
         width = create_fanout_width()
@@ -95,7 +98,8 @@ def run_batch(
             except Exception as e:
                 results.append((None, e))
         return results
-    pool = _fanout_pool_for(width)
+    if pool is None:
+        pool = _fanout_pool_for(width)
     # The submitting sync's trace span is thread-local, which does not
     # cross pool.submit on its own — capture it here and bind it in the
     # workers so per-item create/delete spans parent under the reconcile
@@ -119,6 +123,58 @@ def run_batch(
 # Historical name (the create path landed first); tests and external
 # callers may still import it.
 run_create_batch = run_batch
+
+
+class FanoutExecutor:
+    """The create/delete fan-out as an object the CONTROLLER owns
+    (ROADMAP residue: the env-global module pool made per-replica width
+    impossible).  Two regimes:
+
+      * ``width=None`` (the default) — follow the
+        ``PYTORCH_OPERATOR_CREATE_FANOUT`` env knob per batch and run on
+        the process-shared width-keyed pools, byte-identical to the
+        historical behavior (benches flip the knob between runs; unit
+        tests construct hundreds of controllers and must not mint a
+        thread pool each);
+      * an explicit ``width`` — this executor OWNS a private pool of
+        exactly that width, created lazily and shut down by
+        :meth:`shutdown` (``JobController.shutdown``), so the sharded
+        bench can give every replica its own fan-out width.
+    """
+
+    def __init__(self, width: Optional[int] = None):
+        self.width = max(1, int(width)) if width is not None else None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    def _own_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("FanoutExecutor is shut down")
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.width,
+                    thread_name_prefix=f"ctl-fanout-{self.width}")
+            return self._pool
+
+    def run(self, fn: Callable, items: List
+            ) -> List[Tuple[Optional[object], Optional[Exception]]]:
+        if self.width is None:
+            return run_batch(fn, items)
+        if self.width <= 1 or len(items) <= 1:
+            return run_batch(fn, items, width=1)
+        return run_batch(fn, items, width=self.width,
+                         pool=self._own_pool())
+
+    def shutdown(self) -> None:
+        """Tear down the owned pool (no-op in env-knob mode: the shared
+        module pools outlive any one controller by design)."""
+        with self._lock:
+            pool, self._pool = self._pool, None
+            self._shutdown = True
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 #: the fan-out overlaps sub-100ms API calls; finer buckets than the
 #: default histogram resolve where the batch time actually goes
@@ -203,11 +259,20 @@ def submit_deletes_with_expectations(
 
 
 class PodControl:
-    def __init__(self, pods_client, recorder, registry=None):
+    def __init__(self, pods_client, recorder, registry=None,
+                 executor: Optional[FanoutExecutor] = None):
         self._pods = pods_client
         self._recorder = recorder
+        # constructor-injected fan-out (JobController owns one and
+        # shuts it down on stop); None keeps the env-knob module pools
+        self._executor = executor
         self._create_batch_hist, self._delete_batch_hist = (
             _batch_histograms(registry, "pod"))
+
+    def _run_batch(self, fn, items):
+        if self._executor is not None:
+            return self._executor.run(fn, items)
+        return run_batch(fn, items)
 
     def create_pod_with_controller_ref(
         self, namespace: str, pod: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -251,7 +316,7 @@ class PodControl:
         per-failure without aborting the rest of the batch."""
         t0 = time.perf_counter()
         try:
-            return run_create_batch(
+            return self._run_batch(
                 lambda pod: self.create_pod_with_controller_ref(
                     namespace, pod, controller_obj, controller_ref
                 ),
@@ -291,7 +356,7 @@ class PodControl:
 
         t0 = time.perf_counter()
         try:
-            return run_batch(_one, names)
+            return self._run_batch(_one, names)
         finally:
             self._delete_batch_hist.observe(time.perf_counter() - t0)
 
@@ -300,11 +365,15 @@ class PodControl:
 
 
 class ServiceControl:
-    def __init__(self, services_client, recorder, registry=None):
+    def __init__(self, services_client, recorder, registry=None,
+                 executor: Optional[FanoutExecutor] = None):
         self._services = services_client
         self._recorder = recorder
+        self._executor = executor
         self._create_batch_hist, self._delete_batch_hist = (
             _batch_histograms(registry, "service"))
+
+    _run_batch = PodControl._run_batch
 
     def create_service_with_controller_ref(
         self, namespace: str, service: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -338,7 +407,7 @@ class ServiceControl:
         """Bounded-fan-out batch create; see PodControl.create_many."""
         t0 = time.perf_counter()
         try:
-            return run_create_batch(
+            return self._run_batch(
                 lambda service: self.create_service_with_controller_ref(
                     namespace, service, controller_obj, controller_ref
                 ),
@@ -373,7 +442,7 @@ class ServiceControl:
 
         t0 = time.perf_counter()
         try:
-            return run_batch(_one, names)
+            return self._run_batch(_one, names)
         finally:
             self._delete_batch_hist.observe(time.perf_counter() - t0)
 

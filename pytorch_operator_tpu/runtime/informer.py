@@ -64,6 +64,22 @@ class InformerMetrics:
         store_objects.set_function(lambda: len(informer.store.keys()))
 
 
+def _rv_newer(current: dict, incoming: dict) -> bool:
+    """True when ``incoming`` carries a strictly newer resourceVersion
+    than ``current``.  Integer comparison when both parse (the fake /
+    stub tiers and real etcd-backed apiservers); opaque RVs fall back
+    to plain inequality (any different version is applied — the
+    pre-existing behavior for real clusters)."""
+    cur = (current.get("metadata") or {}).get("resourceVersion")
+    new = (incoming.get("metadata") or {}).get("resourceVersion")
+    if cur == new:
+        return False
+    try:
+        return int(new) > int(cur)
+    except (TypeError, ValueError):
+        return True
+
+
 def meta_namespace_key(obj: dict) -> str:
     """cache.MetaNamespaceKeyFunc: ``namespace/name`` (or ``name``)."""
     meta = obj.get("metadata") or {}
@@ -192,6 +208,13 @@ class Informer:
         # this same informer on the same thread), which must re-enter.
         self._apply_lock = threading.RLock()
         self._mutation_seq = 0
+        # highest integer resourceVersion this informer has applied —
+        # the "since" mark a watch-cache-aware source (list_changes)
+        # turns into a windowed relist: resync then costs O(changes),
+        # not O(collection).  None until the first parseable RV (real
+        # apiservers use opaque RVs; the windowed path simply never
+        # engages there and resync stays the full list+diff).
+        self._last_rv: Optional[int] = None
 
     # -- registration ------------------------------------------------------
     def add_event_handler(
@@ -220,6 +243,7 @@ class Informer:
             self._started = True
         self._source.add_listener(self._on_watch_event)
         for obj in self._source.list():
+            self._note_rv(obj)
             # contains(): presence check without deserialising (the native
             # store would otherwise json-parse every object just for this)
             if self.store.contains(meta_namespace_key(obj)):
@@ -245,6 +269,14 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced
 
+    def _note_rv(self, obj: dict) -> None:
+        try:
+            rv = int((obj.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            return
+        if self._last_rv is None or rv > self._last_rv:
+            self._last_rv = rv
+
     def _seconds_since_last_event(self) -> float:
         last = self._last_event_mono
         if last is None:
@@ -261,7 +293,7 @@ class Informer:
                 # next tick retries either way, but never silently
                 _log.warning("informer resync failed", exc_info=True)
 
-    def resync(self) -> None:
+    def resync(self, prefer_windowed: bool = False) -> None:
         """Diff a fresh LIST against the store and fire synthetic events.
 
         Heals a cache that diverged while the watch stream was down: a
@@ -278,10 +310,36 @@ class Informer:
         could resurrect a just-deleted object — so the diff aborts and
         retries with a fresh LIST.  When the watch is down (the very case
         resync exists to heal) no events flow and the first attempt
-        applies."""
+        applies.
+
+        Windowed relist (``prefer_windowed``, the GAP-healing path):
+        when the source supports ``list_changes`` (the stub apiserver's
+        watch cache, the fake store directly) and this informer has a
+        resourceVersion mark, the resync first asks for only the
+        changes since that mark — a delta whose cost is the churn in
+        the gap, not the collection size — and falls back to the
+        classic full list+diff when the mark fell out of the server's
+        window.  Periodic resyncs never take it: client-go resync
+        semantics deliberately fire update handlers for UNCHANGED
+        objects too (the periodic re-enqueue backstop), which a delta
+        cannot."""
+        prefetched = prefetched_seq = None
+        if prefer_windowed:
+            handled, prefetched, prefetched_seq = self._resync_windowed()
+            if handled:
+                return
         for _attempt in range(3):
-            start_seq = self._mutation_seq
-            fresh = {meta_namespace_key(o): o for o in self._source.list()}
+            if prefetched is not None:
+                # the windowed probe already fetched the full collection
+                # (server answered non-windowed) — diff that instead of
+                # paying a second identical LIST; its staleness guard is
+                # the seq captured before THAT fetch
+                items, prefetched = prefetched, None
+                start_seq = prefetched_seq
+            else:
+                start_seq = self._mutation_seq
+                items = self._source.list()
+            fresh = {meta_namespace_key(o): o for o in items}
             with self._apply_lock:
                 if self._mutation_seq != start_seq:
                     continue  # events interleaved with the LIST; retry
@@ -290,6 +348,7 @@ class Informer:
                 # guarantee the workqueue's dedup then upholds).
                 stale_keys = [k for k in self.store.keys() if k not in fresh]
                 for key, obj in fresh.items():
+                    self._note_rv(obj)
                     cur = self.store.get_by_key(key)
                     if cur is None:
                         self.store.add(obj)
@@ -322,24 +381,113 @@ class Informer:
         # busy stream all 3 attempts: the watch is clearly alive, so the
         # cache is converging through events anyway; next tick retries
 
+    def _resync_windowed(self):
+        """Try the delta relist.  Returns ``(handled, prefetched_items,
+        prefetched_seq)``: handled True means the delta fully applied;
+        otherwise *prefetched_items* (when the server answered with a
+        full non-windowed list) lets the caller diff THAT instead of
+        issuing a second identical LIST, guarded by the mutation seq
+        captured before the fetch.  Same staleness rule as the full
+        path: a delta fetched while watch events were landing is
+        retried, then abandoned to the full diff."""
+        list_changes = getattr(self._source, "list_changes", None)
+        if list_changes is None or self._last_rv is None:
+            return False, None, None
+        for _attempt in range(3):
+            start_seq = self._mutation_seq
+            try:
+                changes = list_changes(self._last_rv)
+            except Exception:
+                return False, None, None  # transient failure: full path
+            if changes is None:
+                return False, None, None
+            if not changes.windowed:
+                return False, changes.items, start_seq
+            with self._apply_lock:
+                if self._mutation_seq != start_seq:
+                    continue  # events interleaved with the fetch; retry
+                for obj in changes.items:
+                    key = meta_namespace_key(obj)
+                    cur = self.store.get_by_key(key)
+                    if cur is not None and (
+                            (cur.get("metadata") or {}).get(
+                                "resourceVersion")
+                            == (obj.get("metadata") or {}).get(
+                                "resourceVersion")):
+                        continue  # the watch already delivered this one
+                    if cur is None:
+                        self.store.add(obj)
+                        if self._metrics is not None:
+                            self._metrics.added.inc()
+                        for fn in self._handlers.add_funcs:
+                            fn(obj)
+                    else:
+                        self.store.update(obj)
+                        if (self._coalesce is not None
+                                and self._coalesce(key, cur, obj)):
+                            if self._metrics is not None:
+                                self._metrics.coalesced.inc()
+                            continue
+                        if self._metrics is not None:
+                            self._metrics.modified.inc()
+                        for fn in self._handlers.update_funcs:
+                            fn(cur, obj)
+                for obj in changes.deleted:
+                    key = meta_namespace_key(obj)
+                    cur = self.store.get_by_key(key)
+                    if cur is None:
+                        continue  # the watch already delivered the delete
+                    self.store.delete(cur)
+                    if self._metrics is not None:
+                        self._metrics.deleted.inc()
+                    for fn in self._handlers.delete_funcs:
+                        fn(cur)
+                if changes.resource_version is not None:
+                    if (self._last_rv is None
+                            or changes.resource_version > self._last_rv):
+                        self._last_rv = changes.resource_version
+                if self._metrics is not None:
+                    self._metrics.resyncs.inc()
+                return True, None, None
+        return False, None, None
+
     # -- watch plumbing ----------------------------------------------------
     def _on_watch_event(self, event_type: str, obj: dict) -> None:
         if event_type == "GAP":
             # the source's watch stream broke and restarted from "now":
             # events in the gap are lost — re-list and diff immediately
+            # (windowed when the server's watch cache still covers our
+            # resourceVersion mark: the gap's churn travels, not the
+            # whole collection)
             if self._synced:
-                self.resync()
+                self.resync(prefer_windowed=True)
             return
         key = meta_namespace_key(obj)
         self._last_event_mono = time.monotonic()
         with self._apply_lock:
             self._mutation_seq += 1
+            self._note_rv(obj)
+            if event_type == "MODIFIED" \
+                    and self.store.get_by_key(key) is None:
+                # MODIFIED for a key we have never seen: treat as ADDED
+                # (client-go DeltaFIFO does the same).  The normal route
+                # here is a label-selector watch — an object PATCHED
+                # into the selector (a job stamped with its shard label)
+                # arrives as MODIFIED on the wire but is brand new to
+                # this informer, and the add handlers (Created
+                # condition, expectations observation) must fire.
+                event_type = "ADDED"
             if event_type == "ADDED":
                 existing = self.store.get_by_key(key)
-                if existing is not None and (existing.get("metadata") or {}).get(
-                    "resourceVersion"
-                ) == (obj.get("metadata") or {}).get("resourceVersion"):
-                    return  # already delivered via the initial list
+                if existing is not None and not _rv_newer(existing, obj):
+                    # already delivered (initial-list replay), or a
+                    # STALE replay: the fake tier's nested bind patch
+                    # makes the create's MODIFIED (retyped to ADDED
+                    # above) arrive before the original ADDED — applying
+                    # the older object would regress the store and fire
+                    # the add handlers (expectations observation!) a
+                    # second time for one creation
+                    return
                 self.store.add(obj)
                 if self._metrics is not None:
                     self._metrics.added.inc()

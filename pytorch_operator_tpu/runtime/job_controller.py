@@ -28,7 +28,7 @@ from ..api.v1 import constants
 from ..k8s import serde
 from ..k8s.errors import NotFoundError
 from ..k8s.objects import OwnerReference
-from .controls import PodControl, ServiceControl
+from .controls import FanoutExecutor, PodControl, ServiceControl
 from .expectations import (
     ControllerExpectations,
     expectation_pods_key,
@@ -60,6 +60,11 @@ class JobControllerConfig:
         max_preemption_restarts: int = 3,
         drain_deadline_seconds: float = 30.0,
         max_elastic_resizes: int = 3,
+        shard_count: int = 1,
+        replica_id: str = "",
+        shard_lease_duration: float = 15.0,
+        shard_renew_interval: float = 5.0,
+        create_fanout_width: Optional[int] = None,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -88,6 +93,20 @@ class JobControllerConfig:
         # with enable_gang_scheduling False, because a partially scheduled
         # TPU slice deadlocks.  Set False to restore reference behavior.
         self.tpu_auto_gang = tpu_auto_gang
+        # Active-active sharded control plane (--shard-count > 1): jobs
+        # hash to shards, each shard is owned via its own Lease, and
+        # this replica runs informers + a workqueue per OWNED shard
+        # instead of hot-standby leader election.  shard_count 1 (the
+        # default) is behavior-identical to the leader-elected operator.
+        self.shard_count = max(1, int(shard_count))
+        self.replica_id = replica_id
+        self.shard_lease_duration = shard_lease_duration
+        self.shard_renew_interval = shard_renew_interval
+        # Per-controller create/delete fan-out width (None follows the
+        # PYTORCH_OPERATOR_CREATE_FANOUT env knob on the shared pools;
+        # an int gives this controller a private pool of that width,
+        # shut down with the controller).
+        self.create_fanout_width = create_fanout_width
 
 
 def _make_runtime_core():
@@ -129,11 +148,24 @@ class JobController:
             registry = default_registry
         self.registry = registry
         self.recorder = recorder or EventRecorder(cluster.events, self.CONTROLLER_NAME)
+        # The fan-out executor is OWNED by the controller (constructor-
+        # injected into both controls, shut down in shutdown()) so each
+        # replica of a sharded fleet can run its own width.
+        self.fanout = FanoutExecutor(self.config.create_fanout_width)
         self.pod_control = PodControl(cluster.pods, self.recorder,
-                                      registry=registry)
+                                      registry=registry,
+                                      executor=self.fanout)
         self.service_control = ServiceControl(cluster.services, self.recorder,
-                                              registry=registry)
+                                              registry=registry,
+                                              executor=self.fanout)
         self.expectations, self.work_queue = _make_runtime_core()
+        # shard-runtime registry (populated by the concrete controller
+        # when --shard-count > 1): shard index -> an object with a
+        # ``queue`` (WorkQueue) and a ``job_informer`` whose store holds
+        # the shard's jobs.  Empty in single-replica mode, where every
+        # queue operation resolves to self.work_queue unchanged.
+        self._shard_runtimes: Dict[int, object] = {}
+        self._shard_lock = threading.Lock()
         # client-go workqueue metric families for the one sync queue;
         # both the Python and the native C++ queue take the same hooks.
         self.work_queue_metrics = WorkQueueMetrics(registry, "pytorchjob")
@@ -186,8 +218,65 @@ class JobController:
         )
 
     # -- enqueue -----------------------------------------------------------
+    def _shard_runtime_snapshot(self) -> List[object]:
+        if not self._shard_runtimes:
+            return []
+        with self._shard_lock:
+            return list(self._shard_runtimes.values())
+
+    def _owns_job_key(self, key: str) -> bool:
+        """Sharded ownership test: is ``key`` in one of this replica's
+        shard-informer stores?  Always True in single-replica mode
+        (everything is ours); a SHARDED replica owning zero shards owns
+        zero jobs — the mode test must be the config, never the
+        runtime dict's emptiness."""
+        if self.config.shard_count <= 1:
+            return True
+        for runtime in self._shard_runtime_snapshot():
+            if runtime.job_informer.store.contains(key):
+                return True
+        return False
+
+    def _queue_for_key(self, key: str):
+        """The workqueue responsible for ``key``: the owning shard's
+        queue when this replica runs sharded and a shard runtime's job
+        store holds the key, else the controller-wide queue (the
+        single-replica path, byte-identical to before sharding)."""
+        for runtime in self._shard_runtime_snapshot():
+            if runtime.job_informer.store.contains(key):
+                return runtime.queue
+        return self.work_queue
+
     def enqueue_job(self, job: dict) -> None:
-        self.work_queue.add(meta_namespace_key(job))
+        key = meta_namespace_key(job)
+        if self._shard_runtimes:
+            shard = ((job.get("metadata") or {}).get("labels")
+                     or {}).get(constants.LABEL_SHARD)
+            if shard is not None and shard.isdigit():
+                with self._shard_lock:
+                    runtime = self._shard_runtimes.get(int(shard))
+                if runtime is not None:
+                    runtime.queue.add(key)
+                    return
+            self._queue_for_key(key).add(key)
+            return
+        self.work_queue.add(key)
+
+    def shutdown(self) -> None:
+        """Stop the controller's owned machinery: the sync queue(s),
+        every shard runtime, the shard manager (when sharded) and the
+        fan-out executor.  Replaces bare ``work_queue.shutdown()`` as
+        the operator's teardown entry point; calling both is harmless."""
+        self.work_queue.shutdown()
+        manager = getattr(self, "shard_manager", None)
+        if manager is not None:
+            manager.stop()
+        with self._shard_lock:
+            runtimes = list(self._shard_runtimes.values())
+            self._shard_runtimes.clear()
+        for runtime in runtimes:
+            runtime.stop()
+        self.fanout.shutdown()
 
     # -- pod informer callbacks (jobcontroller/pod.go:20-163) --------------
     def _resolve_controller_ref(self, namespace: str, ref) -> Optional[dict]:

@@ -137,7 +137,12 @@ class LeaderElector:
         if record != self._observed_record:
             self._observed_record = record
             self._observed_at = now
-        if holder != self.identity and now - self._observed_at < duration:
+        # An EMPTY holder is a released lease (client-go's ReleaseOnCancel
+        # writes holderIdentity "" on the way out): immediately acquirable,
+        # no expiry wait — shard handoff between cooperating replicas
+        # rides this.
+        if holder and holder != self.identity \
+                and now - self._observed_at < duration:
             return False  # holder's record changed within leaseDuration (locally observed)
         ts = _micro_time_now()
         taking_over = holder != self.identity
@@ -160,6 +165,55 @@ class LeaderElector:
             return False
         except ApiError:
             return _degraded()
+
+    def observe(self) -> tuple:
+        """Track the lease record WITHOUT competing for it: one GET that
+        advances the local change-observation clock (the same rule
+        try_acquire_or_renew applies), returning ``(holder, acquirable)``
+        — acquirable when the lease is absent, released (empty holder),
+        already ours, or its holder's record has not changed for a full
+        leaseDuration of local observation.  The shard manager calls
+        this every tick for shards it does not own, so a dead holder's
+        expiry clock starts at death, not at the first acquisition
+        attempt."""
+        now = self.clock()
+        try:
+            lease = self.lease_store.get(self.namespace, self.name)
+        except NotFoundError:
+            return None, True
+        except ApiError:
+            return None, False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration)
+        record = (holder, spec.get("renewTime"))
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+        if not holder or holder == self.identity:
+            return holder, True
+        return holder, now - self._observed_at >= duration
+
+    def release(self) -> None:
+        """Voluntarily hand the lease back (client-go ReleaseOnCancel):
+        write an empty holderIdentity so the next contender acquires
+        immediately instead of waiting out the lease duration.
+        Best-effort — on any API error the lease simply expires."""
+        self.is_leader = False
+        try:
+            lease = self.lease_store.get(self.namespace, self.name)
+        except ApiError:
+            return
+        spec = lease.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return  # someone else took over; nothing to release
+        lease["spec"] = dict(spec, holderIdentity="",
+                             renewTime=_micro_time_now())
+        try:
+            self.lease_store.update(lease)
+        except ApiError:
+            pass
 
     # -- run loop ----------------------------------------------------------
 

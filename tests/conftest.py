@@ -106,6 +106,22 @@ def pytest_runtest_makereport(item, call):
             sys.stderr.write(f"\n[e2e-artifacts] capture failed: {e!r}\n")
 
 
+@pytest.fixture(autouse=True)
+def _isolated_endpoint_breakers():
+    """The per-endpoint circuit-breaker registry is process-global by
+    design (every client of one apiserver shares one breaker).  Across
+    TESTS that is a leak: a breaker tripped OPEN against one stub
+    server's ephemeral port could be inherited by a later test whose
+    server lands on the same reused port.  Clear the registry around
+    every test — sharing still holds within a test, which is what the
+    sharing tests assert."""
+    from pytorch_operator_tpu.k8s.resilience import reset_endpoint_breakers
+
+    reset_endpoint_breakers()
+    yield
+    reset_endpoint_breakers()
+
+
 @pytest.fixture
 def e2e_artifacts(request):
     """Failure flight recorder for sim-e2e tests.

@@ -214,9 +214,9 @@ def test_bench_churn_pods_smoke(monkeypatch):
     # every burst patch was delivered as a MODIFIED (plus lifecycle
     # transitions observed on the way to Running)
     assert res["modified"] >= res["burst_events"] == 30
-    # delivered >= probe-observed: a MODIFIED arriving before its
-    # pod's ADDED was applied (the kubelet's nested bind patch) is
-    # delivered with old=None and never consults the coalesce hook
+    # delivered >= probe-observed (a MODIFIED arriving before its
+    # pod's ADDED was applied — the kubelet's nested bind patch — is
+    # re-typed to ADDED by the informer and counts as neither)
     assert res["informer_delivered_modified"] >= res["modified"]
     assert 0 <= res["coalescible"] <= res["modified"]
     frac = res["coalescible_fraction"]
@@ -381,6 +381,45 @@ def test_bench_elastic_tier_smoke(monkeypatch, tmp_path):
     assert "untouched" in text
     assert "Elastic verdict" in text
     assert text.count(bcp.ELASTIC_BEGIN) == 1
+
+
+def test_bench_shards_tier_smoke(monkeypatch, tmp_path):
+    """ISSUE 7: the sharded-control-plane tier must run end to end —
+    a 2-replica fleet splits the shard Leases and the per-replica verb
+    load, a mid-storm hard kill is survived with the dead replica's
+    shards re-acquired and zero duplicate-create 409s, and the section
+    updater rewrites only its delimited region."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    monkeypatch.setenv("PYTORCH_OPERATOR_NATIVE",
+                       os.environ.get("PYTORCH_OPERATOR_NATIVE", ""))
+    import bench_control_plane as bcp
+
+    res = bcp.run_shards(jobs=6, workers=1, shard_count=2, replicas=2,
+                         kill=True, timeout=60.0, threadiness=2)
+    assert res["converged"], res
+    assert res["duplicate_create_conflicts"] == 0
+    assert res["pods_match_expected"], res
+    assert res["shards_reacquired"], res
+    # both replicas carried apiserver load before the kill
+    totals = [v["total"] for v in res["per_replica_verbs"].values()]
+    assert len(totals) == 2 and all(t > 0 for t in totals)
+
+    single = bcp.run_shards(jobs=6, workers=1, shard_count=1, replicas=1,
+                            timeout=60.0, threadiness=2)
+    assert single["converged"], single
+    assert single["duplicate_create_conflicts"] == 0
+
+    # the renderer + section updater only touch their own region
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched\n")
+    ab = {"shards_single": single, "shards_multi": res,
+          "shards_multi_kill": res}
+    bcp.update_md_section(str(md), bcp.SHARDS_BEGIN, bcp.SHARDS_END,
+                          bcp.render_shards_md(ab, 6, 1, 2, 2))
+    text = md.read_text()
+    assert "untouched" in text
+    assert "Shards verdict" in text
+    assert text.count(bcp.SHARDS_BEGIN) == 1
 
 
 def test_bench_chaos_tier_smoke(monkeypatch):

@@ -1,0 +1,696 @@
+"""Active-active sharded control plane (ISSUE 7): consistent-hash job
+sharding, per-shard Lease ownership with fair rebalancing, shard-filtered
+informer sources, the windowed (watch-cache) relist, the per-endpoint
+circuit breaker, the controller-owned fan-out executor — and the e2e
+satellite: a mid-churn replica kill whose shards are re-acquired with
+zero duplicate creates."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.k8s.stub_server import StubApiServer
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime.informer import Informer
+from pytorch_operator_tpu.runtime.leader_election import LeaderElector
+from pytorch_operator_tpu.runtime.sharding import (
+    LabelFilteredSource,
+    ShardManager,
+    shard_of,
+    shard_selector,
+)
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def new_job(name, workers=1, namespace="default"):
+    tmpl = {"spec": {"containers": [{"name": "pytorch", "image": "img:1"}]}}
+    return {
+        "apiVersion": "kubeflow.org/v1", "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"pytorchReplicaSpecs": {
+            "Master": {"replicas": 1, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+            "Worker": {"replicas": workers, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+        }},
+    }
+
+
+def _condition_true(job, cond_type):
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c["type"] == cond_type and c["status"] == "True":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# consistent hash
+
+
+class TestShardOf:
+    def test_deterministic_and_bounded(self):
+        for count in (1, 2, 4, 7):
+            s = shard_of("ns", "uid-123", count)
+            assert 0 <= s < count
+            assert s == shard_of("ns", "uid-123", count)
+
+    def test_single_shard_is_always_zero(self):
+        assert shard_of("any", "thing", 1) == 0
+
+    def test_spread_is_roughly_uniform(self):
+        counts = [0] * 4
+        for i in range(400):
+            counts[shard_of("default", f"uid-{i}", 4)] += 1
+        # blake2b over 400 keys: every shard gets a meaningful share
+        assert min(counts) > 50, counts
+
+    def test_namespace_is_part_of_the_key(self):
+        hits = {shard_of(f"ns-{i}", "same-uid", 16) for i in range(32)}
+        assert len(hits) > 1
+
+
+# ---------------------------------------------------------------------------
+# LeaderElector release / empty-holder semantics
+
+
+class TestLeaseRelease:
+    def test_release_writes_empty_holder_and_is_instantly_acquirable(self):
+        cluster = FakeCluster()
+        leases = cluster.resource("leases")
+        a = LeaderElector(leases, "a", name="shard-x",
+                          lease_duration=30.0)
+        b = LeaderElector(leases, "b", name="shard-x",
+                          lease_duration=30.0)
+        assert a.try_acquire_or_renew()
+        # b cannot take a live lease
+        assert not b.try_acquire_or_renew()
+        a.is_leader = True
+        a.release()
+        lease = leases.get("default", "shard-x")
+        assert lease["spec"]["holderIdentity"] == ""
+        # empty holder: no expiry wait
+        assert b.try_acquire_or_renew()
+        assert leases.get("default", "shard-x")["spec"][
+            "holderIdentity"] == "b"
+
+    def test_release_is_noop_when_someone_else_holds(self):
+        cluster = FakeCluster()
+        leases = cluster.resource("leases")
+        a = LeaderElector(leases, "a", name="shard-y")
+        b = LeaderElector(leases, "b", name="shard-y")
+        assert a.try_acquire_or_renew()
+        b.release()  # b never held it
+        assert leases.get("default", "shard-y")["spec"][
+            "holderIdentity"] == "a"
+
+    def test_observe_tracks_expiry_without_competing(self):
+        now = [0.0]
+        cluster = FakeCluster()
+        leases = cluster.resource("leases")
+        holder = LeaderElector(leases, "h", name="shard-z",
+                               lease_duration=5.0,
+                               clock=lambda: now[0])
+        watcher = LeaderElector(leases, "w", name="shard-z",
+                                lease_duration=5.0,
+                                clock=lambda: now[0])
+        assert holder.try_acquire_or_renew()
+        who, acquirable = watcher.observe()
+        assert who == "h" and not acquirable
+        # record frozen (holder dead): acquirable after a full duration
+        now[0] += 4.9
+        assert watcher.observe() == ("h", False)
+        now[0] += 0.2
+        who, acquirable = watcher.observe()
+        assert who == "h" and acquirable
+        # and observe() never wrote anything
+        assert leases.get("default", "shard-z")["spec"][
+            "holderIdentity"] == "h"
+
+
+# ---------------------------------------------------------------------------
+# ShardManager fairness / rebalance (fake clock, manual ticks)
+
+
+class TestShardManager:
+    def _manager(self, cluster, identity, clock, shards=4, events=None):
+        log = events if events is not None else []
+
+        def on_acq(s):
+            log.append((identity, "acquired", s))
+
+        def on_rel(s):
+            log.append((identity, "released", s))
+
+        return ShardManager(
+            cluster.resource("leases"), identity, shards,
+            lease_duration=5.0, renew_interval=1.0,
+            on_acquired=on_acq, on_released=on_rel,
+            clock=lambda: clock[0])
+
+    def test_lone_replica_owns_everything(self):
+        clock = [0.0]
+        cluster = FakeCluster()
+        m1 = self._manager(cluster, "m1", clock)
+        m1.tick()
+        assert m1.owned_shards() == {0, 1, 2, 3}
+
+    def test_join_rebalances_to_fair_share(self):
+        clock = [0.0]
+        events = []
+        cluster = FakeCluster()
+        m1 = self._manager(cluster, "m1", clock, events=events)
+        m2 = self._manager(cluster, "m2", clock, events=events)
+        m1.tick()
+        assert len(m1.owned_shards()) == 4
+        # m2 joins: its heartbeat makes it a member, but every shard is
+        # live-held — it acquires nothing yet
+        m2.tick()
+        assert m2.owned_shards() == set()
+        # m1 now sees two members -> fair share 2 -> releases two
+        clock[0] += 1.0
+        m1.tick()
+        assert len(m1.owned_shards()) == 2
+        # the released (empty-holder) shards are immediately acquirable
+        m2.tick()
+        assert len(m2.owned_shards()) == 2
+        assert m1.owned_shards() | m2.owned_shards() == {0, 1, 2, 3}
+        assert m1.owned_shards().isdisjoint(m2.owned_shards())
+        released = [e for e in events if e[0] == "m1" and e[1] == "released"]
+        assert len(released) == 2
+
+    def test_uneven_shard_count_still_gives_every_replica_a_share(self):
+        """4 shards / 3 replicas: a ceil-for-everyone fair share would
+        leave two incumbents at 2+2 and strand the joiner at zero; the
+        ranked floor/remainder quota must converge to 2/1/1."""
+        clock = [0.0]
+        cluster = FakeCluster()
+        managers = [self._manager(cluster, f"m{i}", clock) for i in range(3)]
+        for _ in range(6):
+            for m in managers:
+                m.tick()
+            clock[0] += 1.0
+        counts = sorted(len(m.owned_shards()) for m in managers)
+        assert counts == [1, 1, 2], counts
+        union = set()
+        for m in managers:
+            assert union.isdisjoint(m.owned_shards())
+            union |= m.owned_shards()
+        assert union == {0, 1, 2, 3}
+
+    def test_dead_replica_shards_are_taken_over_after_expiry(self):
+        clock = [0.0]
+        cluster = FakeCluster()
+        m1 = self._manager(cluster, "m1", clock)
+        m2 = self._manager(cluster, "m2", clock)
+        for _ in range(3):  # converge to 2/2
+            m1.tick()
+            m2.tick()
+            clock[0] += 1.0
+        assert len(m1.owned_shards()) == 2 and len(m2.owned_shards()) == 2
+        # m1 dies (stops ticking, nothing released); m2 observes the
+        # frozen records, then takes over after a full lease duration
+        m2.tick()
+        clock[0] += 5.2
+        m2.tick()
+        assert m2.owned_shards() == {0, 1, 2, 3}
+
+    def test_graceful_stop_releases_for_instant_takeover(self):
+        clock = [0.0]
+        cluster = FakeCluster()
+        m1 = self._manager(cluster, "m1", clock)
+        m1.tick()
+        m1.stop()  # no thread: releases inline
+        assert m1.owned_shards() == set()
+        m2 = self._manager(cluster, "m2", clock)
+        m2.tick()  # no expiry wait needed
+        assert m2.owned_shards() == {0, 1, 2, 3}
+        # the dead replica's heartbeat lease is gone too
+        names = [l["metadata"]["name"]
+                 for l in cluster.resource("leases").list()]
+        assert not any(n.startswith("pytorch-operator-replica-m1")
+                       for n in names)
+
+
+# ---------------------------------------------------------------------------
+# label-filtered sources
+
+
+class TestLabelFilteredSource:
+    def test_list_and_events_are_filtered(self):
+        cluster = FakeCluster()
+        src = LabelFilteredSource(cluster.pods, shard_selector(1))
+        seen = []
+        src.add_listener(lambda et, obj: seen.append(
+            (et, (obj.get("metadata") or {}).get("name"))))
+        cluster.pods.create("default", {
+            "metadata": {"name": "mine",
+                         "labels": {constants.LABEL_SHARD: "1"}},
+            "spec": {}})
+        cluster.pods.create("default", {
+            "metadata": {"name": "other",
+                         "labels": {constants.LABEL_SHARD: "2"}},
+            "spec": {}})
+        cluster.pods.create("default", {
+            "metadata": {"name": "unlabeled"}, "spec": {}})
+        assert [p["metadata"]["name"] for p in src.list()] == ["mine"]
+        assert seen == [("ADDED", "mine")]
+        # GAP passes through unfiltered (relist healing must fire)
+        src._wrappers[list(src._wrappers)[0]]("GAP", {})
+        assert seen[-1] == ("GAP", None)
+        cluster.pods.delete("default", "mine")
+        assert ("DELETED", "mine") in seen
+
+    def test_remove_listener_unsubscribes_the_wrapper(self):
+        cluster = FakeCluster()
+        src = LabelFilteredSource(cluster.pods, shard_selector(0))
+        seen = []
+        fn = lambda et, obj: seen.append(et)
+        src.add_listener(fn)
+        src.remove_listener(fn)
+        cluster.pods.create("default", {
+            "metadata": {"name": "p",
+                         "labels": {constants.LABEL_SHARD: "0"}},
+            "spec": {}})
+        assert seen == []
+
+
+# ---------------------------------------------------------------------------
+# watch-cache windowed relist
+
+
+class TestWindowedRelist:
+    def test_changes_since_returns_delta_including_deletes(self):
+        cluster = FakeCluster()
+        cluster.pods.create("default", {"metadata": {"name": "a"},
+                                        "spec": {}})
+        mark = cluster.current_rv()
+        cluster.pods.create("default", {"metadata": {"name": "b"},
+                                        "spec": {}})
+        cluster.pods.patch("default", "a",
+                           {"metadata": {"labels": {"x": "1"}}})
+        cluster.pods.delete("default", "b")
+        changed, deleted, rv = cluster.pods.changes_since(mark)
+        assert [o["metadata"]["name"] for o in changed] == ["a"]
+        assert [o["metadata"]["name"] for o in deleted] == ["b"]
+        assert rv == cluster.current_rv()
+        # nothing since the current mark: empty delta, not None
+        changed, deleted, _ = cluster.pods.changes_since(rv)
+        assert changed == [] and deleted == []
+
+    def test_out_of_window_requires_full_list(self):
+        cluster = FakeCluster(watch_cache_window=4)
+        for i in range(8):
+            cluster.pods.create("default", {"metadata": {"name": f"p{i}"},
+                                            "spec": {}})
+        assert cluster.pods.changes_since(1) is None
+        full = cluster.pods.list_changes(1)
+        assert not full.windowed and len(full.items) == 8
+
+    def test_stub_server_serves_windowed_list(self):
+        from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+        srv = StubApiServer().start()
+        rest = RestCluster(KubeConfig("127.0.0.1", srv.port))
+        try:
+            srv.cluster.pods.create("default", {"metadata": {"name": "a"},
+                                                "spec": {}})
+            mark = srv.cluster.current_rv()
+            srv.cluster.pods.create("default", {"metadata": {"name": "b"},
+                                                "spec": {}})
+            srv.cluster.pods.delete("default", "a")
+            delta = rest.pods.list_changes(mark)
+            assert delta.windowed
+            assert [o["metadata"]["name"] for o in delta.items] == ["b"]
+            assert [o["metadata"]["name"] for o in delta.deleted] == ["a"]
+            assert delta.resource_version == srv.cluster.current_rv()
+            # an RV from before the dawn of the window on a tiny cache
+            srv.cluster.watch_cache_window = 1
+            for i in range(4):
+                srv.cluster.pods.create(
+                    "default", {"metadata": {"name": f"x{i}"}, "spec": {}})
+            full = rest.pods.list_changes(mark)
+            assert not full.windowed and full.deleted == []
+        finally:
+            rest.close()
+            srv.stop()
+
+    def test_informer_gap_heal_uses_delta_not_full_list(self):
+        """After a GAP the informer heals through list_changes: the
+        delta applies adds/mods/deletes — and the FULL list is never
+        consulted (a poisoned .list proves it)."""
+        cluster = FakeCluster()
+        cluster.pods.create("default", {"metadata": {"name": "keep"},
+                                        "spec": {}})
+        cluster.pods.create("default", {"metadata": {"name": "gone"},
+                                        "spec": {}})
+        informer = Informer(cluster.pods)
+        informer.start()
+        assert informer.store.contains("default/keep")
+        # watch goes deaf (the GAP scenario)
+        cluster.pods.remove_listener(informer._on_watch_event)
+        cluster.pods.delete("default", "gone")
+        cluster.pods.create("default", {"metadata": {"name": "new"},
+                                        "spec": {}})
+        cluster.pods.patch("default", "keep",
+                           {"metadata": {"labels": {"x": "1"}}})
+        poisoned = cluster.pods.list
+
+        def exploding_list(*a, **kw):
+            raise AssertionError("full LIST used where the windowed "
+                                 "delta should have served")
+
+        cluster.pods.list = exploding_list
+        try:
+            informer._on_watch_event("GAP", {})
+        finally:
+            cluster.pods.list = poisoned
+        assert not informer.store.contains("default/gone")
+        assert informer.store.contains("default/new")
+        assert (informer.store.get_by_key("default/keep")["metadata"]
+                ["labels"]["x"]) == "1"
+
+
+# ---------------------------------------------------------------------------
+# per-endpoint circuit breaker
+
+
+class TestEndpointBreaker:
+    def test_same_endpoint_shares_one_breaker(self):
+        from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+        srv = StubApiServer().start()
+        try:
+            a = RestCluster(KubeConfig("127.0.0.1", srv.port))
+            b = RestCluster(KubeConfig("127.0.0.1", srv.port))
+            assert a.breaker is b.breaker
+            a.close()
+            b.close()
+        finally:
+            srv.stop()
+
+    def test_different_endpoints_do_not_share(self):
+        from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+        s1 = StubApiServer().start()
+        s2 = StubApiServer().start()
+        try:
+            a = RestCluster(KubeConfig("127.0.0.1", s1.port))
+            b = RestCluster(KubeConfig("127.0.0.1", s2.port))
+            assert a.breaker is not b.breaker
+            # one endpoint's failures cannot trip the other's client
+            for _ in range(a.breaker.threshold):
+                a.breaker.on_failure()
+            assert a.breaker.state == "open"
+            assert b.breaker.state == "closed"
+            a.close()
+            b.close()
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_breaker_config_is_part_of_the_key(self):
+        from pytorch_operator_tpu.k8s.resilience import breaker_for_endpoint
+
+        x = breaker_for_endpoint("host:1", 3, 1.0)
+        y = breaker_for_endpoint("host:1", 3, 1.0)
+        z = breaker_for_endpoint("host:1", 5, 1.0)
+        assert x is y and x is not z
+
+
+# ---------------------------------------------------------------------------
+# controller-owned fan-out executor
+
+
+class TestFanoutExecutor:
+    def test_explicit_width_owns_a_private_concurrent_pool(self):
+        from pytorch_operator_tpu.runtime.controls import FanoutExecutor
+
+        ex = FanoutExecutor(width=4)
+        barrier = threading.Barrier(4, timeout=5)
+        results = ex.run(lambda i: barrier.wait() or i, list(range(4)))
+        assert [e for _, e in results] == [None] * 4
+        ex.shutdown()
+        with pytest.raises(RuntimeError):
+            ex.run(lambda i: i, list(range(4)))
+
+    def test_width_one_stays_sequential_and_ordered(self):
+        from pytorch_operator_tpu.runtime.controls import FanoutExecutor
+
+        ex = FanoutExecutor(width=1)
+        order = []
+        ex.run(lambda i: order.append(i), list(range(5)))
+        assert order == list(range(5))
+        ex.shutdown()
+
+    def test_controller_injects_its_executor_into_controls(self):
+        from pytorch_operator_tpu.controller import PyTorchController
+
+        cluster = FakeCluster()
+        ctl = PyTorchController(
+            cluster,
+            config=JobControllerConfig(create_fanout_width=2),
+            registry=Registry())
+        assert ctl.pod_control._executor is ctl.fanout
+        assert ctl.service_control._executor is ctl.fanout
+        assert ctl.fanout.width == 2
+        ctl.shutdown()
+        assert ctl.fanout._shutdown
+
+
+# ---------------------------------------------------------------------------
+# sharded controller semantics (sim tier)
+
+
+class TestShardedController:
+    def _controller(self, cluster, replica_id, shards=2, registry=None):
+        from pytorch_operator_tpu.controller import PyTorchController
+
+        cfg = JobControllerConfig(
+            shard_count=shards, replica_id=replica_id,
+            shard_lease_duration=1.0, shard_renew_interval=0.05)
+        return PyTorchController(cluster, config=cfg,
+                                 registry=registry or Registry())
+
+    def test_single_replica_mode_builds_no_shard_machinery(self):
+        from pytorch_operator_tpu.controller import PyTorchController
+
+        ctl = PyTorchController(FakeCluster(),
+                                config=JobControllerConfig(),
+                                registry=Registry())
+        assert ctl.shard_manager is None
+        assert ctl._admission_informer is None
+        assert ctl._shard_runtimes == {}
+        assert ctl._queue_for_key("ns/j") is ctl.work_queue
+        ctl.shutdown()
+
+    def test_jobs_and_children_get_shard_labels_and_converge(self):
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)
+        kubelet.start()
+        registry = Registry()
+        ctl = self._controller(cluster, "solo", shards=2,
+                               registry=registry)
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        try:
+            assert wait_for(lambda: ctl.owned_shards() == {0, 1})
+            for j in range(3):
+                cluster.jobs.create("default", new_job(f"sj-{j}"))
+            assert wait_for(lambda: all(
+                _condition_true(cluster.jobs.get("default", f"sj-{j}"),
+                                "Succeeded") for j in range(3)),
+                timeout=20)
+            for j in range(3):
+                job = cluster.jobs.get("default", f"sj-{j}")
+                shard = job["metadata"]["labels"][constants.LABEL_SHARD]
+                meta = job["metadata"]
+                assert shard == str(shard_of(meta["namespace"],
+                                             meta["uid"], 2))
+            for pod in cluster.pods.list("default"):
+                assert constants.LABEL_SHARD in pod["metadata"]["labels"]
+            for svc in cluster.services.list("default"):
+                assert constants.LABEL_SHARD in svc["metadata"]["labels"]
+                # the pod selector stays shard-free (pre-stamp pods)
+                assert constants.LABEL_SHARD not in svc["spec"]["selector"]
+            # owned-shards gauge + per-shard job gauge exported
+            text = registry.expose()
+            assert "pytorch_operator_owned_shards 2" in text
+            assert 'pytorch_operator_shard_jobs{shard="0"}' in text
+        finally:
+            stop.set()
+            ctl.shutdown()
+            kubelet.stop()
+
+    def test_modified_into_selector_fires_add_handlers(self):
+        """A job PATCHED into the shard selector arrives on the filtered
+        watch as MODIFIED — the informer must re-type it to ADDED
+        (DeltaFIFO semantics) so add_job (Created condition) runs."""
+        cluster = FakeCluster()
+        src = LabelFilteredSource(cluster.jobs, shard_selector(1))
+        informer = Informer(src)
+        adds, updates = [], []
+        informer.add_event_handler(
+            on_add=lambda o: adds.append(o["metadata"]["name"]),
+            on_update=lambda old, new: updates.append(
+                new["metadata"]["name"]))
+        informer.start()
+        cluster.jobs.create("default", new_job("stamped"))
+        assert adds == []  # unlabeled: invisible to the filtered source
+        cluster.jobs.patch("default", "stamped", {
+            "metadata": {"labels": {constants.LABEL_SHARD: "1"}}})
+        assert adds == ["stamped"] and updates == []
+        assert informer.store.contains("default/stamped")
+
+    def test_migrated_jobs_children_get_stamped(self):
+        """Migration: a job (and its children) admitted BEFORE sharding
+        was enabled carries no shard labels.  When the owning replica
+        stamps the job, it must stamp the existing children too, or the
+        shard-filtered pod informer never sees their transitions."""
+        cluster = FakeCluster()
+        job = cluster.jobs.create("default", new_job("legacy"))
+        # pre-sharding children: the job's base labels, no shard label
+        base = {constants.LABEL_JOB_NAME: "legacy",
+                "group-name": "kubeflow.org",
+                "pytorch-job-name": "legacy",
+                "controller-name": "pytorch-operator"}
+        cluster.pods.create("default", {
+            "metadata": {"name": "legacy-master-0", "labels": dict(base)},
+            "spec": {}})
+        cluster.services.create("default", {
+            "metadata": {"name": "legacy-master-0", "labels": dict(base)},
+            "spec": {}})
+        shard = shard_of("default", job["metadata"]["uid"], 2)
+        ctl = self._controller(cluster, "mig", shards=2)
+        # claim the job's shard directly (no run loop needed)
+        ctl.shard_manager._owned.add(shard)
+        ctl._admit_job(job)
+        assert (cluster.jobs.get("default", "legacy")["metadata"]
+                ["labels"][constants.LABEL_SHARD]) == str(shard)
+        assert (cluster.pods.get("default", "legacy-master-0")["metadata"]
+                ["labels"][constants.LABEL_SHARD]) == str(shard)
+        assert (cluster.services.get("default", "legacy-master-0")
+                ["metadata"]["labels"][constants.LABEL_SHARD]) == str(shard)
+        ctl.shutdown()
+
+    def test_foreign_disruption_notes_are_ignored_by_non_owners(self):
+        """Sharded replicas all watch nodes; only the job's owner may
+        note a disruption (non-owners would overcount the metric and
+        park keys on their workerless global queue)."""
+        cluster = FakeCluster()
+        ctl = self._controller(cluster, "non-owner", shards=2)
+        # fake an owned-shard runtime with an EMPTY job store: this
+        # replica owns shard 0 but not the job below
+        class _Rt:
+            class job_informer:
+                class store:
+                    @staticmethod
+                    def contains(_key):
+                        return False
+            queue = ctl.work_queue
+
+            @staticmethod
+            def stop():
+                pass
+        ctl._shard_runtimes[0] = _Rt
+        before = ctl.preemptions_detected_counter.value
+        ctl._note_disruption("default/foreign-job", "taint", "node-1",
+                             uid="u1", node="node-1")
+        assert ctl.preemptions_detected_counter.value == before
+        assert "default/foreign-job" not in ctl._pending_disruptions
+        ctl._shard_runtimes.clear()
+        ctl.shutdown()
+
+    def test_only_the_owner_stamps(self):
+        cluster = FakeCluster()
+        ctl = self._controller(cluster, "non-owner", shards=4)
+        # no run(): owns nothing
+        obj = cluster.jobs.create("default", new_job("unowned"))
+        ctl._admit_job(obj)
+        labels = cluster.jobs.get("default", "unowned")["metadata"].get(
+            "labels") or {}
+        assert constants.LABEL_SHARD not in labels
+        ctl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the e2e satellite: handoff under churn over HTTP, zero duplicate creates
+
+
+def test_shard_handoff_under_churn_zero_duplicate_creates():
+    """Two sharded replicas against one stub apiserver; replica 0 is
+    hard-killed (no Lease release) mid-churn.  Its shards must be
+    re-acquired after Lease expiry, every job must reach Succeeded, and
+    the server-side POST 409 (duplicate-create) count must be 0."""
+    from pytorch_operator_tpu.controller import PyTorchController
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+
+    srv = StubApiServer().start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    url_cfg = lambda: KubeConfig("127.0.0.1", srv.port)
+    fleet = []
+    for r in range(2):
+        registry = Registry()
+        rest = RestCluster(url_cfg(), namespace="default",
+                           registry=registry)
+        cfg = JobControllerConfig(
+            shard_count=2, replica_id=f"ho-r{r}",
+            shard_lease_duration=0.8, shard_renew_interval=0.1)
+        ctl = PyTorchController(rest, config=cfg, registry=registry)
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        fleet.append((ctl, rest, stop))
+    jobs = 6
+    try:
+        assert wait_for(lambda: sum(
+            len(c.owned_shards()) for c, _, _ in fleet) == 2, timeout=10)
+        assert all(len(c.owned_shards()) == 1 for c, _, _ in fleet)
+        for j in range(jobs):
+            srv.cluster.jobs.create("default", new_job(f"ho-{j}"))
+
+        def succeeded():
+            return sum(
+                1 for j in range(jobs)
+                if _condition_true(
+                    srv.cluster.jobs.get("default", f"ho-{j}"),
+                    "Succeeded"))
+
+        # mid-churn crash of replica 0 — no release, survivors must
+        # wait out the Lease
+        assert wait_for(lambda: succeeded() >= 2, timeout=20)
+        ctl0, rest0, stop0 = fleet[0]
+        ctl0.shard_manager.kill()
+        stop0.set()
+        ctl0.shutdown()
+        rest0.close()
+
+        assert wait_for(lambda: succeeded() == jobs, timeout=30), (
+            f"{succeeded()}/{jobs} Succeeded")
+        survivor = fleet[1][0]
+        assert wait_for(lambda: survivor.owned_shards() == {0, 1},
+                        timeout=10)
+        assert srv.counters.get("POST 409", 0) == 0
+        pods = srv.cluster.pods.list("default")
+        assert len(pods) == jobs * 2
+    finally:
+        for ctl, rest, stop in fleet[1:]:
+            stop.set()
+            ctl.shutdown()
+            rest.close()
+        kubelet.stop()
+        srv.stop()
