@@ -77,6 +77,14 @@ GANG_SCHEDULING_POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
 # lifetime — rebalancing moves shard OWNERSHIP (per-shard Leases), not
 # job assignments.
 LABEL_SHARD = "pytorch.kubeflow.org/shard"
+# Lease-role label stamped on every Lease the sharded control plane
+# creates (shard-ownership Leases vs replica heartbeats), so membership
+# scans LIST with a selector instead of deserializing every Lease in
+# the namespace — and third-party Leases can never be mistaken for a
+# heartbeat.
+LABEL_LEASE_COMPONENT = "pytorch.kubeflow.org/lease-component"
+LEASE_COMPONENT_SHARD = "shard"
+LEASE_COMPONENT_HEARTBEAT = "replica-heartbeat"
 
 # --- Rendezvous environment ------------------------------------------------
 # Reference c10d wiring (pod.go:234-281), kept for backend='xla'

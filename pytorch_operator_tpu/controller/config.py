@@ -9,6 +9,7 @@ The template can be overridden by a config file
 
 from __future__ import annotations
 
+import functools
 import os
 import string
 from typing import List, Optional
@@ -40,10 +41,38 @@ def get_init_container_template(config_path: Optional[str] = None) -> str:
     return DEFAULT_INIT_CONTAINER_TEMPLATE
 
 
+@functools.lru_cache(maxsize=1)
+def _parsed_default_template():
+    """The DEFAULT template parsed once, placeholders in place —
+    rendering used to pay one full YAML parse per worker-pod build,
+    which the kubemark profile showed as a top-five control-plane cost
+    at 50k pods.  Only the shipped default takes this path: its shape
+    is known (placeholders appear solely inside string VALUES), so a
+    structural walk substituting strings is exactly equivalent to
+    substitute-then-parse.  Custom templates keep the original
+    per-call path — their placeholders may sit in mapping keys, splice
+    YAML structure, or rely on post-substitution scalar coercion."""
+    return yaml.safe_load(DEFAULT_INIT_CONTAINER_TEMPLATE) or []
+
+
 def render_init_containers(
     master_addr: str, init_container_image: str, template: Optional[str] = None
 ) -> List[dict]:
     """Render the template into container dicts (util.go:60-78)."""
-    tpl = string.Template(template or get_init_container_template())
-    rendered = tpl.substitute(masterAddr=master_addr, initContainerImage=init_container_image)
-    return yaml.safe_load(rendered) or []
+    raw = template or get_init_container_template()
+    mapping = {"masterAddr": master_addr,
+               "initContainerImage": init_container_image}
+    if raw != DEFAULT_INIT_CONTAINER_TEMPLATE:
+        rendered = string.Template(raw).substitute(mapping)
+        return yaml.safe_load(rendered) or []
+
+    def subst(v):
+        if isinstance(v, str):
+            return string.Template(v).substitute(mapping)
+        if isinstance(v, dict):
+            return {k: subst(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [subst(x) for x in v]
+        return v
+
+    return subst(_parsed_default_template())

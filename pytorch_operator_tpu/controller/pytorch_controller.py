@@ -172,7 +172,8 @@ class PyTorchController(
                 lease_duration=self.config.shard_lease_duration,
                 renew_interval=self.config.shard_renew_interval,
                 on_acquired=self._on_shard_acquired,
-                on_released=self._on_shard_released)
+                on_released=self._on_shard_released,
+                clock=self.config.clock or time.monotonic)
         # Handlers are attributes so tier-2 tests can stub the status write
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
@@ -286,6 +287,15 @@ class PyTorchController(
         runtime = _ShardRuntime(self, shard, workers=self._shard_workers)
         with self._shard_lock:
             self._shard_runtimes[shard] = runtime
+        # per-shard nodeName index registered BEFORE the informer
+        # starts, so the initial LIST replay populates it — the union
+        # is how sharded disruption handling resolves a disrupted
+        # node's pods without cluster-wide LISTs
+        if self._pod_index_union is not None:
+            from ..disruption.watcher import PodNodeIndex
+
+            self._pod_index_union.add_index(
+                shard, PodNodeIndex(runtime.pod_informer))
         # registered BEFORE informers start: the very first ADDED must
         # already route into this shard's queue
         runtime.start(self._stop_event or threading.Event())
@@ -304,6 +314,8 @@ class PyTorchController(
     def _on_shard_released(self, shard: int) -> None:
         with self._shard_lock:
             runtime = self._shard_runtimes.pop(shard, None)
+        if self._pod_index_union is not None:
+            self._pod_index_union.remove_index(shard)
         if runtime is not None:
             runtime.stop()
             self.logger.info("replica %s released shard %d",
@@ -876,7 +888,8 @@ class _ShardRuntime:
                  workers: int = 1):
         self.shard = shard
         self.controller = controller
-        self.queue = WorkQueue()
+        self.queue = WorkQueue(clock=controller.config.clock
+                               or time.monotonic)
         self.queue.set_metrics(WorkQueueMetrics(
             controller.registry, f"pytorchjob-shard{shard}"))
         cluster = controller.cluster

@@ -53,7 +53,8 @@ from ..runtime.job_controller import _controller_ref_of
 from ..runtime.logger import logger_for_job
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from .detector import node_schedulable_tpu, pod_disruption_reason
-from .watcher import CapacityWatcher, DisruptionWatcher, PodNodeIndex
+from .watcher import (CapacityWatcher, DisruptionWatcher, PodNodeIndex,
+                      PodNodeIndexUnion)
 
 
 class DisruptionHandlingMixin:
@@ -94,8 +95,11 @@ class DisruptionHandlingMixin:
         # not yet bound): one capacity event waking N shrunken jobs must
         # not grow them all onto the same free nodes
         self._growing_claims: Dict[str, int] = {}
-        # injectable clock so drain-deadline tests run on a fake clock
-        self._mono = time.monotonic
+        # injectable clock (JobControllerConfig(clock=...) — the
+        # simulator's virtual time — else wall): drain deadlines and
+        # detection->restart latency ride it; tests also override it
+        # directly
+        self._mono = self.config.clock or time.monotonic
         self.elastic_resizes_counter = registry.counter_vec(
             "pytorch_operator_elastic_resizes_total",
             "Counts elastic gang resizes, labeled direction: shrink "
@@ -116,6 +120,7 @@ class DisruptionHandlingMixin:
         )
         self.disruption_watcher: Optional[DisruptionWatcher] = None
         self.capacity_watcher: Optional[CapacityWatcher] = None
+        self._pod_index_union: Optional[PodNodeIndexUnion] = None
         if self.config.enable_disruption_handling and \
                 self.node_informer is not None:
             # nodeName index over the pod informer (ROADMAP scalability
@@ -123,17 +128,31 @@ class DisruptionHandlingMixin:
             # instead of a cluster-wide LIST per node event.  Sharded
             # replicas never START the global pod informer (each shard
             # runs its own filtered one), so an index over it would be
-            # permanently empty and silently hide every disruption —
-            # they fall back to the cluster-wide LIST instead.
-            pod_index = (PodNodeIndex(self.pod_informer)
-                         if self.config.shard_count <= 1 else None)
+            # permanently empty — they get a PodNodeIndexUnion instead,
+            # fed one per-shard index per ACQUIRED shard (see
+            # _on_shard_acquired), which resolves a disrupted node's
+            # OWNED pods with zero apiserver traffic (the PR 7
+            # cluster-wide-LIST fallback is gone).  The union backs
+            # DISRUPTION resolution only: a replica restarts only gangs
+            # it owns, so owned-shard scope is exactly right there —
+            # but capacity OCCUPANCY needs the whole fleet (a node
+            # hosting another shard's pods is NOT free), so sharded
+            # CapacityWatchers keep the authoritative cluster-LIST
+            # fallback; free_capacity runs only on capacity events for
+            # shrunken elastic jobs, not per disrupted node.
+            if self.config.shard_count <= 1:
+                pod_index = capacity_index = PodNodeIndex(
+                    self.pod_informer)
+            else:
+                pod_index = self._pod_index_union = PodNodeIndexUnion()
+                capacity_index = None
             self.disruption_watcher = DisruptionWatcher(
                 self.cluster, self.node_informer,
                 self._note_node_disruption, kind=self.KIND,
                 pod_index=pod_index)
             self.capacity_watcher = CapacityWatcher(
                 self.node_informer, self._on_capacity_returned,
-                pod_index=pod_index, cluster=self.cluster)
+                pod_index=capacity_index, cluster=self.cluster)
 
     def disruption_handling_enabled(self) -> bool:
         return self.config.enable_disruption_handling
@@ -184,7 +203,7 @@ class DisruptionHandlingMixin:
                 "uid": uid,
                 "nodes": [node] if node else [],
                 "pods": [pod] if pod else [],
-                "detected_at": time.monotonic(),
+                "detected_at": self._mono(),
             }
         self.preemptions_detected_counter.inc()
         self._queue_for_key(job_key).add(job_key)
@@ -336,7 +355,7 @@ class DisruptionHandlingMixin:
         job.status.preemption_restarts = used + 1
         self.preemption_gang_restarts_counter.inc()
         self.preemption_restart_latency.observe(
-            time.monotonic() - note["detected_at"])
+            self._mono() - note["detected_at"])
         self.jobs_restarted_counter.inc()
         return True
 

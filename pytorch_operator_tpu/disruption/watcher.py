@@ -100,6 +100,65 @@ class PodNodeIndex:
         with self._lock:
             return len(self._keys_by_node)
 
+    def node_names(self) -> Set[str]:
+        """Nodes currently hosting at least one indexed pod."""
+        with self._lock:
+            return set(self._keys_by_node)
+
+
+class PodNodeIndexUnion:
+    """Union view over per-shard :class:`PodNodeIndex` instances.
+
+    A sharded replica never starts the global pod informer (each owned
+    shard runs its own shard-filtered one), so a single PodNodeIndex
+    would be permanently empty and disruption handling used to fall
+    back to cluster-wide LISTs (the PR 7 tail).  Instead, the
+    controller registers one index per ACQUIRED shard's pod informer
+    here and drops it on release; ``pods_on`` unions the per-shard
+    buckets — which is exactly the right scope, because a replica only
+    restarts gangs it owns, and every owned job's pods live in an owned
+    shard's informer.
+
+    The union covers OWNED shards only: other replicas' pods are
+    invisible (their disruptions resolve on their owners).  That scope
+    makes it wrong for capacity OCCUPANCY — a node hosting another
+    shard's pods is not free — so sharded ``CapacityWatcher``s keep the
+    cluster-LIST fallback instead of this view.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._indexes: Dict[int, PodNodeIndex] = {}
+
+    def add_index(self, shard: int, index: PodNodeIndex) -> None:
+        with self._lock:
+            self._indexes[shard] = index
+
+    def remove_index(self, shard: int) -> None:
+        with self._lock:
+            self._indexes.pop(shard, None)
+
+    def _snapshot(self) -> List[PodNodeIndex]:
+        with self._lock:
+            return list(self._indexes.values())
+
+    def pods_on(self, node_name: str) -> List[dict]:
+        pods: List[dict] = []
+        seen: Set[str] = set()
+        for index in self._snapshot():
+            for pod in index.pods_on(node_name):
+                key = meta_namespace_key(pod)
+                if key not in seen:
+                    seen.add(key)
+                    pods.append(pod)
+        return pods
+
+    def node_count(self) -> int:
+        nodes: Set[str] = set()
+        for index in self._snapshot():
+            nodes.update(index.node_names())
+        return len(nodes)
+
 
 class CapacityWatcher:
     """Node informer -> "schedulable TPU capacity returned" events.

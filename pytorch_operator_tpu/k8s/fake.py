@@ -54,6 +54,26 @@ def _match_selector(selector: Optional[Dict[str, str]], obj: dict) -> bool:
     return match_labels(selector, labels)
 
 
+def _copy_obj(obj):
+    """Deep copy for wire-format objects (dict/list/scalar trees).
+
+    ``copy.deepcopy`` pays memo-dict bookkeeping on every node; wire
+    objects are plain JSON shapes, so a direct recursive copy is ~5x
+    cheaper — and this is the fake tier's hottest operation (every
+    store mutation copies for the watch fan-out, every LIST copies the
+    result set; at kubemark scale that is hundreds of thousands of
+    copies per scenario).  Anything non-JSON a test smuggled into a
+    stored object falls back to ``copy.deepcopy`` unchanged."""
+    t = type(obj)
+    if t is dict:
+        return {k: _copy_obj(v) for k, v in obj.items()}
+    if t is list:
+        return [_copy_obj(v) for v in obj]
+    if t is str or t is int or t is float or t is bool or obj is None:
+        return obj
+    return copy.deepcopy(obj)
+
+
 class FakeResourceStore:
     """One namespaced resource collection (e.g. all Pods)."""
 
@@ -62,6 +82,18 @@ class FakeResourceStore:
         self.kind = kind
         self._objects: Dict[Tuple[str, str], dict] = {}
         self._listeners: List[Callable[[str, dict], None]] = []
+        # Label index (kubemark scale): for each label key in
+        # ``cluster.index_labels``, value -> set of object keys.  A LIST
+        # whose selector pins an indexed label then scans only that
+        # bucket — the controller's per-job pod/service LIST drops from
+        # O(collection) to O(objects of that job), which is what makes
+        # a 50k-pod fleet reconcilable in Python.  Buckets hold KEYS
+        # only (objects resolve through ``_objects``), so value-stable
+        # rewrites (status, GC owner-ref surgery) need no index work.
+        self._index_labels: Tuple[str, ...] = tuple(
+            getattr(cluster, "index_labels", ()) or ())
+        self._label_index: Dict[str, Dict[str, set]] = {
+            k: {} for k in self._index_labels}
         # Watch cache (ROADMAP direction 2, first slice): a bounded
         # window of recent mutations so a LIST carrying the caller's
         # last-seen resourceVersion can be answered as a DELTA instead
@@ -75,10 +107,83 @@ class FakeResourceStore:
     def _key(self, namespace: str, name: str) -> Tuple[str, str]:
         return (namespace or "default", name)
 
+    def __len__(self) -> int:
+        with self._cluster.lock:
+            return len(self._objects)
+
+    # -- label index (called with the cluster lock held) -------------------
+    def _index_add(self, key: Tuple[str, str], obj: dict) -> None:
+        if not self._index_labels:
+            return
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for lk in self._index_labels:
+            value = labels.get(lk)
+            if value is not None:
+                self._label_index[lk].setdefault(value, set()).add(key)
+
+    def _index_remove(self, key: Tuple[str, str], obj: dict) -> None:
+        if not self._index_labels:
+            return
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for lk in self._index_labels:
+            value = labels.get(lk)
+            bucket = self._label_index[lk].get(value)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_index[lk][value]
+
+    def _index_replace(self, key: Tuple[str, str], old_obj: dict,
+                       new_obj: dict) -> None:
+        if not self._index_labels:
+            return
+        old_labels = (old_obj.get("metadata") or {}).get("labels") or {}
+        new_labels = (new_obj.get("metadata") or {}).get("labels") or {}
+        for lk in self._index_labels:
+            old_v, new_v = old_labels.get(lk), new_labels.get(lk)
+            if old_v == new_v:
+                continue
+            if old_v is not None:
+                bucket = self._label_index[lk].get(old_v)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._label_index[lk][old_v]
+            if new_v is not None:
+                self._label_index[lk].setdefault(new_v, set()).add(key)
+
+    def _indexed_keys(
+            self, label_selector: Optional[Dict[str, str]]):
+        """The smallest index bucket an exact-equality selector pins, or
+        None when no indexed label participates (caller full-scans)."""
+        if not label_selector or not self._index_labels:
+            return None
+        best = None
+        for lk in self._index_labels:
+            value = label_selector.get(lk)
+            if not isinstance(value, str):
+                continue
+            bucket = self._label_index[lk].get(value, set())
+            if best is None or len(bucket) < len(best):
+                best = bucket
+        return best
+
     def _notify(self, event_type: str, obj: dict) -> None:
         self._record_event(event_type, obj)
-        for listener in list(self._listeners):
-            listener(event_type, copy.deepcopy(obj))
+        listeners = list(self._listeners)
+        if not listeners:
+            return
+        # ONE copy shared by every listener (informer, kubelet, index
+        # wrappers): watch consumers treat delivered objects as
+        # read-only by contract — the informer stores them in its cache
+        # and hands them to handlers as immutable state — so a per-
+        # listener copy only taxed the fan-out (measurably, at kubemark
+        # scale: two listeners on the pod store doubled the fake tier's
+        # hottest allocation).  The copy still isolates listeners from
+        # the STORE's object, which later mutations replace wholesale.
+        shared = _copy_obj(obj)
+        for listener in listeners:
+            listener(event_type, shared)
 
     def _record_event(self, event_type: str, obj: dict) -> None:
         # called with the cluster lock held (every mutation notifies
@@ -121,9 +226,9 @@ class FakeResourceStore:
                 key = (meta.get("namespace", "default"),
                        meta.get("name", ""))
                 latest[key] = (event_type, obj)
-            changed = [copy.deepcopy(obj) for et, obj in latest.values()
+            changed = [_copy_obj(obj) for et, obj in latest.values()
                        if et != DELETED]
-            deleted = [copy.deepcopy(obj) for et, obj in latest.values()
+            deleted = [_copy_obj(obj) for et, obj in latest.values()
                        if et == DELETED]
             return changed, deleted, self._cluster.current_rv()
 
@@ -151,8 +256,9 @@ class FakeResourceStore:
     # -- CRUD --------------------------------------------------------------
     def create(self, namespace: str, obj: dict) -> dict:
         self._cluster.maybe_fault("create", self.kind)
+        self._cluster.count_verb("create", self.kind)
         with self._cluster.lock:
-            obj = copy.deepcopy(obj)
+            obj = _copy_obj(obj)
             meta = obj.setdefault("metadata", {})
             if namespace and meta.get("namespace") and meta["namespace"] != namespace:
                 raise InvalidError(
@@ -170,16 +276,18 @@ class FakeResourceStore:
             meta["resourceVersion"] = str(self._cluster.next_rv())
             meta.setdefault("creationTimestamp", _now_iso())
             self._objects[key] = obj
+            self._index_add(key, obj)
             self._notify(ADDED, obj)
-            return copy.deepcopy(obj)
+            return _copy_obj(obj)
 
     def get(self, namespace: str, name: str) -> dict:
         self._cluster.maybe_fault("get", self.kind)
+        self._cluster.count_verb("get", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             if key not in self._objects:
                 raise NotFoundError(f'{self.kind} "{name}" not found')
-            return copy.deepcopy(self._objects[key])
+            return _copy_obj(self._objects[key])
 
     def list(
         self,
@@ -187,20 +295,35 @@ class FakeResourceStore:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[dict]:
         self._cluster.maybe_fault("list", self.kind)
+        self._cluster.count_verb("list", self.kind)
         with self._cluster.lock:
             out = []
+            indexed = self._indexed_keys(label_selector)
+            if indexed is not None:
+                # the bucket narrows the scan; the full selector (and
+                # namespace) still decide membership authoritatively
+                for key in sorted(indexed):
+                    obj = self._objects.get(key)
+                    if obj is None:
+                        continue
+                    if namespace and key[0] != namespace:
+                        continue
+                    if _match_selector(label_selector, obj):
+                        out.append(_copy_obj(obj))
+                return out
             for (ns, _), obj in sorted(self._objects.items()):
                 if namespace and ns != namespace:
                     continue
                 if _match_selector(label_selector, obj):
-                    out.append(copy.deepcopy(obj))
+                    out.append(_copy_obj(obj))
             return out
 
     def update(self, obj: dict, subresource: Optional[str] = None) -> dict:
         """Replace an object; enforces resourceVersion optimistic locking."""
         self._cluster.maybe_fault("update", self.kind)
+        self._cluster.count_verb("update", self.kind)
         with self._cluster.lock:
-            obj = copy.deepcopy(obj)
+            obj = _copy_obj(obj)
             meta = obj.get("metadata") or {}
             key = self._key(meta.get("namespace", "default"), meta.get("name", ""))
             existing = self._objects.get(key)
@@ -213,7 +336,7 @@ class FakeResourceStore:
                 )
             if subresource == "status":
                 # Status updates only replace .status.
-                new_obj = copy.deepcopy(existing)
+                new_obj = _copy_obj(existing)
                 new_obj["status"] = obj.get("status", {})
             else:
                 new_obj = obj
@@ -226,8 +349,9 @@ class FakeResourceStore:
                     new_obj["status"] = existing["status"]
             new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
             self._objects[key] = new_obj
+            self._index_replace(key, existing, new_obj)
             self._notify(MODIFIED, new_obj)
-            return copy.deepcopy(new_obj)
+            return _copy_obj(new_obj)
 
     def patch(self, namespace: str, name: str, patch: dict, subresource: Optional[str] = None) -> dict:
         """JSON-merge-patch: dicts merge recursively, nulls delete, lists
@@ -239,6 +363,7 @@ class FakeResourceStore:
         ignored), so the sim and http tiers exercise the same
         merge-patch + conflict-retry path the controller ships."""
         self._cluster.maybe_fault("patch", self.kind)
+        self._cluster.count_verb("patch", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             existing = self._objects.get(key)
@@ -249,7 +374,7 @@ class FakeResourceStore:
                 raise ConflictError(
                     f'{self.kind} "{name}": resourceVersion conflict'
                 )
-            new_obj = copy.deepcopy(existing)
+            new_obj = _copy_obj(existing)
             if subresource == "status":
                 body = patch["status"] if "status" in patch else {
                     k: v for k, v in patch.items() if k != "metadata"}
@@ -257,16 +382,19 @@ class FakeResourceStore:
             _merge(new_obj, patch)
             new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
             self._objects[key] = new_obj
+            self._index_replace(key, existing, new_obj)
             self._notify(MODIFIED, new_obj)
-            return copy.deepcopy(new_obj)
+            return _copy_obj(new_obj)
 
     def delete(self, namespace: str, name: str) -> None:
         self._cluster.maybe_fault("delete", self.kind)
+        self._cluster.count_verb("delete", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f'{self.kind} "{name}" not found')
+            self._index_remove(key, obj)
             # a real apiserver mints a fresh resourceVersion for the
             # DELETED watch event; without it the watch cache could not
             # place the delete after the object's last modification and
@@ -276,18 +404,22 @@ class FakeResourceStore:
         self._cluster._collect_garbage(obj)
 
     def set_status(self, namespace: str, name: str, status: dict) -> dict:
-        """Test helper: overwrite .status directly (as a kubelet would)."""
+        """Test helper: overwrite .status directly (as a kubelet would).
+        Counted as a ``status`` verb — at kubemark scale the kubelet's
+        phase writes dominate apiserver load and must show in the
+        accounting."""
+        self._cluster.count_verb("status", self.kind)
         with self._cluster.lock:
             key = self._key(namespace, name)
             existing = self._objects.get(key)
             if existing is None:
                 raise NotFoundError(f'{self.kind} "{name}" not found')
-            new_obj = copy.deepcopy(existing)
+            new_obj = _copy_obj(existing)
             new_obj["status"] = status
             new_obj["metadata"]["resourceVersion"] = str(self._cluster.next_rv())
             self._objects[key] = new_obj
             self._notify(MODIFIED, new_obj)
-            return copy.deepcopy(new_obj)
+            return _copy_obj(new_obj)
 
 
 def _merge(dst: dict, patch: dict) -> None:
@@ -297,7 +429,7 @@ def _merge(dst: dict, patch: dict) -> None:
         elif v is None:
             dst.pop(k, None)
         else:
-            dst[k] = copy.deepcopy(v)
+            dst[k] = _copy_obj(v)
 
 
 class FakeCluster:
@@ -324,9 +456,21 @@ class FakeCluster:
         "nodes": "Node",
     }
 
-    def __init__(self, fault_plan=None, watch_cache_window: int = 2048):
+    def __init__(self, fault_plan=None, watch_cache_window: int = 2048,
+                 index_labels: Iterable[str] = ()):
         self.lock = threading.RLock()
         self._rv = 0
+        # label keys every store indexes for LIST (see
+        # FakeResourceStore._indexed_keys) — the kubemark tier passes
+        # the job-name label so per-job pod/service lists stay O(gang)
+        # at 50k pods; empty (the default) keeps the plain full scan.
+        self.index_labels: Tuple[str, ...] = tuple(index_labels or ())
+        # per-verb request accounting ("verb Kind" -> count): the sim
+        # tier's equivalent of the stub server's response counters —
+        # deterministic under the virtual clock, which is what lets the
+        # --scale bench assert same-seed runs produce identical load.
+        self._verb_counts: Dict[str, int] = {}
+        self._verb_lock = threading.Lock()
         # per-store watch-cache depth (see FakeResourceStore.changes_since):
         # how many recent mutations stay answerable as a windowed relist
         self.watch_cache_window = max(0, int(watch_cache_window))
@@ -339,6 +483,17 @@ class FakeCluster:
         self.stores: Dict[str, FakeResourceStore] = {
             plural: FakeResourceStore(self, kind) for plural, kind in self.KINDS.items()
         }
+
+    def count_verb(self, verb: str, kind: str) -> None:
+        key = f"{verb} {kind}"
+        with self._verb_lock:
+            self._verb_counts[key] = self._verb_counts.get(key, 0) + 1
+
+    def verb_snapshot(self) -> Dict[str, int]:
+        """Copy of the per-verb request counts (sorted for stable
+        JSON/diff output)."""
+        with self._verb_lock:
+            return dict(sorted(self._verb_counts.items()))
 
     def next_rv(self) -> int:
         self._rv += 1
@@ -438,7 +593,7 @@ class FakeCluster:
                         # copy-on-write, never in place: past versions of
                         # a stored object may be referenced by the watch
                         # cache, which must keep the state AT its event
-                        new_obj = copy.deepcopy(obj)
+                        new_obj = _copy_obj(obj)
                         new_obj["metadata"]["ownerReferences"] = remaining
                         new_obj["metadata"]["resourceVersion"] = str(
                             self.next_rv())

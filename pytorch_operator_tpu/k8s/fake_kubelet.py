@@ -94,6 +94,15 @@ class FakeKubelet:
         # stand-in for SIGTERM -> orbax save -> exit readiness).  None
         # never acks, so drains run to their deadline.
         checkpoint_delay: Optional[float] = 0.02,
+        # Cluster-scale simulator hooks (pytorch_operator_tpu.sim): a
+        # NodeFleet replaces the lazily-minted-node behavior — pods
+        # bind round-robin onto the fleet's fixed node population and
+        # each pod's Pending/Running dwell comes from its node's seeded
+        # latency profile instead of run_delay/complete_delay; a
+        # VirtualClock replaces threading.Timer so every phase
+        # transition fires deterministically in virtual time.
+        fleet=None,
+        clock=None,
     ):
         self.cluster = cluster
         self.run_delay = run_delay
@@ -107,6 +116,8 @@ class FakeKubelet:
             f"{pod['metadata']['name']}: {phase} exit={code}\naccuracy=0.9876\n"
         )
         self.max_nodes = max_nodes
+        self.fleet = fleet
+        self.clock = clock
         self._node_seq = 0
         self._bind_rr = 0
         # Node pool bookkeeping: a deleted pod releases its (still
@@ -127,6 +138,8 @@ class FakeKubelet:
         self._stopped = False
 
     def start(self) -> None:
+        if self.fleet is not None:
+            self.fleet.provision(self.cluster)
         self.cluster.pods.add_listener(self._on_pod_event)
 
     def stop(self) -> None:
@@ -184,6 +197,8 @@ class FakeKubelet:
             frozen = self._capacity_frozen
         if frozen:
             return self._pop_free_node()
+        if self.fleet is not None:
+            return self.fleet.assign()
         if self.max_nodes is None:
             reused = self._pop_free_node()
             return reused if reused is not None else self._provision_node()
@@ -223,6 +238,9 @@ class FakeKubelet:
             node = self._node_of_pod.pop(f"{ns}/{name}", None)
         if node is None:
             return
+        if self.fleet is not None:
+            self.fleet.release(node)
+            return
         try:
             healthy = self._schedulable(
                 self.cluster.nodes.get("default", node))
@@ -234,6 +252,19 @@ class FakeKubelet:
             # a node freed mid-freeze goes straight to a waiting pod —
             # within a dip the surviving capacity keeps circulating
             self._drain_bind_queue()
+
+    def _pod_delays(self, ns: str, name: str):
+        """(run_delay, complete_delay) for one pod: the bound node's
+        fleet profile when a NodeFleet paces this kubelet, the global
+        knobs otherwise."""
+        if self.fleet is None:
+            return self.run_delay, self.complete_delay
+        with self._lock:
+            node = self._node_of_pod.get(f"{ns}/{name}")
+        profile = self.fleet.profile(node) if node else None
+        if profile is None:
+            return self.run_delay, self.complete_delay
+        return profile.run_delay, profile.complete_delay
 
     # -- capacity freeze ---------------------------------------------------
     def freeze_capacity(self) -> None:
@@ -260,7 +291,8 @@ class FakeKubelet:
                 continue  # deleted while waiting: just drop it
             if not self._bind_pod(ns, name, pod):
                 return  # still no capacity: _bind_pod re-queued it
-            self._schedule(f"{ns}/{name}/run", self.run_delay,
+            self._schedule(f"{ns}/{name}/run",
+                           self._pod_delays(ns, name)[0],
                            self._run_pod, ns, name)
 
     # -- chaos injection ---------------------------------------------------
@@ -412,13 +444,15 @@ class FakeKubelet:
         bound = self._bind_pod(ns, name, pod)
         self._set_phase(ns, name, "Pending")
         if bound:
-            self._schedule(f"{ns}/{name}/run", self.run_delay,
+            self._schedule(f"{ns}/{name}/run",
+                           self._pod_delays(ns, name)[0],
                            self._run_pod, ns, name)
 
     def _run_pod(self, ns: str, name: str) -> None:
         self._set_phase(ns, name, "Running")
         self._schedule(
-            f"{ns}/{name}/complete", self.complete_delay, self._complete_pod, ns, name
+            f"{ns}/{name}/complete", self._pod_delays(ns, name)[1],
+            self._complete_pod, ns, name
         )
 
     def _complete_pod(self, ns: str, name: str) -> None:
@@ -491,7 +525,10 @@ class FakeKubelet:
         with self._lock:
             if self._stopped:
                 return
-            timer = threading.Timer(delay, fn, args=args)
-            timer.daemon = True
+            if self.clock is not None:
+                timer = self.clock.timer(delay, fn, args)
+            else:
+                timer = threading.Timer(delay, fn, args=args)
+                timer.daemon = True
             self._timers[key] = timer
             timer.start()

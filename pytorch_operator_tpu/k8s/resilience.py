@@ -375,22 +375,33 @@ def reset_endpoint_breakers() -> None:
 
 
 def build(config: Optional[ResilienceConfig], registry=None,
-          endpoint: Optional[str] = None):
+          endpoint: Optional[str] = None,
+          clock: Optional[Callable[[], float]] = None,
+          sleep: Optional[Callable[[float], None]] = None):
     """(retry_policy, rate_limiter, breaker, metrics) for one client —
     each piece independently None when its knob disables it.  ``None``
     config means 'all defaults' (retries + breaker on, limiter off).
     ``endpoint`` (``host:port``) keys the breaker into the process-wide
     per-endpoint registry; without it the breaker is private to the
-    caller (the pre-PR-7 behavior, kept for direct construction)."""
+    caller (the pre-PR-7 behavior, kept for direct construction).
+    ``clock``/``sleep`` inject one time source into every primitive
+    (the simulator's VirtualClock: backoff sleeps cost virtual time) —
+    note an endpoint-keyed breaker is process-shared and keeps the
+    registry's clock, so virtual-time callers wanting a virtual breaker
+    must skip ``endpoint``."""
     config = config or ResilienceConfig()
+    clock = clock or time.monotonic
+    sleep = sleep or time.sleep
     policy = None
     if config.max_attempts > 1:
         policy = RetryPolicy(
             max_attempts=config.max_attempts,
             base_backoff=config.base_backoff,
             max_backoff=config.max_backoff,
-            deadline=config.deadline)
-    limiter = TokenBucket(config.qps, config.burst) \
+            deadline=config.deadline,
+            clock=clock, sleep=sleep)
+    limiter = TokenBucket(config.qps, config.burst,
+                          clock=clock, sleep=sleep) \
         if config.qps > 0 else None
     breaker = None
     if config.breaker_threshold > 0:
@@ -399,7 +410,8 @@ def build(config: Optional[ResilienceConfig], registry=None,
                 endpoint, config.breaker_threshold, config.breaker_reset)
         else:
             breaker = CircuitBreaker(config.breaker_threshold,
-                                     config.breaker_reset)
+                                     config.breaker_reset,
+                                     clock=clock)
     metrics = ResilienceMetrics(registry, breaker) \
         if registry is not None else None
     return policy, limiter, breaker, metrics

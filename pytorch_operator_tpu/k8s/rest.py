@@ -183,6 +183,14 @@ class RestClient:
         self.rate_limiter = rate_limiter
         self.breaker = breaker
         self.metrics = metrics
+        # Closed-client guard (PR 5/7 residue): the breaker is shared
+        # per ENDPOINT across every client in the process, and a client
+        # being torn down (sockets closing under in-flight requests)
+        # produces local connection errors that say nothing about the
+        # endpoint's health — without the flag they count as breaker
+        # failures and a dying replica can blip its siblings' shared
+        # breaker open (observed in the --shards kill round).
+        self._closed = False
         self.native = None
         from pytorch_operator_tpu import native as _native
 
@@ -300,6 +308,14 @@ class RestClient:
                 status, data, retry_after = self._send_once(
                     method, path, payload, headers)
             except (OSError, HTTPException) as e:
+                if self._closed:
+                    # our own teardown, not the endpoint's health:
+                    # hand back any probe slot, never strike the
+                    # shared breaker, and don't burn retries on a
+                    # client that is going away
+                    if self.breaker is not None:
+                        self.breaker.release_probe()
+                    raise
                 err = e
             except BaseException:
                 # an unexpected local error (not a server answer, not a
@@ -355,6 +371,12 @@ class RestClient:
             if self.metrics is not None:
                 self.metrics.count_retry(verb, transient_reason(err))
             attempt += 1
+
+    def close(self) -> None:
+        """Mark this client closing: local transport errors after this
+        point are attributed to the teardown, not the endpoint (see
+        the closed-client guard in :meth:`request`)."""
+        self._closed = True
 
     def request_text(self, method: str, path: str) -> str:
         """Raw-text request (pod logs endpoint returns plain text)."""
@@ -905,6 +927,7 @@ class RestCluster:
         return snap
 
     def close(self) -> None:
+        self.client.close()
         with self._lock:
             for store in self._stores.values():
                 store.stop_watch()

@@ -18,12 +18,48 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import functools
 import typing
 from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin, get_type_hints
 
 T = TypeVar("T")
 
 _HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+# Per-class (field name, wire name, type hint, hint-is-optional) plans:
+# reflection (dataclasses.fields + get_type_hints + metadata lookups)
+# per call made serde the hottest control-plane path after the store
+# itself — the kubemark tier parses/serializes status trees hundreds of
+# thousands of times per scenario, and the plans never change.
+_PLAN_CACHE: dict[type, list] = {}
+
+
+def _plan(cls: type) -> list:
+    plan = _PLAN_CACHE.get(cls)
+    if plan is None:
+        hints = _hints(cls)
+        plan = [(f.name, _wire_name(f), hints[f.name],
+                 _is_optional(hints[f.name]))
+                for f in dataclasses.fields(cls)]
+        _PLAN_CACHE[cls] = plan
+    return plan
+
+
+@functools.lru_cache(maxsize=None)
+def _type_info(tp: Any):
+    """(kind, unwrapped type, element hint) for one field hint —
+    computed once per distinct hint (typing objects hash)."""
+    tp = _unwrap_optional(tp)
+    if dataclasses.is_dataclass(tp) and isinstance(tp, type):
+        return ("dataclass", tp, None)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return ("list", tp, elem)
+    if origin is dict:
+        args = get_args(tp)
+        return ("dict", tp, args[1] if len(args) == 2 else Any)
+    return ("scalar", tp, None)
 
 
 def camel_case(snake: str) -> str:
@@ -68,8 +104,8 @@ def _encode_value(v: Any) -> Any:
 def to_dict(obj: Any) -> dict:
     """Serialize a dataclass to a camelCase JSON-ready dict."""
     out: dict[str, Any] = {}
-    for f in dataclasses.fields(obj):
-        v = getattr(obj, f.name)
+    for name, wire, _hint, _opt in _plan(type(obj)):
+        v = getattr(obj, name)
         if v is None:
             continue
         encoded = _encode_value(v)
@@ -77,25 +113,21 @@ def to_dict(obj: Any) -> dict:
         # dataclasses that serialized to nothing); keep 0 and False.
         if encoded == "" or (isinstance(encoded, (list, dict)) and not encoded):
             continue
-        out[_wire_name(f)] = encoded
+        out[wire] = encoded
     return out
 
 
 def _decode_value(tp: Any, v: Any) -> Any:
-    tp = _unwrap_optional(tp)
     if v is None:
         return None
-    if dataclasses.is_dataclass(tp) and isinstance(tp, type):
+    kind, tp, elem = _type_info(tp)
+    if kind == "dataclass":
         if not isinstance(v, dict):
             return v
         return from_dict(tp, v)
-    origin = get_origin(tp)
-    if origin in (list, tuple) and isinstance(v, list):
-        (elem,) = get_args(tp) or (Any,)
+    if kind == "list" and isinstance(v, list):
         return [_decode_value(elem, x) for x in v]
-    if origin is dict and isinstance(v, dict):
-        args = get_args(tp)
-        elem = args[1] if len(args) == 2 else Any
+    if kind == "dict" and isinstance(v, dict):
         return {k: _decode_value(elem, x) for k, x in v.items()}
     return v
 
@@ -107,17 +139,15 @@ def from_dict(cls: Type[T], data: Optional[dict]) -> T:
     """
     if data is None:
         data = {}
-    hints = _hints(cls)
     kwargs: dict[str, Any] = {}
-    for f in dataclasses.fields(cls):
-        wire = _wire_name(f)
+    for name, wire, hint, optional in _plan(cls):
         if wire in data:
             value = data[wire]
-            if value is None and not _is_optional(hints[f.name]):
+            if value is None and not optional:
                 # Explicit JSON null on a non-Optional field: keep the
                 # field default rather than violating the type contract.
                 continue
-            kwargs[f.name] = _decode_value(hints[f.name], value)
+            kwargs[name] = _decode_value(hint, value)
     return cls(**kwargs)
 
 

@@ -51,6 +51,12 @@ class StubApiServer:
         # injected-fault counts against what the server actually sent
         self.counters: dict = {}
         self._counters_lock = threading.Lock()
+        # per-verb load/latency accounting by "verb plural" (e.g.
+        # "list pods" -> {count, total_s}): the kubemark tier's answer
+        # to "which verb against which resource is loading the
+        # apiserver" measured AT the server, watch-stream opens counted
+        # with zero latency (their lifetime is not a request latency)
+        self.verb_stats: dict = {}
         # Test hook: while set, active watch streams terminate and new watch
         # requests are refused with 500, simulating an API-server outage /
         # network partition so watch-gap healing can be exercised.
@@ -65,6 +71,14 @@ class StubApiServer:
 
             def _send(self, status: int, body: dict,
                       extra_headers: Optional[dict] = None):
+                acct = getattr(self, "_acct", None)
+                if acct is not None:
+                    # request-scoped: armed by the dispatching verb
+                    # handler, consumed by the response it sends
+                    # (errors included — a slow 409 is a slow request)
+                    self._acct = None
+                    outer.account(acct[0], acct[1],
+                                  time.perf_counter() - acct[2])
                 outer._count(self.command, status)
                 data = json.dumps(body).encode()
                 self.send_response(status)
@@ -125,11 +139,18 @@ class StubApiServer:
                 return outer.cluster.resource(plural)
 
             def do_GET(self):
+                t0 = time.perf_counter()
                 r = self._route()
                 if not r:
                     return
                 store, ns, name, sub, q, plural = r
                 is_watch = q.get("watch", ["false"])[0] == "true"
+                if is_watch:
+                    # stream opens counted, never timed: a watch lives
+                    # as long as the informer, not a request round-trip
+                    outer.account("watch", plural, 0.0)
+                elif sub != "log":
+                    self._acct = ("get" if name else "list", plural, t0)
                 if not is_watch and sub != "log":
                     fault = self._fault("get" if name else "list", plural)
                     if fault is not None:
@@ -368,6 +389,7 @@ class StubApiServer:
                 without touching the store; 'after' faults COMMIT the
                 mutation and then fail the response — the torn-response
                 case the client's retry-ambiguity rules resolve."""
+                self._acct = (verb, plural, time.perf_counter())
                 fault = self._fault(verb, plural)
                 if fault is not None and fault.when == "before":
                     self._error(fault.error)
@@ -445,6 +467,24 @@ class StubApiServer:
         key = f"{method} {status}"
         with self._counters_lock:
             self.counters[key] = self.counters.get(key, 0) + 1
+
+    def account(self, verb: str, plural: str, seconds: float) -> None:
+        key = f"{verb} {plural}"
+        with self._counters_lock:
+            stat = self.verb_stats.get(key)
+            if stat is None:
+                stat = self.verb_stats[key] = {"count": 0, "total_s": 0.0}
+            stat["count"] += 1
+            stat["total_s"] += seconds
+
+    def verb_snapshot(self) -> dict:
+        """{'verb plural': {'count': n, 'total_s': rounded}} — the
+        server-side per-verb load/latency table the --scale and --shards
+        verdicts read."""
+        with self._counters_lock:
+            return {k: {"count": v["count"],
+                        "total_s": round(v["total_s"], 6)}
+                    for k, v in sorted(self.verb_stats.items())}
 
     @property
     def port(self) -> int:

@@ -51,6 +51,12 @@ class InformerMetrics:
             "pytorch_operator_informer_resyncs_total",
             "Completed relist-and-diff resyncs",
             ("informer",)).labels(informer=name)
+        self.windowed_relists = registry.counter_vec(
+            "pytorch_operator_informer_windowed_relists_total",
+            "Resyncs served as a watch-cache delta (cost O(changes in "
+            "the gap)) instead of a full LIST+diff — the GAP-heal path "
+            "at kubemark scale",
+            ("informer",)).labels(informer=name)
         watch_lag = registry.gauge_vec(
             "pytorch_operator_informer_watch_lag_seconds",
             "Seconds since the informer last observed a live watch event "
@@ -448,6 +454,7 @@ class Informer:
                         self._last_rv = changes.resource_version
                 if self._metrics is not None:
                     self._metrics.resyncs.inc()
+                    self._metrics.windowed_relists.inc()
                 return True, None, None
         return False, None, None
 

@@ -22,7 +22,7 @@ The concrete controller supplies job-type specifics through the
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..api.v1 import constants
 from ..k8s import serde
@@ -65,6 +65,7 @@ class JobControllerConfig:
         shard_lease_duration: float = 15.0,
         shard_renew_interval: float = 5.0,
         create_fanout_width: Optional[int] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -107,12 +108,24 @@ class JobControllerConfig:
         # an int gives this controller a private pool of that width,
         # shut down with the controller).
         self.create_fanout_width = create_fanout_width
+        # Injectable time source (sim.VirtualClock.now) honored by the
+        # workqueue's delayed adds, the shard manager's lease
+        # renew/expiry and the disruption handler's drain deadlines —
+        # the cluster-scale simulator runs the whole control plane on
+        # one deterministic virtual timeline through this.  None (the
+        # default) is wall time everywhere, byte-identical to before.
+        self.clock = clock
 
 
-def _make_runtime_core():
+def _make_runtime_core(clock=None):
     """Expectations + workqueue, C++ when available (native/), Python
     otherwise.  PYTORCH_OPERATOR_NATIVE contract via
-    native.resolve_backend (=0 forces Python, =1 hard error)."""
+    native.resolve_backend (=0 forces Python, =1 hard error).  An
+    injected ``clock`` (the simulator's virtual time) forces the Python
+    pair — the native queue's delay heap lives in C++ against the real
+    clock and cannot be driven by a virtual one."""
+    if clock is not None:
+        return ControllerExpectations(), WorkQueue(clock=clock)
     from pytorch_operator_tpu.native import (
         NativeExpectations,
         NativeWorkQueue,
@@ -158,7 +171,8 @@ class JobController:
         self.service_control = ServiceControl(cluster.services, self.recorder,
                                               registry=registry,
                                               executor=self.fanout)
-        self.expectations, self.work_queue = _make_runtime_core()
+        self.expectations, self.work_queue = _make_runtime_core(
+            self.config.clock)
         # shard-runtime registry (populated by the concrete controller
         # when --shard-count > 1): shard index -> an object with a
         # ``queue`` (WorkQueue) and a ``job_informer`` whose store holds

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 from datetime import datetime, timezone
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from pytorch_operator_tpu.k8s.errors import (
     AlreadyExistsError,
@@ -57,6 +57,7 @@ class LeaderElector:
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.lease_store = lease_store
         self.identity = identity
@@ -68,6 +69,10 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.clock = clock
+        # stamped onto the Lease at creation (shard/heartbeat role
+        # labels): lets membership scans LIST with a selector instead
+        # of deserializing every Lease in the namespace
+        self.labels = dict(labels) if labels else None
         self.is_leader = False
         self._stop = threading.Event()
         self._active_stop = self._stop
@@ -89,10 +94,13 @@ class LeaderElector:
 
     def _lease_obj(self) -> dict:
         ts = _micro_time_now()
+        meta: dict = {"name": self.name, "namespace": self.namespace}
+        if self.labels:
+            meta["labels"] = dict(self.labels)
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
-            "metadata": {"name": self.name, "namespace": self.namespace},
+            "metadata": meta,
             "spec": {
                 "holderIdentity": self.identity,
                 "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
@@ -146,6 +154,17 @@ class LeaderElector:
             return False  # holder's record changed within leaseDuration (locally observed)
         ts = _micro_time_now()
         taking_over = holder != self.identity
+        if self.labels:
+            # stamp the role labels on renewal/takeover too, not only
+            # at creation: a Lease minted by a pre-label build must
+            # become selector-visible the moment a labeling build
+            # renews it, or membership scans exclude its replica
+            # forever rather than for one upgrade window
+            meta = lease.setdefault("metadata", {})
+            labels = dict(meta.get("labels") or {})
+            if any(labels.get(k) != v for k, v in self.labels.items()):
+                labels.update(self.labels)
+                meta["labels"] = labels
         lease["spec"] = {
             "holderIdentity": self.identity,
             "leaseDurationSeconds": max(1, int(round(self.lease_duration))),

@@ -11,6 +11,8 @@ import time
 import uuid
 from typing import List, Optional
 
+from ..api.v1.constants import LABEL_SHARD as _LABEL_SHARD
+
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
 
@@ -32,13 +34,21 @@ class EventRecorder:
         meta = obj.get("metadata") or {}
         name = meta.get("name", "unknown")
         namespace = meta.get("namespace", "default")
+        ev_meta: dict = {
+            "name": f"{name}.{uuid.uuid4().hex[:10]}",
+            "namespace": namespace,
+        }
+        # Events inherit the involved object's shard label: a sharded
+        # replica (or dashboard) can then list/watch exactly its own
+        # shards' event traffic with a selector instead of receiving
+        # the whole fleet's stream.
+        shard = (meta.get("labels") or {}).get(_LABEL_SHARD)
+        if shard is not None:
+            ev_meta["labels"] = {_LABEL_SHARD: shard}
         ev = {
             "apiVersion": "v1",
             "kind": "Event",
-            "metadata": {
-                "name": f"{name}.{uuid.uuid4().hex[:10]}",
-                "namespace": namespace,
-            },
+            "metadata": ev_meta,
             "involvedObject": {
                 "apiVersion": obj.get("apiVersion", ""),
                 "kind": obj.get("kind", ""),

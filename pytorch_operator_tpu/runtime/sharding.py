@@ -192,11 +192,21 @@ class ShardManager:
         self.on_acquired = on_acquired
         self.on_released = on_released
         self.clock = clock
+        from ..api.v1 import constants as _constants
+
+        # role labels on every Lease we mint: membership scans LIST
+        # with the heartbeat selector (server-side on the REST tier)
+        # instead of deserializing every Lease in the namespace — at
+        # fleet scale the namespace also holds one Lease per SHARD
+        # plus whatever other controllers keep there
         self._electors: Dict[int, LeaderElector] = {
             i: LeaderElector(
                 lease_store, identity, name=f"{lease_prefix}-{i}",
                 namespace=namespace, lease_duration=lease_duration,
-                renew_interval=renew_interval, clock=clock)
+                renew_interval=renew_interval, clock=clock,
+                labels={_constants.LABEL_LEASE_COMPONENT:
+                        _constants.LEASE_COMPONENT_SHARD,
+                        _constants.LABEL_SHARD: str(i)})
             for i in range(self.shard_count)
         }
         self._heartbeat_name = (
@@ -204,7 +214,9 @@ class ShardManager:
         self._heartbeat = LeaderElector(
             lease_store, identity, name=self._heartbeat_name,
             namespace=namespace, lease_duration=lease_duration,
-            renew_interval=renew_interval, clock=clock)
+            renew_interval=renew_interval, clock=clock,
+            labels={_constants.LABEL_LEASE_COMPONENT:
+                    _constants.LEASE_COMPONENT_HEARTBEAT})
         # replica-lease name -> ((holder, renewTime), locally observed at)
         self._member_obs: Dict[str, Tuple[tuple, float]] = {}
         self._owned: Set[int] = set()
@@ -245,10 +257,23 @@ class ShardManager:
         """Identities of live replicas: every heartbeat Lease whose
         record changed within leaseDuration of local observation, plus
         always this replica itself."""
+        from ..api.v1 import constants as _constants
+
         now = self.clock()
         members = {self.identity}
         try:
-            leases = self.lease_store.list(namespace=self.namespace)
+            # selector-scoped: only heartbeat Leases travel (labeled
+            # at creation AND re-stamped on every renewal, so a
+            # pre-label heartbeat becomes visible within one renew
+            # interval of its replica upgrading).  An unlabeled
+            # heartbeat is invisible only while its owner runs an old
+            # build — that costs fairness (the unseen member's quota),
+            # never safety: shard ownership is still CAS-guarded by
+            # the per-shard Leases themselves.
+            leases = self.lease_store.list(
+                namespace=self.namespace,
+                label_selector={_constants.LABEL_LEASE_COMPONENT:
+                                _constants.LEASE_COMPONENT_HEARTBEAT})
         except ApiError:
             return members
         prefix = f"{self.replica_prefix}-"

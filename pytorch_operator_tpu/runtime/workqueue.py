@@ -12,7 +12,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class WorkQueueMetrics:
@@ -148,9 +148,22 @@ class RateLimiter:
 
 
 class WorkQueue:
-    """Deduplicating FIFO queue with processing-exclusion semantics."""
+    """Deduplicating FIFO queue with processing-exclusion semantics.
 
-    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+    ``clock`` is the time source for the delayed-add machinery
+    (``add_after`` / ``add_rate_limited`` ready times and ``get``'s
+    timeout deadline) — ``time.monotonic`` by default, a
+    :class:`~pytorch_operator_tpu.sim.clock.VirtualClock`'s ``now`` for
+    the deterministic simulator tier.  Under a virtual clock the queue
+    is meant to be DRIVEN, not waited on: callers poll with
+    ``get(timeout=0)`` and advance the clock to ``next_ready_at()`` —
+    a blocking ``get`` would sleep real seconds against a timeline
+    that only moves when the driver advances it.
+    """
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
         self._lock = threading.Condition()
         self._queue: List[Any] = []
         self._dirty: set = set()
@@ -190,7 +203,7 @@ class WorkQueue:
 
     def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
         """Pop the next item. Returns (item, shutdown)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         with self._lock:
             while True:
                 self._drain_ready_locked()
@@ -205,23 +218,23 @@ class WorkQueue:
                     return None, True
                 wait = self._next_wait_locked(deadline)
                 if wait is not None and wait <= 0:
-                    if deadline is not None and time.monotonic() >= deadline:
+                    if deadline is not None and self._clock() >= deadline:
                         return None, False
                     continue
                 if not self._lock.wait(timeout=wait):
-                    if deadline is not None and time.monotonic() >= deadline:
+                    if deadline is not None and self._clock() >= deadline:
                         return None, False
 
     def _next_wait_locked(self, deadline: Optional[float]) -> Optional[float]:
         candidates = []
         if self._waiting:
-            candidates.append(self._waiting[0][0] - time.monotonic())
+            candidates.append(self._waiting[0][0] - self._clock())
         if deadline is not None:
-            candidates.append(deadline - time.monotonic())
+            candidates.append(deadline - self._clock())
         return min(candidates) if candidates else None
 
     def _drain_ready_locked(self) -> None:
-        now = time.monotonic()
+        now = self._clock()
         while self._waiting and self._waiting[0][0] <= now:
             _, seq, item, is_retry = heapq.heappop(self._waiting)
             if is_retry:
@@ -264,6 +277,22 @@ class WorkQueue:
         with self._lock:
             return item in self._dirty
 
+    def next_ready_at(self) -> Optional[float]:
+        """Clock time of the earliest pending delayed add (None when no
+        entry waits).  The simulator's driver advances its VirtualClock
+        to ``min(next timer, next_ready_at)`` instead of sleeping.
+        Superseded/cancelled retry heads are popped for good (their seq
+        can never match again), so the peek is O(1) amortized — the
+        pump calls this every iteration."""
+        with self._lock:
+            while self._waiting:
+                ready_at, seq, item, is_retry = self._waiting[0]
+                if is_retry and self._pending_retry.get(item) != seq:
+                    heapq.heappop(self._waiting)
+                    continue
+                return ready_at
+            return None
+
     # -- delayed / rate-limited adds ---------------------------------------
     def add_after(self, item: Any, delay: float) -> None:
         if delay <= 0:
@@ -275,7 +304,7 @@ class WorkQueue:
             self._seq += 1
             heapq.heappush(
                 self._waiting,
-                (time.monotonic() + delay, self._seq, item, False))
+                (self._clock() + delay, self._seq, item, False))
             self._lock.notify()
 
     def add_rate_limited(self, item: Any) -> None:
@@ -298,7 +327,7 @@ class WorkQueue:
             self._pending_retry[item] = self._seq
             heapq.heappush(
                 self._waiting,
-                (time.monotonic() + delay, self._seq, item, True))
+                (self._clock() + delay, self._seq, item, True))
             self._lock.notify()
 
     def forget(self, item: Any) -> None:

@@ -1,0 +1,35 @@
+"""Cluster-scale simulator: deterministic virtual-time kubemark tier.
+
+The stub apiserver + fake kubelet were always a miniature kubemark;
+this package grows them into a deliberate one (ROADMAP direction 2):
+
+  * :class:`~pytorch_operator_tpu.sim.clock.VirtualClock` — the one
+    injectable time source (generalizing the fake clocks the
+    resilience/sharding test tiers grew ad hoc) honored by the
+    workqueue's delayed adds, lease renew/expiry, retry backoff, drain
+    deadlines and the fake kubelet's phase timers, so a ten-minute
+    convergence scenario runs in seconds of real time and is fully
+    deterministic — seeded, single-threaded, no wall-clock races;
+  * :class:`~pytorch_operator_tpu.sim.fleet.NodeFleet` — thousands of
+    virtual TPU nodes with per-node kubelet latency profiles drawn from
+    a seeded distribution (plus configurable stragglers), replacing the
+    fake kubelet's lazily-minted-node behavior at scale;
+  * :mod:`~pytorch_operator_tpu.sim.scale` — the discrete-event driver
+    that pumps the controller's workqueue and the virtual clock from
+    ONE thread, and the 10k-job / 50k-pod churn scenario behind
+    ``bench_control_plane.py --scale``.
+"""
+
+from .clock import VirtualClock, VirtualTimer
+from .fleet import NodeFleet, NodeProfile
+from .scale import ScaleConfig, run_scale, run_scenario
+
+__all__ = [
+    "NodeFleet",
+    "NodeProfile",
+    "ScaleConfig",
+    "VirtualClock",
+    "VirtualTimer",
+    "run_scale",
+    "run_scenario",
+]

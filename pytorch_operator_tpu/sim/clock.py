@@ -1,0 +1,173 @@
+"""Deterministic virtual time for the cluster-scale simulator.
+
+The resilience and sharding test tiers each grew their own fake clock
+(a mutable ``[now]`` cell passed as ``clock=lambda: now[0]``); this
+module generalizes that into one injectable time source the whole
+control plane can run on:
+
+  * ``clock.now`` (a bound method, directly usable wherever a
+    ``clock=time.monotonic`` parameter is accepted: the workqueue,
+    LeaderElector/ShardManager, RetryPolicy/TokenBucket/CircuitBreaker,
+    the disruption handler's drain deadlines);
+  * ``clock.timer(delay, fn, args)`` — a ``threading.Timer``-shaped
+    handle (``start()`` / ``cancel()`` / assignable ``daemon``) the
+    fake kubelet schedules its phase transitions on;
+  * ``clock.advance_to(t)`` / ``advance(dt)`` — fire every due timer
+    in deterministic ``(due time, registration order)`` order, with
+    ``now()`` observing each timer's own due time while it runs.
+
+Virtual time only moves when the driver advances it, and every callback
+runs on the advancing thread, so a scenario driven through a
+VirtualClock is single-threaded and fully deterministic: same schedule
+in, same event order out — no wall-clock races, no thread scheduling
+jitter.  (The clock is still lock-guarded so incidental cross-thread
+``now()`` reads are safe, but *advancing* from concurrent threads is
+not a supported regime.)
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Callable, List, Optional, Tuple
+
+
+class VirtualTimer:
+    """``threading.Timer``-shaped handle over a VirtualClock deadline.
+
+    Created unarmed; ``start()`` registers it ``delay`` virtual seconds
+    after the clock's *current* time, ``cancel()`` is effective until
+    the timer fires (a cancelled heap entry is skipped on advance).
+    ``daemon`` exists only so call sites that set it on a real Timer
+    need no branching.
+    """
+
+    __slots__ = ("_clock", "_delay", "_fn", "_args", "_cancelled",
+                 "_started", "daemon")
+
+    def __init__(self, clock: "VirtualClock", delay: float,
+                 fn: Callable, args: Tuple = ()):
+        self._clock = clock
+        self._delay = max(0.0, float(delay))
+        self._fn = fn
+        self._args = tuple(args)
+        self._cancelled = False
+        self._started = False
+        self.daemon = True
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._clock._register(self)
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def _fire(self) -> None:
+        if not self._cancelled:
+            self._fn(*self._args)
+
+
+class VirtualClock:
+    """A monotonic virtual timeline with an explicit timer wheel."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._seq = 0
+        # (due, seq, timer) — seq breaks ties deterministically in
+        # registration order, exactly like the workqueue's waiting heap
+        self._timers: List[Tuple[float, int, VirtualTimer]] = []
+        self._lock = threading.RLock()
+
+    # -- reading -----------------------------------------------------------
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    #: alias so ``clock=vclock.monotonic`` reads like the stdlib
+    monotonic = now
+
+    def sleep(self, seconds: float) -> None:
+        """Advance virtual time by ``seconds`` — the ``sleep=`` injection
+        point for RetryPolicy/TokenBucket: a backoff "sleep" costs
+        virtual time only (and fires any timer that falls inside it)."""
+        self.advance(seconds)
+
+    def next_timer(self) -> Optional[float]:
+        """Virtual due time of the earliest pending timer (cancelled
+        entries skipped), or None when the wheel is empty."""
+        with self._lock:
+            while self._timers and self._timers[0][2]._cancelled:
+                heapq.heappop(self._timers)
+            return self._timers[0][0] if self._timers else None
+
+    # -- scheduling --------------------------------------------------------
+    def timer(self, delay: float, fn: Callable,
+              args: Tuple = ()) -> VirtualTimer:
+        """An unarmed ``threading.Timer`` stand-in; call ``start()``."""
+        return VirtualTimer(self, delay, fn, args)
+
+    def call_later(self, delay: float, fn: Callable,
+                   *args) -> VirtualTimer:
+        """Schedule ``fn(*args)`` ``delay`` virtual seconds from now."""
+        t = VirtualTimer(self, delay, fn, args)
+        t.start()
+        return t
+
+    def call_at(self, when: float, fn: Callable, *args) -> VirtualTimer:
+        return self.call_later(max(0.0, when - self.now()), fn, *args)
+
+    def _register(self, timer: VirtualTimer) -> None:
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._timers,
+                           (self._now + timer._delay, self._seq, timer))
+
+    # -- advancing ---------------------------------------------------------
+    def advance(self, dt: float) -> int:
+        return self.advance_to(self.now() + max(0.0, float(dt)))
+
+    def advance_to(self, target: float) -> int:
+        """Move virtual time to ``target``, firing every timer due on the
+        way in (due, registration) order.  ``now()`` reads each timer's
+        own due time while its callback runs — a callback scheduling a
+        relative follow-up (the kubelet's run->complete chain) anchors
+        at its own firing instant, exactly like a real timer thread.
+        Returns the number of callbacks fired.  Callback exceptions
+        propagate to the caller (a deterministic scenario should fail
+        loudly, not tick on with half-applied state)."""
+        fired = 0
+        while True:
+            with self._lock:
+                if target < self._now:
+                    return fired
+                while self._timers and self._timers[0][2]._cancelled:
+                    heapq.heappop(self._timers)
+                if not self._timers or self._timers[0][0] > target:
+                    self._now = max(self._now, target)
+                    return fired
+                due, _seq, timer = heapq.heappop(self._timers)
+                self._now = max(self._now, due)
+            # fire OUTSIDE the lock: callbacks re-enter (schedule,
+            # cancel, read now) freely
+            timer._fire()
+            fired += 1
+
+    def run_until(self, predicate: Callable[[], bool],
+                  max_time: Optional[float] = None) -> bool:
+        """Advance timer by timer until ``predicate()`` holds.  Returns
+        False when the wheel runs dry or virtual ``max_time`` is reached
+        first — the caller decides whether that is a stall or a
+        timeout."""
+        while not predicate():
+            nxt = self.next_timer()
+            if nxt is None:
+                return False
+            if max_time is not None and nxt > max_time:
+                return False
+            self.advance_to(nxt)
+        return True
+
+
+__all__ = ["VirtualClock", "VirtualTimer"]

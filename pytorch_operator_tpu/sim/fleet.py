@@ -1,0 +1,134 @@
+"""NodeFleet: a seeded model of thousands of virtual TPU nodes.
+
+The fake kubelet's default node behavior — lazily mint one fresh node
+per pod, one uniform delay pair for every pod — is the right shape for
+unit tests and exactly the wrong one for a kubemark: a real fleet has a
+FIXED population of nodes, pods pack onto them, and per-node kubelet
+latency varies (and has a tail: stragglers).  This module supplies that
+model:
+
+  * every node gets a :class:`NodeProfile` whose bind/run/complete
+    delays are drawn once from a seeded distribution (uniform jitter
+    around the base delays, with ``straggler_fraction`` of nodes
+    multiplied by ``straggler_factor`` — the slow-VM tail);
+  * assignment is deterministic plain round-robin (O(1); per-node
+    load is tracked for observability and released on pod deletion),
+    so the same seed always packs the same pods onto the same nodes;
+  * ``provision(cluster)`` creates the Node objects so disruption/
+    capacity machinery sees a real fleet.
+
+Plugged into :class:`~pytorch_operator_tpu.k8s.fake_kubelet.FakeKubelet`
+via ``FakeKubelet(cluster, fleet=...)``: the kubelet then binds pods to
+fleet nodes and paces each pod's Pending->Running->terminal walk with
+the node's own profile instead of the global ``run_delay`` /
+``complete_delay``.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class NodeProfile:
+    """One node's kubelet latency profile (virtual seconds)."""
+
+    name: str
+    #: create -> bound + container started (the pod's Pending dwell)
+    run_delay: float
+    #: Running -> terminal decision
+    complete_delay: float
+    straggler: bool = False
+
+
+class NodeFleet:
+    def __init__(
+        self,
+        size: int,
+        seed: int = 0,
+        *,
+        base_run_delay: float = 2.0,
+        base_complete_delay: float = 30.0,
+        jitter: float = 0.5,
+        straggler_fraction: float = 0.02,
+        straggler_factor: float = 8.0,
+        tpu_chips: int = 4,
+        accelerator: str = "tpu-v4-podslice",
+        name_prefix: str = "sim-tpu-node",
+    ):
+        """``jitter`` widens each node's delays by a uniform factor in
+        ``[1, 1 + jitter]``; a straggler's delays are additionally
+        multiplied by ``straggler_factor``.  All randomness comes from
+        ``random.Random(seed)`` at construction — two fleets built with
+        the same arguments are identical, and the seed is the ONLY
+        source of cross-run variation in the scale scenario."""
+        self.size = max(1, int(size))
+        self.seed = int(seed)
+        self.tpu_chips = tpu_chips
+        self.accelerator = accelerator
+        rng = random.Random(self.seed)
+        self._profiles: Dict[str, NodeProfile] = {}
+        self._order: List[str] = []
+        for i in range(self.size):
+            name = f"{name_prefix}-{i}"
+            factor = 1.0 + jitter * rng.random()
+            straggler = rng.random() < straggler_fraction
+            if straggler:
+                factor *= straggler_factor
+            self._profiles[name] = NodeProfile(
+                name=name,
+                run_delay=round(base_run_delay * factor, 6),
+                complete_delay=round(base_complete_delay * factor, 6),
+                straggler=straggler,
+            )
+            self._order.append(name)
+        self._load: Dict[str, int] = {name: 0 for name in self._order}
+        self._rr = 0
+
+    # -- provisioning ------------------------------------------------------
+    def provision(self, cluster) -> None:
+        """Create every fleet node in the cluster's node store (skipping
+        names that already exist, so re-provisioning is idempotent)."""
+        from ..k8s.errors import AlreadyExistsError
+        from ..k8s.fake_kubelet import new_tpu_node
+
+        for name in self._order:
+            try:
+                cluster.nodes.create(
+                    "default",
+                    new_tpu_node(name, tpu_chips=self.tpu_chips,
+                                 accelerator=self.accelerator))
+            except AlreadyExistsError:
+                pass
+
+    # -- assignment --------------------------------------------------------
+    def assign(self) -> str:
+        """Bind one pod: deterministic round-robin over the fleet.
+        O(1) per assignment — at 50k pods a least-loaded scan would be
+        O(pods x nodes) — and round-robin IS balanced packing while the
+        population only grows (the scale scenario's pods terminate in
+        place; deletes call :meth:`release` and the next wrap naturally
+        refills)."""
+        name = self._order[self._rr]
+        self._rr = (self._rr + 1) % self.size
+        self._load[name] += 1
+        return name
+
+    def release(self, name: str) -> None:
+        if name in self._load and self._load[name] > 0:
+            self._load[name] -= 1
+
+    # -- profiles ----------------------------------------------------------
+    def profile(self, name: str) -> Optional[NodeProfile]:
+        return self._profiles.get(name)
+
+    def stragglers(self) -> List[str]:
+        return [n for n, p in self._profiles.items() if p.straggler]
+
+    def __len__(self) -> int:
+        return self.size
+
+
+__all__ = ["NodeFleet", "NodeProfile"]

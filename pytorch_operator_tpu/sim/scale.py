@@ -1,0 +1,311 @@
+"""The kubemark scenario: 10k jobs / 50k pods on one virtual timeline.
+
+This is the discrete-event driver that turns the fake tier into a
+cluster-scale simulator.  Everything runs on ONE thread:
+
+  * the controller is built with ``JobControllerConfig(clock=vclock.now,
+    create_fanout_width=1)`` — its workqueue's delayed adds, drain
+    deadlines and (if sharded) lease clocks all read virtual time, and
+    the create/delete fan-out stays on the calling thread;
+  * the fake kubelet schedules every pod phase transition on the same
+    :class:`~pytorch_operator_tpu.sim.clock.VirtualClock`, paced by a
+    seeded :class:`~pytorch_operator_tpu.sim.fleet.NodeFleet`;
+  * the pump loop alternates "drain every ready workqueue item" with
+    "advance the clock to the next due event" until the scenario
+    converges (all jobs Succeeded) or the virtual deadline passes.
+
+Because the only randomness is the scenario seed and the only time
+source is the virtual clock, two runs with the same seed produce the
+SAME event order — same virtual convergence wall, same per-verb
+apiserver load, same queue-depth trace — while a different seed shifts
+arrivals and kubelet latencies and produces a different (but equally
+reproducible) run.  ``bench_control_plane.py --scale`` asserts exactly
+that before committing a verdict.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .clock import VirtualClock
+from .fleet import NodeFleet
+
+
+@dataclass
+class ScaleConfig:
+    """One scale scenario.  The defaults are the committed bench tier's
+    shape scaled DOWN — the bench passes jobs=10000/nodes=2000; tests
+    use double-digit jobs so the determinism contract stays cheap to
+    assert in tier 1."""
+
+    jobs: int = 100
+    #: Worker replicas per job (each job also runs 1 Master): the
+    #: canonical 10k-job tier uses 4, i.e. 5 pods/job = 50k pods.
+    workers: int = 4
+    nodes: int = 50
+    seed: int = 7
+    #: jobs arrive uniformly (seeded) over this virtual window — churn,
+    #: not a single thundering herd, so queue depth has a shape worth
+    #: plotting
+    arrival_seconds: float = 300.0
+    base_run_delay: float = 2.0
+    base_complete_delay: float = 60.0
+    jitter: float = 0.5
+    straggler_fraction: float = 0.02
+    straggler_factor: float = 8.0
+    queue_sample_interval: float = 5.0
+    max_virtual_seconds: float = 7200.0
+    watch_cache_window: int = 4096
+    namespace: str = "default"
+    #: labels the fake cluster indexes for LIST (per-job pod/service
+    #: lists must stay O(gang) at 50k pods)
+    index_labels: tuple = field(default_factory=tuple)
+
+    def effective_index_labels(self) -> tuple:
+        if self.index_labels:
+            return tuple(self.index_labels)
+        from ..api.v1 import constants
+
+        return (constants.LABEL_JOB_NAME,)
+
+
+def new_scale_job(name: str, workers: int,
+                  namespace: str = "default") -> dict:
+    tmpl = {"spec": {"containers": [{"name": "pytorch",
+                                     "image": "img:1"}]}}
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"pytorchReplicaSpecs": {
+            "Master": {"replicas": 1, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+            "Worker": {"replicas": workers, "restartPolicy": "OnFailure",
+                       "template": tmpl},
+        }},
+    }
+
+
+def pump(controller, clock: VirtualClock, until: Callable[[], bool],
+         max_virtual_seconds: float, queues=None,
+         probe: Optional[Callable[[], None]] = None) -> bool:
+    """Drive the controller and the clock from the calling thread until
+    ``until()`` holds.  Returns False on a stall (no pending timer, no
+    delayed work item — nothing can ever happen again) or when the next
+    event lies beyond the virtual deadline.  ``probe`` (if given) runs
+    after every clock advance, BEFORE the queues drain — the only
+    instant queue depth is observable in a discrete-event run (a
+    timer-driven sampler would always see the post-drain empty
+    queue)."""
+    queues = list(queues) if queues is not None \
+        else [controller.work_queue]
+    deadline = clock.now() + max_virtual_seconds
+
+    def drain() -> None:
+        progressed = True
+        while progressed:
+            progressed = False
+            for q in queues:
+                while len(q) > 0 or (
+                        (ready := q.next_ready_at()) is not None
+                        and ready <= clock.now()):
+                    controller.process_next_work_item(timeout=0, queue=q)
+                    progressed = True
+
+    while True:
+        drain()
+        if until():
+            return True
+        candidates = [clock.next_timer()]
+        candidates.extend(q.next_ready_at() for q in queues)
+        candidates = [c for c in candidates if c is not None]
+        if not candidates:
+            return False  # stalled — fail loudly, not a silent hang
+        target = min(candidates)
+        if target > deadline:
+            return False
+        clock.advance_to(target)
+        if probe is not None:
+            probe()
+
+
+def run_scenario(cfg: ScaleConfig) -> Dict:
+    """One seeded scale run -> its result dict (see keys below).  The
+    result's :func:`fingerprint` is the determinism contract: identical
+    for same-seed runs, different across seeds."""
+    from ..controller import PyTorchController
+    from ..k8s.fake import FakeCluster
+    from ..k8s.fake_kubelet import FakeKubelet
+    from ..metrics.prometheus import Registry
+    from ..runtime.job_controller import JobControllerConfig
+
+    clock = VirtualClock()
+    cluster = FakeCluster(watch_cache_window=cfg.watch_cache_window,
+                          index_labels=cfg.effective_index_labels())
+    fleet = NodeFleet(
+        cfg.nodes, seed=cfg.seed,
+        base_run_delay=cfg.base_run_delay,
+        base_complete_delay=cfg.base_complete_delay,
+        jitter=cfg.jitter,
+        straggler_fraction=cfg.straggler_fraction,
+        straggler_factor=cfg.straggler_factor)
+    kubelet = FakeKubelet(cluster, fleet=fleet, clock=clock)
+    controller = PyTorchController(
+        cluster,
+        config=JobControllerConfig(clock=clock.now,
+                                   create_fanout_width=1),
+        registry=Registry())
+
+    succeeded: set = set()
+
+    def _job_event(event_type: str, obj: dict) -> None:
+        if event_type != "MODIFIED":
+            return
+        for cond in (obj.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Succeeded" \
+                    and cond.get("status") == "True":
+                succeeded.add((obj.get("metadata") or {}).get("name"))
+                return
+
+    cluster.jobs.add_listener(_job_event)
+
+    # seeded arrival process: one creation timer per job, spread over
+    # the arrival window (sorted so heap insertion order is by time —
+    # determinism does not depend on it, readability of traces does)
+    rng = random.Random(cfg.seed)
+    arrivals = sorted(rng.uniform(0.0, cfg.arrival_seconds)
+                      for _ in range(cfg.jobs))
+
+    def _create(index: int) -> None:
+        cluster.jobs.create(
+            cfg.namespace,
+            new_scale_job(f"scale-{index:05d}", cfg.workers,
+                          cfg.namespace))
+
+    # queue-depth-over-time trace: the pump probes depth right after
+    # every clock advance (events just landed, drain not yet run) and
+    # each sample bucket keeps its interval's MAX depth + the pod count
+    buckets: Dict[int, List[int]] = {}
+
+    def _probe() -> None:
+        idx = int(clock.now() // cfg.queue_sample_interval)
+        depth = len(controller.work_queue)
+        pods = len(cluster.pods)
+        cur = buckets.get(idx)
+        if cur is None:
+            buckets[idx] = [depth, pods]
+        else:
+            cur[0] = max(cur[0], depth)
+            cur[1] = max(cur[1], pods)
+
+    # syncs per sample interval — the load-over-time signal that stays
+    # meaningful in a discrete-event run (depth rarely exceeds 1 when
+    # every reconcile costs zero virtual time; the sync RATE is where
+    # the churn shape shows)
+    sync_buckets: Dict[int, int] = {}
+    inner_process = controller.process_next_work_item
+
+    def _counting_process(timeout=None, queue=None):
+        idx = int(clock.now() // cfg.queue_sample_interval)
+        sync_buckets[idx] = sync_buckets.get(idx, 0) + 1
+        return inner_process(timeout=timeout, queue=queue)
+
+    controller.process_next_work_item = _counting_process
+
+    t_real = time.perf_counter()
+    kubelet.start()
+    controller.start_informers()
+    for index, at in enumerate(arrivals):
+        clock.call_at(at, _create, index)
+
+    expected_pods = cfg.jobs * (cfg.workers + 1)
+    try:
+        converged = pump(
+            controller, clock,
+            until=lambda: len(succeeded) >= cfg.jobs,
+            max_virtual_seconds=cfg.max_virtual_seconds,
+            probe=_probe)
+    finally:
+        cluster.jobs.remove_listener(_job_event)
+        kubelet.stop()
+        controller.shutdown()
+    samples = [
+        (round(idx * cfg.queue_sample_interval, 3), depth, pods,
+         sync_buckets.get(idx, 0))
+        for idx, (depth, pods) in sorted(buckets.items())]
+
+    real_wall = time.perf_counter() - t_real
+    depths = [d for _, d, _, _ in samples] or [0]
+    syncs = [n for _, _, _, n in samples] or [0]
+    return {
+        "jobs": cfg.jobs,
+        "workers": cfg.workers,
+        "nodes": cfg.nodes,
+        "seed": cfg.seed,
+        "converged": converged,
+        "succeeded": len(succeeded),
+        "virtual_wall_s": round(clock.now(), 3),
+        "real_wall_s": round(real_wall, 3),
+        "speedup_virtual_over_real": (
+            round(clock.now() / real_wall, 1) if real_wall > 0 else None),
+        "expected_pods": expected_pods,
+        "pods_total": len(cluster.pods),
+        "services_total": len(cluster.services),
+        "pods_match_expected": len(cluster.pods) == expected_pods,
+        "straggler_nodes": len(fleet.stragglers()),
+        "verb_counts": cluster.verb_snapshot(),
+        "queue_depth": {
+            "max": max(depths),
+            "mean": round(sum(depths) / len(depths), 2),
+            "samples": len(samples),
+        },
+        "syncs_total": sum(syncs),
+        "syncs_per_interval_max": max(syncs),
+        "queue_sample_interval_s": cfg.queue_sample_interval,
+        "queue_depth_samples": samples,
+    }
+
+
+def fingerprint(result: Dict) -> Dict:
+    """The determinism-relevant projection of one run: everything here
+    must be byte-identical for two runs of the same seed (wall-clock
+    fields and the real/virtual speedup are deliberately excluded)."""
+    return {
+        "virtual_wall_s": result["virtual_wall_s"],
+        "verb_counts": result["verb_counts"],
+        "queue_depth_samples": result["queue_depth_samples"],
+        "pods_total": result["pods_total"],
+        "services_total": result["services_total"],
+        "succeeded": result["succeeded"],
+    }
+
+
+def run_scale(cfg: ScaleConfig,
+              alt_seed: Optional[int] = None) -> Dict:
+    """The full determinism-checked tier: the scenario at ``cfg.seed``
+    TWICE (fingerprints must match exactly) and once at ``alt_seed``
+    (fingerprint must differ — the seed is genuinely load-bearing, the
+    determinism is not an accident of ignoring it).  This is what
+    ``bench_control_plane.py --scale`` runs and what the slow-marked
+    10k test asserts."""
+    if alt_seed is None:
+        alt_seed = cfg.seed + 1
+    first = run_scenario(cfg)
+    repeat = run_scenario(cfg)
+    alt_cfg = ScaleConfig(**{**cfg.__dict__, "seed": alt_seed})
+    alt = run_scenario(alt_cfg)
+    deterministic = fingerprint(first) == fingerprint(repeat)
+    seed_sensitive = fingerprint(first) != fingerprint(alt)
+    return {
+        "runs": [first, repeat, alt],
+        "deterministic": deterministic,
+        "seed_sensitive": seed_sensitive,
+        "converged": all(r["converged"] for r in (first, repeat, alt)),
+    }
+
+
+__all__ = ["ScaleConfig", "fingerprint", "new_scale_job", "pump",
+           "run_scale", "run_scenario"]

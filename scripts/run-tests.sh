@@ -8,6 +8,18 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# --scale additionally runs the cluster-scale simulator's slow tier
+# (the full 10k-job / 50k-pod determinism check, pytest -m slow) after
+# the regular gate — kept out of the default run so CI stays inside
+# its time budget.
+RUN_SCALE=0
+for arg in "$@"; do
+  case "$arg" in
+    --scale) RUN_SCALE=1 ;;
+    *) echo "unknown argument: $arg (supported: --scale)" >&2; exit 2 ;;
+  esac
+done
+
 echo "=== build: native runtime core ==="
 make -C native
 
@@ -33,14 +45,16 @@ else
 fi
 
 echo "=== tests ==="
+# slow tiers (the 10k-job scale simulation) stay out of the default
+# gate; opt in with --scale
 if python -c "import pytest_cov" >/dev/null 2>&1; then
-  python -m pytest tests/ -q --cov=pytorch_operator_tpu --cov-report=term
+  python -m pytest tests/ -q -m "not slow" --cov=pytorch_operator_tpu --cov-report=term
 elif python -m coverage --version >/dev/null 2>&1; then
-  python -m coverage run -m pytest tests/ -q
+  python -m coverage run -m pytest tests/ -q -m "not slow"
   python -m coverage report --include="pytorch_operator_tpu/*"
 else
   echo "(coverage tooling not in image — running plain pytest)"
-  python -m pytest tests/ -q
+  python -m pytest tests/ -q -m "not slow"
 fi
 
 echo "=== sanitize: native core under ASan+UBSan ==="
@@ -63,5 +77,10 @@ fi
 
 echo "=== driver compile checks ==="
 python __graft_entry__.py 8
+
+if [ "$RUN_SCALE" = 1 ]; then
+  echo "=== cluster-scale simulator: slow 10k tier ==="
+  python -m pytest tests/test_sim.py -q -m slow
+fi
 
 echo "all checks passed"

@@ -671,3 +671,67 @@ def test_operator_resilience_flags_parse():
     # the historical spellings stay valid
     legacy = build_parser().parse_args(["--qps", "9", "--burst", "18"])
     assert legacy.qps == 9.0 and legacy.burst == 18
+
+
+# ---------------------------------------------------------------------------
+# Closed-client guard on the shared per-endpoint breaker (ISSUE 8
+# satellite: the --shards kill round's benign blip)
+# ---------------------------------------------------------------------------
+
+
+class TestClosedClientBreakerGuard:
+    @staticmethod
+    def _dead_port() -> int:
+        """A port nothing listens on: connects are REFUSED instantly
+        (a merely-stopped stub server still has a bound socket whose
+        backlog accepts and then hangs the request)."""
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        return port
+
+    def test_closing_clients_errors_never_strike_the_shared_breaker(self):
+        port = self._dead_port()
+        cfg = ResilienceConfig(max_attempts=1, breaker_threshold=2,
+                               breaker_reset=60.0)
+        dying = RestCluster(KubeConfig("127.0.0.1", port),
+                            resilience=cfg)
+        survivor = RestCluster(KubeConfig("127.0.0.1", port),
+                               resilience=cfg)
+        # same endpoint + same knobs -> ONE shared breaker
+        assert dying.breaker is survivor.breaker
+
+        dying.close()  # teardown begins: its errors are OUR fault
+        for _ in range(5):
+            with pytest.raises(Exception):
+                dying.pods.list("default")
+        snap = survivor.breaker.snapshot()
+        assert snap["state"] == "closed"
+        assert snap["consecutive_failures"] == 0
+
+        # sanity: the NOT-closing client's identical failures do strike
+        for _ in range(2):
+            with pytest.raises(Exception):
+                survivor.pods.list("default")
+        assert survivor.breaker.state == "open"
+        survivor.close()
+
+    def test_closed_client_does_not_burn_retries_on_teardown(self):
+        """A closing client's connection error raises immediately —
+        retry sleeps against a dying socket only slow teardown down."""
+        cluster = RestCluster(
+            KubeConfig("127.0.0.1", self._dead_port()),
+            resilience=ResilienceConfig(max_attempts=4,
+                                        base_backoff=5.0,
+                                        breaker_threshold=0))
+        cluster.close()
+        import time as _time
+
+        t0 = _time.monotonic()
+        with pytest.raises(Exception):
+            cluster.pods.list("default")
+        # no backoff sleeps were paid (4 attempts x 5s base otherwise)
+        assert _time.monotonic() - t0 < 2.0

@@ -436,3 +436,38 @@ def test_bench_chaos_tier_smoke(monkeypatch):
     assert res["gang_restarts"] == 2
     assert res["restart_latency"]["count"] == 2
     assert res["recovery_wall_s"] > 0
+
+
+def test_bench_scale_tier_smoke(monkeypatch, tmp_path):
+    """ISSUE 8: the cluster-scale simulator tier must run end to end at
+    a small size — same-seed runs byte-identical, alt-seed run
+    different, and the section updater rewriting only its delimited
+    region of the bench markdown."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    res = bcp.run_scale_tier(jobs=20, workers=2, nodes=6, seed=7,
+                             alt_seed=8, arrival_s=40.0,
+                             max_virtual_s=3600.0)
+    assert res["converged"], res
+    assert res["deterministic"], "same-seed fingerprints diverged"
+    assert res["seed_sensitive"], "alt seed produced an identical run"
+    first = res["runs"][0]
+    assert first["pods_total"] == first["expected_pods"] == 60
+    assert first["verb_counts"]["create Pod"] == 60
+    assert first["virtual_wall_s"] > 0
+
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched\n<!-- shards:begin -->old"
+                  "<!-- shards:end -->\n")
+    bcp.update_md_section(str(md), bcp.SCALE_BEGIN, bcp.SCALE_END,
+                          bcp.render_scale_md(res, 20, 2, 6, 7, 8))
+    text = md.read_text()
+    assert "untouched" in text
+    assert "<!-- shards:begin -->old<!-- shards:end -->" in text
+    assert "Scale verdict" in text
+    assert text.count(bcp.SCALE_BEGIN) == 1
+    # re-running the updater replaces, never appends
+    bcp.update_md_section(str(md), bcp.SCALE_BEGIN, bcp.SCALE_END,
+                          bcp.render_scale_md(res, 20, 2, 6, 7, 8))
+    assert md.read_text().count(bcp.SCALE_BEGIN) == 1

@@ -694,3 +694,203 @@ def test_shard_handoff_under_churn_zero_duplicate_creates():
             rest.close()
         kubelet.stop()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8 satellites: per-shard PodNodeIndex union, shard-labeled
+# events and leases
+
+
+class TestPodNodeIndexUnion:
+    def test_union_merges_per_shard_buckets(self):
+        from pytorch_operator_tpu.disruption.watcher import (
+            PodNodeIndex,
+            PodNodeIndexUnion,
+        )
+
+        union = PodNodeIndexUnion()
+        clusters = [FakeCluster(), FakeCluster()]
+        for shard, cluster in enumerate(clusters):
+            informer = Informer(cluster.pods)
+            union.add_index(shard, PodNodeIndex(informer))
+            informer.start()
+            cluster.pods.create("default", {
+                "metadata": {"name": f"s{shard}-pod"},
+                "spec": {"nodeName": "node-x"}})
+        names = {p["metadata"]["name"] for p in union.pods_on("node-x")}
+        assert names == {"s0-pod", "s1-pod"}
+        union.remove_index(1)
+        names = {p["metadata"]["name"] for p in union.pods_on("node-x")}
+        assert names == {"s0-pod"}
+        assert union.node_count() == 1
+
+    def test_sharded_disruption_resolves_through_the_union(self):
+        """The PR 7 tail: sharded replicas used to fall back to
+        cluster-wide pod LISTs for disruption resolution (pod_index was
+        None).  Now the union of per-shard indexes backs both watchers,
+        and a taint still produces exactly one proactive gang restart —
+        with the node's pods resolved from informer state, zero
+        apiserver LISTs."""
+        from pytorch_operator_tpu.controller import PyTorchController
+
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster, decide=lambda pod: None)  # park
+        kubelet.start()
+        cfg = JobControllerConfig(
+            shard_count=2, replica_id="union-repl",
+            shard_lease_duration=1.0, shard_renew_interval=0.05,
+            enable_disruption_handling=True)
+        ctl = PyTorchController(cluster, config=cfg, registry=Registry())
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        try:
+            assert wait_for(lambda: ctl.owned_shards() == {0, 1})
+            # disruption resolution rides the union (owned-shard
+            # scope is exactly right there); capacity occupancy keeps
+            # the authoritative cluster fallback — a node hosting
+            # another shard's pods must not read as free
+            assert ctl._pod_index_union is not None
+            assert ctl.disruption_watcher.pod_index \
+                is ctl._pod_index_union
+            assert ctl.capacity_watcher.pod_index is None
+            assert ctl.capacity_watcher.cluster is cluster
+            # TPU-requesting template: gang semantics (tpu_auto_gang)
+            # are what make the proactive restart eligible
+            job = new_job("union-job", workers=1)
+            for spec in job["spec"]["pytorchReplicaSpecs"].values():
+                spec["template"]["spec"]["containers"][0]["resources"] = {
+                    "limits": {"google.com/tpu": "4"}}
+            cluster.jobs.create("default", job)
+            assert wait_for(lambda: len([
+                p for p in cluster.pods.list("default")
+                if (p.get("status") or {}).get("phase") == "Running"])
+                == 2, timeout=15)
+            worker = next(p for p in cluster.pods.list("default")
+                          if "worker" in p["metadata"]["name"])
+            node = worker["spec"]["nodeName"]
+            uids_before = {p["metadata"]["uid"]
+                           for p in cluster.pods.list("default")}
+            # the union resolves the node's pods from per-shard state
+            assert wait_for(lambda: any(
+                p["metadata"]["name"] == worker["metadata"]["name"]
+                for p in ctl._pod_index_union.pods_on(node)))
+            kubelet.taint_node(node)
+            assert wait_for(
+                lambda: ctl.preemption_gang_restarts_counter.value == 1,
+                timeout=15)
+            # the proactive restart recreated the WHOLE gang
+            assert wait_for(lambda: (
+                len(cluster.pods.list("default")) == 2
+                and {p["metadata"]["uid"]
+                     for p in cluster.pods.list("default")}
+                .isdisjoint(uids_before)), timeout=15)
+        finally:
+            stop.set()
+            ctl.shutdown()
+            kubelet.stop()
+
+
+class TestShardLabeledEventsAndLeases:
+    def test_events_inherit_the_involved_jobs_shard_label(self):
+        from pytorch_operator_tpu.controller import PyTorchController
+
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)
+        kubelet.start()
+        cfg = JobControllerConfig(
+            shard_count=2, replica_id="ev-repl",
+            shard_lease_duration=1.0, shard_renew_interval=0.05)
+        ctl = PyTorchController(cluster, config=cfg, registry=Registry())
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        try:
+            assert wait_for(lambda: ctl.owned_shards() == {0, 1})
+            cluster.jobs.create("default", new_job("ev-job"))
+            assert wait_for(lambda: _condition_true(
+                cluster.jobs.get("default", "ev-job"), "Succeeded"),
+                timeout=20)
+            job = cluster.jobs.get("default", "ev-job")
+            shard = job["metadata"]["labels"][constants.LABEL_SHARD]
+            events = cluster.events.list("default")
+            assert events, "the lifecycle should have emitted events"
+            for ev in events:
+                assert (ev["metadata"].get("labels") or {}).get(
+                    constants.LABEL_SHARD) == shard
+            # a shard-selector list isolates exactly this shard's stream
+            assert cluster.events.list(
+                "default", {constants.LABEL_SHARD: shard}) == events
+        finally:
+            stop.set()
+            ctl.shutdown()
+            kubelet.stop()
+
+    def test_shard_and_heartbeat_leases_carry_role_labels(self):
+        cluster = FakeCluster()
+        store = cluster.resource("leases")
+        manager = ShardManager(store, "lbl-repl", 2,
+                               lease_duration=5.0, renew_interval=1.0)
+        manager.tick()
+        try:
+            shard_lease = store.get("default", "pytorch-operator-shard-0")
+            labels = shard_lease["metadata"]["labels"]
+            assert labels[constants.LABEL_LEASE_COMPONENT] == \
+                constants.LEASE_COMPONENT_SHARD
+            assert labels[constants.LABEL_SHARD] == "0"
+            hb = store.get("default",
+                           "pytorch-operator-replica-lbl-repl")
+            assert hb["metadata"]["labels"][
+                constants.LABEL_LEASE_COMPONENT] == \
+                constants.LEASE_COMPONENT_HEARTBEAT
+        finally:
+            manager.stop()
+
+    def test_pre_label_lease_is_stamped_on_renewal(self):
+        """Upgrade path: a Lease minted by a pre-label build gains the
+        role labels the first time a labeling build renews it — its
+        replica must not stay selector-invisible forever."""
+        store = FakeCluster().resource("leases")
+        store.create("default", {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "pytorch-operator-replica-old"},
+            "spec": {"holderIdentity": "old-build",
+                     "leaseDurationSeconds": 5,
+                     "renewTime": "2020-01-01T00:00:00.000000Z"}})
+        elector = LeaderElector(
+            store, "old-build", name="pytorch-operator-replica-old",
+            lease_duration=5.0,
+            labels={constants.LABEL_LEASE_COMPONENT:
+                    constants.LEASE_COMPONENT_HEARTBEAT})
+        assert elector.try_acquire_or_renew()
+        lease = store.get("default", "pytorch-operator-replica-old")
+        assert lease["metadata"]["labels"][
+            constants.LABEL_LEASE_COMPONENT] == \
+            constants.LEASE_COMPONENT_HEARTBEAT
+
+    def test_live_members_scans_only_labeled_heartbeats(self):
+        """Membership LISTs with the heartbeat selector: shard leases,
+        third-party leases and pre-label heartbeats no longer travel
+        (nor count).  Safety is unaffected — shard ownership stays
+        CAS-guarded by the per-shard Leases themselves."""
+        cluster = FakeCluster()
+        store = cluster.resource("leases")
+        # a third-party lease and an UNLABELED old-build heartbeat
+        for name in ("some-other-controller",
+                     "pytorch-operator-replica-ghost"):
+            store.create("default", {
+                "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": name},
+                "spec": {"holderIdentity": "ghost",
+                         "leaseDurationSeconds": 3600,
+                         "renewTime": "2099-01-01T00:00:00.000000Z"}})
+        m1 = ShardManager(store, "m1", 4, lease_duration=5.0,
+                          renew_interval=1.0)
+        m2 = ShardManager(store, "m2", 4, lease_duration=5.0,
+                          renew_interval=1.0)
+        m1.tick()
+        m2.tick()
+        try:
+            assert m1.live_members() == {"m1", "m2"}
+            assert m2.live_members() == {"m1", "m2"}
+        finally:
+            m1.stop()
+            m2.stop()
