@@ -1,0 +1,163 @@
+// ThreadSanitizer stress harness for the native runtime core.
+//
+// A dedicated binary, not a Python host: LD_PRELOADing an instrumented
+// .so under an uninstrumented CPython would drown real races in
+// interpreter false positives (TSan must see every thread's birth).
+// Instead this links the same objects the .so is built from, compiled
+// with -fsanitize=thread, and hammers the three thread-safe subsystems
+// the C ABI promises (tpu_operator.h: "All functions are thread-safe"):
+//
+//   * workqueue  — producers add/add_after/add_rate_limited while
+//     consumers get/done/forget and a poller reads len/is_dirty/
+//     num_requeues, then a late shutdown races the final gets;
+//   * expectations — writers expect/raise against observers decrementing
+//     and a poller calling exp_satisfied/exp_get;
+//   * store      — concurrent st_set/st_get/st_delete/st_keys over a
+//     small hot key space (malloc'd return buffers freed by the reader).
+//
+// Exit code 0 means TSan saw no data race (halt_on_error aborts
+// non-zero otherwise).  Bounded: every loop is iteration-counted, and
+// blocking wq_get calls use short timeouts, so the binary finishes in
+// a couple of seconds even under TSan's ~5-15x slowdown.
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpu_operator.h"
+
+namespace {
+
+constexpr int kProducers = 4;
+constexpr int kConsumers = 4;
+constexpr int kItemsPerProducer = 400;
+constexpr int kHotKeys = 16;
+
+void workqueue_stress() {
+  void* q = wq_new(0.0005, 0.01);
+  std::atomic<int> consumed{0};
+
+  std::vector<std::thread> threads;
+  for (int p = 0; p < kProducers; ++p) {
+    threads.emplace_back([q, p] {
+      char item[64];
+      for (int i = 0; i < kItemsPerProducer; ++i) {
+        std::snprintf(item, sizeof(item), "ns/job-%d", (p * 7 + i) % kHotKeys);
+        switch (i % 3) {
+          case 0: wq_add(q, item); break;
+          case 1: wq_add_after(q, item, 0.0005); break;
+          default: wq_add_rate_limited(q, item); break;
+        }
+      }
+    });
+  }
+  for (int c = 0; c < kConsumers; ++c) {
+    threads.emplace_back([q, &consumed] {
+      char buf[128];
+      for (;;) {
+        int rc = wq_get(q, 0.05, buf, sizeof(buf));
+        if (rc == -1) return;  // shut down
+        if (rc == 0) {
+          // timed out: queue may be drained (dedupe collapses the hot
+          // key space hard) — keep polling until shutdown
+          continue;
+        }
+        wq_is_dirty(q, buf);
+        if (consumed.fetch_add(1) % 5 == 0) {
+          wq_add_rate_limited(q, buf);  // requeue while still processing
+          wq_num_requeues(q, buf);
+        } else {
+          wq_forget(q, buf);
+        }
+        wq_done(q, buf);
+      }
+    });
+  }
+  threads.emplace_back([q] {
+    for (int i = 0; i < 2000; ++i) wq_len(q);
+    wq_shutdown(q);
+  });
+  for (auto& t : threads) t.join();
+  wq_free(q);
+}
+
+void expectations_stress() {
+  void* e = exp_new(0.001);  // tiny TTL so expiry races the observers
+  std::vector<std::thread> threads;
+  for (int w = 0; w < 3; ++w) {
+    threads.emplace_back([e, w] {
+      char key[64];
+      for (int i = 0; i < 600; ++i) {
+        std::snprintf(key, sizeof(key), "ns/job-%d/pods", i % kHotKeys);
+        if ((i + w) % 2 == 0)
+          exp_expect_creations(e, key, 3);
+        else
+          exp_expect_deletions(e, key, 3);
+        exp_raise(e, key, 1, 0);
+        if (i % 11 == 0) exp_delete(e, key);
+      }
+    });
+  }
+  for (int o = 0; o < 3; ++o) {
+    threads.emplace_back([e] {
+      char key[64];
+      int adds, dels;
+      double age;
+      for (int i = 0; i < 600; ++i) {
+        std::snprintf(key, sizeof(key), "ns/job-%d/pods", i % kHotKeys);
+        exp_creation_observed(e, key);
+        exp_deletion_observed(e, key);
+        exp_satisfied(e, key);
+        exp_get(e, key, &adds, &dels, &age);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  exp_free(e);
+}
+
+void store_stress() {
+  void* s = st_new();
+  std::vector<std::thread> threads;
+  for (int w = 0; w < 4; ++w) {
+    threads.emplace_back([s, w] {
+      char key[64], rv[16];
+      for (int i = 0; i < 500; ++i) {
+        std::snprintf(key, sizeof(key), "ns/pod-%d", i % kHotKeys);
+        std::snprintf(rv, sizeof(rv), "%d", w * 1000 + i);
+        st_set(s, key, rv, "{\"kind\":\"Pod\"}");
+        if (i % 7 == 0) st_delete(s, key);
+      }
+    });
+  }
+  for (int r = 0; r < 4; ++r) {
+    threads.emplace_back([s] {
+      char key[64];
+      for (int i = 0; i < 500; ++i) {
+        std::snprintf(key, sizeof(key), "ns/pod-%d", i % kHotKeys);
+        if (char* json = st_get(s, key)) st_buf_free(json);
+        if (char* rv = st_get_rv(s, key)) st_buf_free(rv);
+        if (i % 19 == 0) {
+          if (char* keys = st_keys(s)) st_buf_free(keys);
+        }
+        st_len(s);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  st_free(s);
+}
+
+}  // namespace
+
+int main() {
+  workqueue_stress();
+  expectations_stress();
+  store_stress();
+  std::printf("tsan_stress: OK\n");
+  return 0;
+}

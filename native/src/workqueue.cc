@@ -22,6 +22,23 @@ namespace {
 
 using Clock = std::chrono::steady_clock;
 
+// Timed condvar wait against a steady_clock deadline, issued on the
+// system_clock overload.  libstdc++ maps a steady_clock wait_until to
+// pthread_cond_clockwait, which older libtsan builds (GCC 10) do not
+// intercept: every timed wait then reads as a phantom "double lock of
+// a mutex" AND hides the real unlock/relock handoff inside the wait
+// from the race detector.  Waiting on system_clock routes through the
+// intercepted pthread_cond_timedwait instead.  Callers loop and
+// re-check their steady deadline, so a realtime clock jump costs at
+// most a spurious wakeup or one late recheck, never a wrong result.
+std::cv_status WaitUntilSteady(std::condition_variable& cv,
+                               std::unique_lock<std::mutex>& lk,
+                               Clock::time_point deadline) {
+  const auto rel = deadline - Clock::now();
+  if (rel <= Clock::duration::zero()) return std::cv_status::timeout;
+  return cv.wait_until(lk, std::chrono::system_clock::now() + rel);
+}
+
 struct Waiting {
   Clock::time_point ready_at;
   uint64_t seq;
@@ -121,7 +138,7 @@ class WorkQueue {
       if (wake == Clock::time_point::max()) {
         cv_.wait(lk);
       } else {
-        if (cv_.wait_until(lk, wake) == std::cv_status::timeout &&
+        if (WaitUntilSteady(cv_, lk, wake) == std::cv_status::timeout &&
             !forever && Clock::now() >= deadline) {
           // drain anything that became ready exactly at the deadline
           DrainReadyLocked();

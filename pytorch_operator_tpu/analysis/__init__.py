@@ -1,0 +1,36 @@
+"""Concurrency & determinism analysis layer.
+
+The control plane is a heavily threaded, clock-injected system whose
+correctness rests on invariants no test tier can reliably surface:
+
+  * "no wall clock in a clock-injectable path" — one raw
+    ``time.monotonic()`` silently breaks the simulator's same-seed
+    determinism guarantee;
+  * "never builtin ``hash()`` for shard placement or cache keys" —
+    PYTHONHASHSEED would reshard the fleet per restart;
+  * "no blocking I/O while holding a lock" — a sleep or socket call
+    inside a ``with lock:`` body convoys every other thread;
+  * "one consistent lock order across controller/disruption/sharding" —
+    an inverted pair is a latent deadlock that strikes only under
+    production interleavings.
+
+This package is the checking machinery itself:
+
+  * :mod:`.rules` + :mod:`.engine` — an AST rule engine with per-line
+    pragma waivers (``# lint: wall-clock-ok <reason>``) run by
+    ``scripts/lint.py`` and the ``tests/test_analysis.py`` tree-wide
+    cleanliness assertion;
+  * :mod:`.witness` — a runtime lock-order witness: instrumented
+    Lock/RLock factories the runtime's locks are built through, which
+    (when enabled) record the per-thread lock-acquisition graph and
+    report any cycle with the two offending acquisition stacks.
+"""
+
+from .engine import Finding, scan_file, scan_paths, scan_tree  # noqa: F401
+from .witness import (  # noqa: F401
+    make_lock,
+    make_rlock,
+    witness_active,
+    enable_witness,
+    disable_witness,
+)

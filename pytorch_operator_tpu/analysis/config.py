@@ -1,0 +1,77 @@
+"""Rule scoping for the repo's own tree.
+
+Rules carry different blast radii: wall-clock usage is only a bug in
+modules whose time source is injectable (the simulator drives them on a
+VirtualClock), while builtin ``hash()`` and unseeded ``random`` are
+wrong anywhere in the operator package.  The scopes below are
+path-prefix matches against POSIX-style paths relative to the repo
+root; tests construct their own :class:`AnalysisConfig` to exercise
+rules on fixture snippets without caring where the tmpdir lives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence, Tuple
+
+#: Modules that accept an injected clock (``clock=`` / ``sleep=`` /
+#: ``VirtualClock.timer``) somewhere in their construction chain — a raw
+#: wall-clock call here either bypasses the injection (breaking the
+#: simulator's same-seed determinism) or marks a path the injection has
+#: not reached yet.  The sim package itself is excluded: it IS the
+#: clock, and its driver deliberately measures real wall time to report
+#: the simulator's leverage (virtual vs real seconds).
+CLOCK_INJECTABLE: Tuple[str, ...] = (
+    "pytorch_operator_tpu/runtime/",
+    "pytorch_operator_tpu/controller/",
+    "pytorch_operator_tpu/disruption/",
+    "pytorch_operator_tpu/k8s/resilience.py",
+    "pytorch_operator_tpu/k8s/fake_kubelet.py",
+    "pytorch_operator_tpu/native/__init__.py",
+)
+
+#: Modules on the reconcile path, where a silently swallowed exception
+#: turns a failed sync into a wedged job (no requeue, no event, no log
+#: line to find it by).
+RECONCILE_PATHS: Tuple[str, ...] = (
+    "pytorch_operator_tpu/controller/",
+    "pytorch_operator_tpu/runtime/",
+    "pytorch_operator_tpu/disruption/",
+)
+
+#: Default scan roots for the tree-wide run (scripts/lint.py with no
+#: arguments and the test suite's cleanliness assertion).
+DEFAULT_SCAN_ROOTS: Tuple[str, ...] = (
+    "pytorch_operator_tpu",
+    "scripts",
+)
+
+
+@dataclass
+class AnalysisConfig:
+    """Which paths each scoped rule applies to.
+
+    ``clock_injectable`` / ``reconcile_paths``: path-prefix lists; a
+    file matches when its repo-relative POSIX path starts with any
+    entry.  An empty tuple disables the scoped rule everywhere; tests
+    use ``("",)`` (matches everything) to run a scoped rule on fixture
+    files.
+    """
+
+    clock_injectable: Sequence[str] = field(default=CLOCK_INJECTABLE)
+    reconcile_paths: Sequence[str] = field(default=RECONCILE_PATHS)
+
+    @staticmethod
+    def _matches(rel_path: str, prefixes: Sequence[str]) -> bool:
+        posix = rel_path.replace("\\", "/")
+        return any(posix.startswith(p) for p in prefixes)
+
+    def is_clock_injectable(self, rel_path: str) -> bool:
+        return self._matches(rel_path, self.clock_injectable)
+
+    def is_reconcile_path(self, rel_path: str) -> bool:
+        return self._matches(rel_path, self.reconcile_paths)
+
+
+#: Shared default — what scripts/lint.py and test_analysis.py use.
+DEFAULT_CONFIG = AnalysisConfig()

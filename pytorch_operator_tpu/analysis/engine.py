@@ -1,0 +1,173 @@
+"""Rule engine: scan files, apply pragma waivers, report findings.
+
+Pragma grammar (one per comment, anywhere in the lines a flagged
+statement spans)::
+
+    # lint: <rule-key>-ok <reason>
+
+The reason is REQUIRED — a waiver without one is itself a finding
+(``waiver-missing-reason``), so every surviving pragma in the tree
+documents why the invariant legitimately does not apply.  A pragma
+that waives nothing (``unused-waiver``) and a pragma naming an unknown
+rule (``unknown-pragma``) are findings too: stale waivers rot into
+camouflage for real regressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .config import DEFAULT_CONFIG, DEFAULT_SCAN_ROOTS, AnalysisConfig
+from .rules import RULES
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*([A-Za-z0-9_-]+)-ok\b[ \t]*(.*?)\s*$")
+
+
+@dataclass
+class Finding:
+    """One lint finding; ``waived`` findings carry their pragma reason
+    and do not fail the gate."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    reason: Optional[str] = None
+    end_line: int = field(default=0)
+
+    def __post_init__(self):
+        if not self.end_line:
+            self.end_line = self.line
+
+    def format(self) -> str:
+        tag = f" (waived: {self.reason})" if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class _Pragma:
+    line: int
+    rule: str
+    reason: str
+    used: bool = False
+
+
+def _collect_pragmas(source: str) -> Dict[int, _Pragma]:
+    """Pragmas from real COMMENT tokens only — a docstring QUOTING the
+    pragma syntax (this package's own docs) must not parse as one."""
+    pragmas: Dict[int, _Pragma] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m:
+                lineno = tok.start[0]
+                pragmas[lineno] = _Pragma(lineno, m.group(1), m.group(2))
+    except tokenize.TokenError:
+        pass  # ast.parse already reported the syntax problem
+    return pragmas
+
+
+def scan_source(source: str, rel_path: str,
+                config: AnalysisConfig = DEFAULT_CONFIG) -> List[Finding]:
+    """Run every applicable rule over one module's source text."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("parse-error", rel_path, e.lineno or 1,
+                        f"cannot parse: {e.msg}")]
+    pragmas = _collect_pragmas(source)
+
+    for key, (rule_fn, scope_attr) in RULES.items():
+        if scope_attr is not None and not getattr(config, scope_attr)(rel_path):
+            continue
+        for line, end_line, message in rule_fn(tree):
+            finding = Finding(key, rel_path, line, message,
+                              end_line=end_line)
+            # a pragma on any line the statement spans — or on the line
+            # directly above it (for statements too long to carry an
+            # inline comment) — waives it
+            for ln in range(line - 1, end_line + 1):
+                p = pragmas.get(ln)
+                if p is not None and p.rule == key:
+                    p.used = True
+                    if not p.reason:
+                        findings.append(Finding(
+                            "waiver-missing-reason", rel_path, ln,
+                            f"waiver for [{key}] carries no reason — "
+                            f"say WHY the invariant does not apply"))
+                    else:
+                        finding.waived = True
+                        finding.reason = p.reason
+                    break
+            findings.append(finding)
+
+    for p in pragmas.values():
+        if p.rule not in RULES:
+            findings.append(Finding(
+                "unknown-pragma", rel_path, p.line,
+                f"pragma waives unknown rule [{p.rule}] — known: "
+                f"{', '.join(sorted(RULES))}"))
+        elif not p.used:
+            findings.append(Finding(
+                "unused-waiver", rel_path, p.line,
+                f"waiver for [{p.rule}] matches no finding on this "
+                f"line — stale pragma, remove it"))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+def scan_file(path: str, rel_path: Optional[str] = None,
+              config: AnalysisConfig = DEFAULT_CONFIG) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return scan_source(source, rel_path or path, config)
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def scan_paths(paths: Sequence[str], root: Optional[str] = None,
+               config: AnalysisConfig = DEFAULT_CONFIG) -> List[Finding]:
+    """Scan files/directories; ``rel_path`` (what scopes and reports
+    use) is computed against ``root`` (default: cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    for path in paths:
+        for file_path in _iter_py_files(path):
+            rel = os.path.relpath(os.path.abspath(file_path), root)
+            rel = rel.replace(os.sep, "/")
+            findings.extend(scan_file(file_path, rel, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def scan_tree(root: str,
+              config: AnalysisConfig = DEFAULT_CONFIG) -> List[Finding]:
+    """Scan the repo's default roots (the whole-tree gate)."""
+    paths = [os.path.join(root, p) for p in DEFAULT_SCAN_ROOTS]
+    return scan_paths([p for p in paths if os.path.exists(p)],
+                      root=root, config=config)
+
+
+def unwaived(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.waived]

@@ -1,0 +1,355 @@
+"""AST lint rules for concurrency & determinism invariants.
+
+Each rule is a pure function over one parsed module: it yields raw
+findings ``(line, end_line, message)``; the engine scopes rules to
+paths (``config.py``), applies pragma waivers, and decides exit codes.
+
+The rules deliberately resolve names through the module's own imports
+(``import time as t`` still flags ``t.monotonic()``), and deliberately
+do NOT flag *references* — ``clock: Callable = time.monotonic`` as a
+default argument is the injection idiom these rules exist to protect,
+only the *call* ``time.monotonic()`` bypasses it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+RawFinding = Tuple[int, int, str]
+
+# -- shared name resolution -------------------------------------------------
+
+#: module roots whose attribute calls the rules care about
+_TRACKED_MODULES = {
+    "time", "datetime", "threading", "random", "subprocess", "socket",
+    "urllib", "urllib.request", "requests",
+}
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to canonical dotted paths for tracked imports.
+
+    ``import time as t``          -> {"t": "time"}
+    ``from time import monotonic``-> {"monotonic": "time.monotonic"}
+    ``from datetime import datetime as dt`` -> {"dt": "datetime.datetime"}
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root in _TRACKED_MODULES or a.name in _TRACKED_MODULES:
+                    aliases[a.asname or root] = (
+                        a.name if a.asname else root)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            root = node.module.split(".")[0]
+            if root in _TRACKED_MODULES:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted path of a Name/Attribute expression, or None."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    if isinstance(node, ast.Attribute):
+        base = _resolve(node.value, aliases)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _span(node: ast.AST) -> Tuple[int, int]:
+    return node.lineno, getattr(node, "end_lineno", node.lineno)
+
+
+# -- rule: wall-clock -------------------------------------------------------
+
+#: calls that read or act on the real clock unconditionally
+_WALL_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.sleep", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+    "threading.Timer",
+}
+
+#: calls that default to "now" when the time argument is omitted:
+#: canonical name -> index of the optional time argument
+_WALL_CLOCK_DEFAULT_NOW = {
+    "time.gmtime": 0,
+    "time.localtime": 0,
+    "time.strftime": 1,
+    "time.ctime": 0,
+}
+
+
+def rule_wall_clock(tree: ast.AST) -> Iterator[RawFinding]:
+    """Raw wall-clock calls in a clock-injectable module.
+
+    One ``time.monotonic()`` on a path the simulator drives silently
+    breaks the same-seed determinism guarantee: the fingerprint then
+    depends on host scheduling, not the virtual timeline.  Take the
+    injected clock (``clock=`` / ``VirtualClock.timer``) or waive with
+    ``# lint: wall-clock-ok <reason>``.
+    """
+    aliases = _import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _resolve(node.func, aliases)
+        if target is None:
+            continue
+        if target in _WALL_CLOCK_CALLS:
+            lo, hi = _span(node)
+            yield lo, hi, (
+                f"wall-clock call {target}() in a clock-injectable "
+                f"module — thread the injected clock through instead")
+        elif target in _WALL_CLOCK_DEFAULT_NOW:
+            # only a wall-clock read when the time argument is omitted
+            idx = _WALL_CLOCK_DEFAULT_NOW[target]
+            has_time_arg = (
+                len(node.args) > idx
+                or any(isinstance(a, ast.Starred) for a in node.args)
+                or any(kw.arg is None for kw in node.keywords))
+            if not has_time_arg:
+                lo, hi = _span(node)
+                yield lo, hi, (
+                    f"{target}() without a time argument reads the real "
+                    f"clock — pass an injected timestamp")
+
+
+# -- rule: builtin-hash -----------------------------------------------------
+
+def rule_builtin_hash(tree: ast.AST) -> Iterator[RawFinding]:
+    """Builtin ``hash()`` anywhere in the operator package.
+
+    ``hash()`` of a str/bytes is salted by PYTHONHASHSEED: using it for
+    shard placement, cache keys or any persisted/compared value means a
+    restart reshards the fleet.  Use ``hashlib.blake2b`` (see
+    ``runtime.sharding.shard_of``) or waive with
+    ``# lint: builtin-hash-ok <reason>``.
+    """
+    shadowed = {
+        n.asname or n.name.split(".")[0]
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.Import, ast.ImportFrom))
+        for n in node.names
+    }
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "hash"
+                and "hash" not in shadowed):
+            lo, hi = _span(node)
+            yield lo, hi, (
+                "builtin hash() is PYTHONHASHSEED-salted — restart "
+                "reshards/rekeys; use hashlib.blake2b like "
+                "runtime.sharding.shard_of")
+
+
+# -- rule: unseeded-random --------------------------------------------------
+
+#: module-level random functions drawing from the shared, unseeded RNG
+_GLOBAL_RANDOM_FNS = {
+    "random", "randint", "uniform", "choice", "choices", "shuffle",
+    "sample", "randrange", "gauss", "betavariate", "expovariate",
+    "normalvariate", "lognormvariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "triangular", "getrandbits", "randbytes", "seed",
+}
+
+
+def rule_unseeded_random(tree: ast.AST) -> Iterator[RawFinding]:
+    """Module-level ``random.*`` calls (the shared, unseeded RNG).
+
+    Every stochastic knob in this repo (fleet latency profiles, fault
+    plans, churn arrival) draws from a ``random.Random(seed)`` instance
+    so the same seed replays the same scenario; the module-level
+    functions share one process-global generator that any import can
+    perturb.  Seeded instances are fine; waive with
+    ``# lint: unseeded-random-ok <reason>``.
+    """
+    aliases = _import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _resolve(node.func, aliases)
+        if (target and target.startswith("random.")
+                and target.split(".", 1)[1] in _GLOBAL_RANDOM_FNS):
+            lo, hi = _span(node)
+            yield lo, hi, (
+                f"{target}() draws from the process-global unseeded RNG "
+                f"— use a random.Random(seed) instance")
+
+
+# -- rule: blocking-in-lock -------------------------------------------------
+
+#: canonical call targets that block on I/O or sleep
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.patch", "requests.head", "requests.request",
+}
+
+#: bare attribute/function names that block regardless of receiver
+#: (``self._sleep(...)`` is an injected sleep — still a real block)
+_BLOCKING_ATTRS = {"sleep"}
+
+
+def _expr_text(node: ast.AST) -> str:
+    """Best-effort dotted text of a Name/Attribute for lock matching."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_expr_text(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return _expr_text(node.func)
+    return ""
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    text = _expr_text(expr)
+    last = text.rsplit(".", 1)[-1].lower()
+    return ("lock" in last or "mutex" in last or last in ("mu", "cv")) \
+        and "unlock" not in last
+
+
+def _iter_body_calls(body: Sequence[ast.stmt]) -> Iterator[ast.Call]:
+    """Calls lexically inside ``body``, not descending into nested
+    function/class definitions (those run later, outside the lock)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def rule_blocking_in_lock(tree: ast.AST) -> Iterator[RawFinding]:
+    """Blocking calls lexically inside a ``with <lock>:`` body.
+
+    A sleep, subprocess or network round-trip while holding a lock
+    convoys every thread that needs it — the token bucket's "sleep
+    outside the lock: no convoy" comment is the invariant this rule
+    enforces mechanically.  Waive with
+    ``# lint: blocking-in-lock-ok <reason>``.
+    """
+    aliases = _import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        lock_items = [i.context_expr for i in node.items
+                      if _looks_like_lock(i.context_expr)]
+        if not lock_items:
+            continue
+        lock_texts = {_expr_text(i) for i in lock_items}
+        for call in _iter_body_calls(node.body):
+            target = _resolve(call.func, aliases)
+            blocked = None
+            if target in _BLOCKING_CALLS:
+                blocked = target
+            elif isinstance(call.func, ast.Attribute):
+                recv = _expr_text(call.func.value)
+                if call.func.attr in _BLOCKING_ATTRS:
+                    blocked = f"{recv}.{call.func.attr}" if recv \
+                        else call.func.attr
+                elif (call.func.attr in ("join", "wait")
+                      and recv not in lock_texts
+                      and any(h in recv.lower()
+                              for h in ("thread", "timer", "pool",
+                                        "proc", "future", "event",
+                                        "stop"))):
+                    # t.join() / stop_event.wait() while holding a lock;
+                    # cond-var waits on the held lock itself are the
+                    # legitimate release-and-sleep idiom and excluded
+                    blocked = f"{recv}.{call.func.attr}"
+            elif (isinstance(call.func, ast.Name)
+                  and call.func.id in _BLOCKING_ATTRS
+                  and call.func.id not in aliases):
+                blocked = call.func.id
+            if blocked:
+                lo, hi = _span(call)
+                yield lo, hi, (
+                    f"blocking call {blocked}() lexically inside "
+                    f"`with {sorted(lock_texts)[0]}:` — move it outside "
+                    f"the critical section")
+
+
+# -- rule: swallowed-except -------------------------------------------------
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    if handler.type is None:
+        return "bare except"
+    t = handler.type
+    if isinstance(t, ast.Name) and t.id in _BROAD_EXC_NAMES:
+        return f"except {t.id}"
+    if isinstance(t, ast.Tuple):
+        for el in t.elts:
+            if isinstance(el, ast.Name) and el.id in _BROAD_EXC_NAMES:
+                return f"except (...{el.id}...)"
+    return None
+
+
+def _body_is_silent(body: Sequence[ast.stmt]) -> bool:
+    """True when the handler body neither re-raises, logs, counts, nor
+    mutates any state — only pass/continue/break/docstring/bare return."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def rule_swallowed_except(tree: ast.AST) -> Iterator[RawFinding]:
+    """Broad/bare ``except`` that silently swallows on a reconcile path.
+
+    A handler that catches Exception and does literally nothing turns a
+    failed sync into a wedged job: no requeue, no event, no log line to
+    find it by.  Handle it (log, count, re-raise) or waive with
+    ``# lint: swallowed-except-ok <reason>`` — the recorder's
+    "event emission must never break reconciliation" is the canonical
+    legitimate waiver.
+    """
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            broad = _handler_is_broad(handler)
+            if broad and _body_is_silent(handler.body):
+                lo = handler.lineno
+                hi = getattr(handler, "end_lineno", lo)
+                yield lo, hi, (
+                    f"{broad} silently swallows errors on a reconcile "
+                    f"path — log, count, or re-raise")
+
+
+# -- registry ---------------------------------------------------------------
+
+#: rule key -> (rule fn, scope attribute on AnalysisConfig or None for
+#: tree-wide).  Keys double as the pragma vocabulary:
+#: ``# lint: <key>-ok <reason>``.
+RULES = {
+    "wall-clock": (rule_wall_clock, "is_clock_injectable"),
+    "builtin-hash": (rule_builtin_hash, None),
+    "unseeded-random": (rule_unseeded_random, None),
+    "blocking-in-lock": (rule_blocking_in_lock, None),
+    "swallowed-except": (rule_swallowed_except, "is_reconcile_path"),
+}

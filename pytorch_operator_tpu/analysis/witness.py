@@ -1,0 +1,277 @@
+"""Runtime lock-order witness: deadlock detection by observation.
+
+Every runtime lock is built through :func:`make_lock` /
+:func:`make_rlock` with a stable name.  By default the wrappers add one
+module-global read per acquire; when a witness is enabled (the pytest
+``--lock-witness`` flag, or :func:`enable_witness` directly) each
+acquisition is recorded into a per-thread held-stack and a global
+edge set: holding A while acquiring B adds the edge A→B with both
+acquisition stacks (captured lazily — only the first observation of an
+edge pays for stack formatting).
+
+At session end :meth:`LockWitness.cycles` runs a DFS over the observed
+graph; any cycle is a latent deadlock — two threads that interleave at
+the recorded call sites will block forever — and the report names every
+edge in the cycle with the two stacks that witnessed it.
+
+Nodes are lock *instances* (a monotonically increasing serial, never
+``id()`` — ids are reused after GC and would weld unrelated locks into
+phantom edges), labeled with their creation name, so two different
+informer stores acquired in opposite orders do not alias into a false
+cycle.  Re-entrant acquisition of an RLock the thread already holds
+records nothing (not an ordering event).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "make_lock", "make_rlock", "enable_witness", "disable_witness",
+    "witness_active", "LockWitness", "WitnessLock",
+]
+
+#: the active witness, or None (the common case: zero recording)
+_witness: Optional["LockWitness"] = None
+
+_serial_lock = threading.Lock()
+_next_serial = 0
+
+
+def _new_serial() -> int:
+    global _next_serial
+    with _serial_lock:
+        _next_serial += 1
+        return _next_serial
+
+
+def _capture_stack(skip: int = 2, limit: int = 12) -> traceback.StackSummary:
+    """The caller's stack, source lines deferred (lookup at report
+    time): capture runs on every witnessed acquire and must stay cheap."""
+    frame = sys._getframe(skip)
+    return traceback.StackSummary.extract(
+        traceback.walk_stack(frame), limit=limit, lookup_lines=False)
+
+
+class WitnessLock:
+    """Lock wrapper that reports acquisitions to the active witness.
+
+    Wraps a real ``threading.Lock``/``RLock`` and mirrors its protocol
+    (``acquire(blocking, timeout)`` / ``release`` / context manager),
+    including what ``threading.Condition`` needs from a plain lock —
+    ``Condition(make_lock("x"))`` keeps the witness accounting balanced
+    because the condition's wait path releases and re-acquires through
+    this wrapper.
+    """
+
+    __slots__ = ("_inner", "name", "serial", "reentrant")
+
+    def __init__(self, inner, name: str, reentrant: bool):
+        self._inner = inner
+        self.name = name
+        self.serial = _new_serial()
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            w = _witness
+            if w is not None:
+                w._on_acquire(self)
+        return got
+
+    def release(self) -> None:
+        w = _witness
+        if w is not None:
+            w._on_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        kind = "RLock" if self.reentrant else "Lock"
+        return f"<WitnessLock {self.name}#{self.serial} ({kind})>"
+
+
+def make_lock(name: str) -> WitnessLock:
+    """A ``threading.Lock`` with a witness identity.  ``name`` labels
+    the node in lock-order reports — stable, module-scoped, lowercase
+    (e.g. ``"workqueue"``, ``"informer.apply"``)."""
+    return WitnessLock(threading.Lock(), name, reentrant=False)
+
+
+def make_rlock(name: str) -> WitnessLock:
+    """A ``threading.RLock`` with a witness identity."""
+    return WitnessLock(threading.RLock(), name, reentrant=True)
+
+
+class _Edge:
+    __slots__ = ("holder_stack", "acquirer_stack", "thread_name", "count")
+
+    def __init__(self, holder_stack, acquirer_stack, thread_name):
+        self.holder_stack = holder_stack
+        self.acquirer_stack = acquirer_stack
+        self.thread_name = thread_name
+        self.count = 1
+
+
+class LockWitness:
+    """Observed lock-acquisition graph for one enabled session."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (holder_serial, acquirer_serial) -> _Edge (first observation)
+        self._edges: Dict[Tuple[int, int], _Edge] = {}
+        self._names: Dict[int, str] = {}
+        self._local = threading.local()
+        self.acquisitions = 0
+
+    # -- recording (hot path) ---------------------------------------------
+    def _held(self) -> List[Tuple[int, object]]:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def _on_acquire(self, lock: WitnessLock) -> None:
+        held = self._held()
+        serial = lock.serial
+        if any(s == serial for s, _ in held):
+            # re-entrant RLock acquire: push for balanced release
+            # accounting, but record no ordering edge against itself
+            held.append((serial, None))
+            return
+        stack = _capture_stack(skip=3)
+        new_edges = []
+        for held_serial, held_stack in held:
+            if held_serial != serial \
+                    and (held_serial, serial) not in self._edges:
+                new_edges.append((held_serial, held_stack))
+        held.append((serial, stack))
+        with self._mu:
+            self.acquisitions += 1
+            self._names.setdefault(serial, lock.name)
+            for held_serial, held_stack in new_edges:
+                self._edges.setdefault(
+                    (held_serial, serial),
+                    _Edge(held_stack, stack, threading.current_thread().name))
+
+    def _on_release(self, lock: WitnessLock) -> None:
+        held = getattr(self._local, "held", None)
+        if not held:
+            return
+        serial = lock.serial
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == serial:
+                del held[i]
+                return
+
+    # -- analysis (session end) -------------------------------------------
+    def cycles(self) -> List[List[int]]:
+        """Every elementary cycle's node list (serials), shortest-first.
+        DFS over the observed edge set; a cycle means the recorded
+        acquisition orders can interleave into a deadlock."""
+        with self._mu:
+            edges = list(self._edges)
+        graph: Dict[int, List[int]] = {}
+        for a, b in edges:
+            graph.setdefault(a, []).append(b)
+        found: List[List[int]] = []
+        seen_cycles: Set[Tuple[int, ...]] = set()
+
+        def dfs(start: int, node: int, path: List[int],
+                on_path: Set[int]) -> None:
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    # canonicalize rotation so each cycle reports once
+                    cyc = path[:]
+                    pivot = cyc.index(min(cyc))
+                    key = tuple(cyc[pivot:] + cyc[:pivot])
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        found.append(list(key))
+                elif nxt > start and nxt not in on_path:
+                    on_path.add(nxt)
+                    path.append(nxt)
+                    dfs(start, nxt, path, on_path)
+                    path.pop()
+                    on_path.discard(nxt)
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        found.sort(key=len)
+        return found
+
+    def _format_stack(self, stack) -> str:
+        if stack is None:
+            return "    (first lock of the thread — stack not retained)"
+        return "".join(f"    {line}" for line in stack.format())
+
+    def report(self) -> str:
+        """Human-readable cycle report: every edge of every cycle with
+        the two stacks that witnessed it (holder's acquisition, then
+        the acquisition taken while holding).  Empty string when the
+        observed order is acyclic."""
+        cycles = self.cycles()
+        if not cycles:
+            return ""
+        with self._mu:
+            names = dict(self._names)
+            edges = dict(self._edges)
+        out = [f"LOCK-ORDER CYCLES DETECTED: {len(cycles)}"]
+        for n, cyc in enumerate(cycles, 1):
+            label = " -> ".join(
+                f"{names.get(s, '?')}#{s}" for s in cyc + [cyc[0]])
+            out.append(f"\ncycle {n}: {label}")
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                edge = edges.get((a, b))
+                if edge is None:
+                    continue
+                out.append(
+                    f"  edge {names.get(a, '?')}#{a} -> "
+                    f"{names.get(b, '?')}#{b} "
+                    f"(thread {edge.thread_name}):")
+                out.append(f"   held {names.get(a, '?')} acquired at:")
+                out.append(self._format_stack(edge.holder_stack))
+                out.append(f"   then acquired {names.get(b, '?')} at:")
+                out.append(self._format_stack(edge.acquirer_stack))
+        return "\n".join(out)
+
+    def edge_names(self) -> Set[Tuple[str, str]]:
+        """Observed (holder name, acquirer name) pairs — the coarse
+        lock-order documentation the developer guide embeds."""
+        with self._mu:
+            return {(self._names.get(a, "?"), self._names.get(b, "?"))
+                    for a, b in self._edges}
+
+
+def enable_witness() -> LockWitness:
+    """Install (and return) a fresh witness; every subsequent acquire
+    of a witness-built lock is recorded until :func:`disable_witness`."""
+    global _witness
+    w = LockWitness()
+    _witness = w
+    return w
+
+
+def disable_witness() -> Optional[LockWitness]:
+    """Stop recording; returns the witness that was active (its graph
+    stays queryable) or None."""
+    global _witness
+    w = _witness
+    _witness = None
+    return w
+
+
+def witness_active() -> Optional[LockWitness]:
+    return _witness

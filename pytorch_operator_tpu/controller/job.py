@@ -114,9 +114,11 @@ class JobLifecycleMixin:
         if old_ads is None or old_ads != new_ads:
             try:
                 new_ads = float(new_ads)
+                # lint: wall-clock-ok deadline math is anchored to the RFC3339 status.startTime on the wire (wall-clock epoch domain); only the requeue DELAY derived from it rides the injected queue clock
                 start = parse_time(start_time) or time.time()
             except (TypeError, ValueError):
                 return  # malformed spec/status: sync_job reports it
+            # lint: wall-clock-ok same epoch-domain comparison as above
             passed = time.time() - start
             key = meta_namespace_key(new_obj)
             self._queue_for_key(key).add_after(key, new_ads - passed)
@@ -186,6 +188,7 @@ class JobLifecycleMixin:
         completion = parse_time(job.status.completion_time)
         if completion is None:
             return
+        # lint: wall-clock-ok TTL is anchored to the RFC3339 status.completionTime (wall-clock epoch domain); a monotonic source cannot be compared against it
         remaining = completion + ttl - time.time()
         if remaining <= 0:
             try:

@@ -73,7 +73,9 @@ class PyTorchController(
         # keeps a modest ring and never logs slow reconciles — the CLI
         # passes one configured from --trace-buffer-size /
         # --slow-reconcile-threshold.
-        self.tracer = tracer or tracing.Tracer()
+        self.tracer = tracer or tracing.Tracer(
+            clock=self.mono_clock,
+            wall=self.config.clock)
         # Reference parity: the unstructured job informer resyncs every 30s
         # (informer.go:24), factories every --resyc-period (options.go:24).
         # When resync is disabled (0, the unit-test default) the job
@@ -86,7 +88,8 @@ class PyTorchController(
         self.job_informer = Informer(cluster.jobs, resync_period=job_resync,
                                      coalesce=self._coalesce_job_event,
                                      name="pytorchjobs",
-                                     registry=registry or default_registry)
+                                     registry=registry or default_registry,
+                                     clock=self.mono_clock)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
         )
@@ -162,7 +165,8 @@ class PyTorchController(
                 "PyTorchJobs in this replica's per-shard informer cache "
                 "(0 for shards it does not own)",
                 ("shard",))
-            self._admission_informer = Informer(cluster.jobs)
+            self._admission_informer = Informer(cluster.jobs,
+                                                clock=self.mono_clock)
             self._admission_informer.add_event_handler(
                 on_add=self._admit_job,
                 on_update=lambda _old, new: self._admit_job(new))
@@ -520,7 +524,7 @@ class PyTorchController(
         if key is None:
             return True
         try:
-            start = time.monotonic()
+            start = self.mono_clock()
             with self.tracer.trace("reconcile", key=key) as tspan:
                 forget, err = self.sync_job(key)
                 result = ("error" if err is not None
@@ -530,7 +534,7 @@ class PyTorchController(
             # its bucket, so a slow bucket on an OpenMetrics scrape
             # resolves directly to its /debug/traces entry
             self.sync_duration.labels(result=result).observe(
-                time.monotonic() - start,
+                self.mono_clock() - start,
                 exemplar={"trace_id": tspan.trace_id})
             if err is None and forget:
                 queue.forget(key)
@@ -557,7 +561,7 @@ class PyTorchController(
     # -- sync --------------------------------------------------------------
     def sync_job(self, key: str):
         """controller.go:290-334. Returns (forget, error)."""
-        start = time.monotonic()
+        start = self.mono_clock()
         try:
             namespace, name = split_meta_namespace_key(key)
         except ValueError as e:
@@ -616,7 +620,7 @@ class PyTorchController(
             except Exception as e:  # reconcile errors requeue the job
                 err = e
         logger_for_key(self.logger, key).debug(
-            "Finished syncing job %s (%.3fs)", key, time.monotonic() - start
+            "Finished syncing job %s (%.3fs)", key, self.mono_clock() - start
         )
         if err is not None:
             return False, err
@@ -871,6 +875,7 @@ class PyTorchController(
         start = parse_time(job.status.start_time)
         if start is None:
             return False
+        # lint: wall-clock-ok deadline is anchored to the wire-format RFC3339 status.startTime, which lives in the wall-clock epoch domain; a monotonic source cannot be compared against it
         return time.time() - start >= job.spec.active_deadline_seconds
 
 
@@ -888,10 +893,10 @@ class _ShardRuntime:
                  workers: int = 1):
         self.shard = shard
         self.controller = controller
-        self.queue = WorkQueue(clock=controller.config.clock
-                               or time.monotonic)
+        self.queue = WorkQueue(clock=controller.mono_clock)
         self.queue.set_metrics(WorkQueueMetrics(
-            controller.registry, f"pytorchjob-shard{shard}"))
+            controller.registry, f"pytorchjob-shard{shard}",
+            clock=controller.mono_clock))
         cluster = controller.cluster
         self._sources = [sharded_source(cluster, plural, shard)
                          for plural in ("pytorchjobs", "pods", "services")]
@@ -900,15 +905,17 @@ class _ShardRuntime:
             jobs_src,
             coalesce=lambda key, old, new:
                 controller._coalesce_job_event(key, old, new,
-                                               queue=self.queue))
+                                               queue=self.queue),
+            clock=controller.mono_clock)
         self.job_informer.add_event_handler(
             on_add=controller.add_job, on_update=controller.update_job,
             on_delete=controller._job_deleted)
-        self.pod_informer = Informer(pods_src)
+        self.pod_informer = Informer(pods_src, clock=controller.mono_clock)
         self.pod_informer.add_event_handler(
             on_add=controller.add_pod, on_update=controller.update_pod,
             on_delete=controller.delete_pod)
-        self.service_informer = Informer(services_src)
+        self.service_informer = Informer(services_src,
+                                         clock=controller.mono_clock)
         self.service_informer.add_event_handler(
             on_add=controller.add_service,
             on_delete=controller.delete_service)

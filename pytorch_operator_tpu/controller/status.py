@@ -26,8 +26,10 @@ JOB_FAILED_REASON = "PyTorchJobFailed"
 JOB_RESTARTING_REASON = "PyTorchJobRestarting"
 
 
-def now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+def now_iso(now: Optional[float] = None) -> str:
+    """RFC3339 condition timestamp; ``now`` (epoch seconds, e.g. a
+    VirtualClock's ``now``) overrides the real wall clock."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
 
 
 def new_condition(cond_type: str, reason: str, message: str) -> JobCondition:

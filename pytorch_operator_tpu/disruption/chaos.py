@@ -14,6 +14,22 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence
 
+from ..analysis.witness import make_lock
+
+
+def _make_timer(clock, delay: float, fn, args=(), kwargs=None):
+    """A started daemon timer on ``clock`` (a VirtualClock, giving the
+    storm a deterministic virtual timeline) or, when None, on real
+    threading timers."""
+    if clock is not None:
+        timer = clock.timer(delay, fn, args=args, kwargs=kwargs or {})
+    else:
+        # lint: wall-clock-ok intended fallback when no VirtualClock is injected — live-cluster chaos drills run on real timers
+        timer = threading.Timer(delay, fn, args=args, kwargs=kwargs or {})
+    timer.daemon = True
+    timer.start()
+    return timer
+
 
 class PreemptionStorm:
     """A scripted sequence of node preemptions against one fake kubelet.
@@ -24,12 +40,13 @@ class PreemptionStorm:
     like a zone-wide spot reclaim walking through a rack.
     """
 
-    def __init__(self, kubelet, exit_code: int = 143):
+    def __init__(self, kubelet, exit_code: int = 143, clock=None):
         self.kubelet = kubelet
         self.exit_code = exit_code
+        self.clock = clock
         self._planned: List[tuple] = []  # (node, at, grace)
         self._timers: List[threading.Timer] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.storm")
         self._started = False
 
     def schedule(self, node: str, at: float = 0.0,
@@ -58,13 +75,12 @@ class PreemptionStorm:
                 self.kubelet.inject_preemption(
                     node, grace=grace, exit_code=self.exit_code)
             else:
-                timer = threading.Timer(
-                    at, self.kubelet.inject_preemption, args=(node,),
+                timer = _make_timer(
+                    self.clock, at, self.kubelet.inject_preemption,
+                    args=(node,),
                     kwargs={"grace": grace, "exit_code": self.exit_code})
-                timer.daemon = True
                 with self._lock:
                     self._timers.append(timer)
-                timer.start()
         return self
 
     def cancel(self) -> None:
@@ -88,7 +104,8 @@ class CapacityFlap:
 
     def __init__(self, kubelet, nodes: Sequence[str], grace: float = 0.05,
                  exit_code: int = 143, taint_key: Optional[str] = None,
-                 freeze_capacity: bool = False):
+                 freeze_capacity: bool = False, clock=None):
+        self.clock = clock
         self.kubelet = kubelet
         self.nodes = list(nodes)
         self.grace = grace
@@ -102,7 +119,7 @@ class CapacityFlap:
         # assert the controller-side grow gating alone.
         self.freeze_capacity = freeze_capacity
         self._timers: List[threading.Timer] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.flap")
 
     def down(self) -> "CapacityFlap":
         if self.freeze_capacity:
@@ -130,11 +147,9 @@ class CapacityFlap:
             if delay <= 0:
                 fn()
                 return
-            timer = threading.Timer(delay, fn)
-            timer.daemon = True
+            timer = _make_timer(self.clock, delay, fn)
             with self._lock:
                 self._timers.append(timer)
-            timer.start()
 
         arm(down_at, self.down)
         arm(down_at + restore_after, self.restore)

@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..analysis.witness import make_lock
 from ..api.v1 import constants
 from ..api.v1.types import PyTorchJob
 from ..k8s.errors import NotFoundError
@@ -62,7 +63,7 @@ class DisruptionHandlingMixin:
         """Build the disruption metrics and (when enabled and the cluster
         models Nodes) the watcher over the runtime's node informer."""
         self._pending_disruptions: Dict[str, dict] = {}
-        self._disruption_lock = threading.Lock()
+        self._disruption_lock = make_lock("disruption.pending")
         self.preemptions_detected_counter = registry.counter(
             "pytorch_operator_preemptions_detected_total",
             "Counts disruption detections (node taints, DisruptionTarget "

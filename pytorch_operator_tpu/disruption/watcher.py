@@ -21,6 +21,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from ..analysis.witness import make_lock
 from ..runtime.informer import meta_namespace_key
 from .detector import node_disruption_reason, node_schedulable_tpu
 
@@ -42,7 +43,7 @@ class PodNodeIndex:
 
     def __init__(self, informer):
         self._store = informer.store
-        self._lock = threading.Lock()
+        self._lock = make_lock("disruption.pod-index")
         self._keys_by_node: Dict[str, Set[str]] = {}
         self._node_of_key: Dict[str, str] = {}
         informer.add_event_handler(
@@ -127,7 +128,7 @@ class PodNodeIndexUnion:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("disruption.sharded-index")
         self._indexes: Dict[int, PodNodeIndex] = {}
 
     def add_index(self, shard: int, index: PodNodeIndex) -> None:
@@ -188,7 +189,7 @@ class CapacityWatcher:
         self.on_capacity = on_capacity
         self.pod_index = pod_index
         self.cluster = cluster
-        self._lock = threading.Lock()
+        self._lock = make_lock("disruption.capacity")
         self._schedulable: Dict[str, bool] = {}
         informer.add_event_handler(
             on_add=self._evaluate,
@@ -264,7 +265,7 @@ class DisruptionWatcher:
         self.on_job_disruption = on_job_disruption
         self.kind = kind
         self.pod_index = pod_index
-        self._lock = threading.Lock()
+        self._lock = make_lock("disruption.watcher")
         self._flagged: Dict[str, str] = {}  # node name -> last fired reason
         informer.add_event_handler(
             on_add=self._node_added, on_update=self._node_updated,

@@ -22,6 +22,7 @@ import uuid
 from collections import deque, namedtuple
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.witness import make_lock, make_rlock
 from .errors import AlreadyExistsError, ConflictError, InvalidError, NotFoundError
 from .objects import match_labels
 
@@ -458,7 +459,7 @@ class FakeCluster:
 
     def __init__(self, fault_plan=None, watch_cache_window: int = 2048,
                  index_labels: Iterable[str] = ()):
-        self.lock = threading.RLock()
+        self.lock = make_rlock("fake.cluster")
         self._rv = 0
         # label keys every store indexes for LIST (see
         # FakeResourceStore._indexed_keys) — the kubemark tier passes
@@ -470,7 +471,7 @@ class FakeCluster:
         # deterministic under the virtual clock, which is what lets the
         # --scale bench assert same-seed runs produce identical load.
         self._verb_counts: Dict[str, int] = {}
-        self._verb_lock = threading.Lock()
+        self._verb_lock = make_lock("fake.verb-counts")
         # per-store watch-cache depth (see FakeResourceStore.changes_since):
         # how many recent mutations stay answerable as a windowed relist
         self.watch_cache_window = max(0, int(watch_cache_window))

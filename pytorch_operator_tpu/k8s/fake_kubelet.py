@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.witness import make_lock
 from .errors import ApiError, NotFoundError
 from .fake import ADDED, FakeCluster
 
@@ -38,8 +39,10 @@ TPU_ACCELERATOR_LABEL = _api_constants.NODE_SELECTOR_TPU_ACCELERATOR
 SIGTERM_EXIT_CODE = 143
 
 
-def _now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+def _now_iso(now: Optional[float] = None) -> str:
+    """RFC3339 timestamp; ``now`` (epoch seconds, e.g. a VirtualClock's
+    ``now``) overrides the real wall clock."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
 
 
 def new_tpu_node(name: str, tpu_chips: int = 4,
@@ -134,7 +137,7 @@ class FakeKubelet:
         self._capacity_frozen = False
         self._bind_queue: List[tuple] = []
         self._timers: Dict[str, threading.Timer] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("fake-kubelet")
         self._stopped = False
 
     def start(self) -> None:
@@ -295,6 +298,10 @@ class FakeKubelet:
                            self._pod_delays(ns, name)[0],
                            self._run_pod, ns, name)
 
+    def _ts(self) -> str:
+        """RFC3339 stamp on the kubelet's clock (virtual when injected)."""
+        return _now_iso(self.clock.now() if self.clock is not None else None)
+
     # -- chaos injection ---------------------------------------------------
     def taint_node(self, name: str, key: str = IMPENDING_PREEMPTION_TAINT,
                    value: str = "", effect: str = "NoSchedule") -> None:
@@ -305,7 +312,7 @@ class FakeKubelet:
         if any(t.get("key") == key for t in taints):
             return
         taints = taints + [{"key": key, "value": value, "effect": effect,
-                            "timeAdded": _now_iso()}]
+                            "timeAdded": self._ts()}]
         self.cluster.nodes.patch("default", name, {"spec": {"taints": taints}})
 
     def set_node_ready(self, name: str, ready: bool,
@@ -315,7 +322,7 @@ class FakeKubelet:
         status = "True" if ready else "False"
         self.cluster.nodes.patch("default", name, {"status": {"conditions": [
             {"type": "Ready", "status": status, "reason": reason,
-             "lastTransitionTime": _now_iso()},
+             "lastTransitionTime": self._ts()},
         ]}})
 
     def pods_on_node(self, name: str) -> List[dict]:
@@ -418,7 +425,7 @@ class FakeKubelet:
             return
         try:
             self.cluster.pods.patch(ns, name, {"metadata": {"annotations": {
-                _api_constants.ANNOTATION_CHECKPOINTED: _now_iso(),
+                _api_constants.ANNOTATION_CHECKPOINTED: self._ts(),
             }}})
         except NotFoundError:
             pass
@@ -528,6 +535,7 @@ class FakeKubelet:
             if self.clock is not None:
                 timer = self.clock.timer(delay, fn, args)
             else:
+                # lint: wall-clock-ok intended fallback when no VirtualClock is injected — the live-timer kubelet tier runs on real threading timers
                 timer = threading.Timer(delay, fn, args=args)
                 timer.daemon = True
             self._timers[key] = timer

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Dict, Optional, Sequence
 
+from ..analysis.witness import make_lock
 from .errors import ApiError, error_for_status
 
 #: Verbs a FaultPlan can target (watch is addressed separately through
@@ -109,7 +110,7 @@ class FaultPlan:
         self.watch_reset_every = int(watch_reset_every)
         self._clock = clock or time.monotonic
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.plan")
         self._requests = 0
         self._throttled_remaining = 0
         self._throttle_armed = throttle_after is not None

@@ -36,6 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..analysis.witness import make_lock
 from .errors import CircuitOpenError
 
 
@@ -150,7 +151,7 @@ class TokenBucket:
         self._sleep = sleep
         self._last = clock()
         self._pause_until = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.token-bucket")
 
     def acquire(self) -> float:
         if self.qps <= 0:
@@ -204,7 +205,7 @@ class CircuitBreaker:
         self.reset_timeout = float(reset_timeout)
         self._clock = clock
         self.on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.breaker")
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -354,7 +355,7 @@ class ResilienceMetrics:
 #: knobs are part of the key so a test with a different threshold never
 #: inherits another test's breaker state.
 _endpoint_breakers: dict = {}
-_endpoint_breakers_lock = threading.Lock()
+_endpoint_breakers_lock = make_lock("resilience.endpoint-breakers")
 
 
 def breaker_for_endpoint(endpoint: str, threshold: int,

@@ -35,6 +35,7 @@ from .errors import (
     is_transient,
     transient_reason,
 )
+from ..analysis.witness import make_lock
 from .resilience import ResilienceConfig
 from . import resilience as _resilience
 
@@ -800,7 +801,7 @@ class RestCluster:
         self.namespace = namespace or None
         self._stores: Dict[str, RestResourceStore] = {}
         self._filtered_stores: List[RestResourceStore] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("rest.cluster")
         if registry is None:
             from pytorch_operator_tpu.metrics import default_registry
             registry = default_registry

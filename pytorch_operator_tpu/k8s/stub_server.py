@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..analysis.witness import make_lock
 from .errors import ApiError
 from .fake import FakeCluster
 
@@ -50,7 +51,7 @@ class StubApiServer:
         # benches and the resilience e2e assert duplicate-create /
         # injected-fault counts against what the server actually sent
         self.counters: dict = {}
-        self._counters_lock = threading.Lock()
+        self._counters_lock = make_lock("stub-server.counters")
         # per-verb load/latency accounting by "verb plural" (e.g.
         # "list pods" -> {count, total_s}): the kubemark tier's answer
         # to "which verb against which resource is loading the

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.witness import make_lock
+
 #: Shared counter absorbing samples whose label set exceeded a vec's
 #: series budget (one per registry; see ``_MetricVec.with_budget``).
 DROPPED_SERIES_NAME = "pytorch_operator_metrics_dropped_series_total"
@@ -87,7 +89,7 @@ class _Metric:
         self.help = help_text
         self.type = metric_type
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.metric")
         # set by a vec when this metric is a labeled child; standalone
         # metrics expose bare series
         self._label_pairs: List[Tuple[str, str]] = []
@@ -272,7 +274,7 @@ class _MetricVec:
         self.label_names = tuple(label_names)
         self._child_factory = child_factory
         self._children: Dict[Tuple[str, ...], _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.vec")
         self._budget: Optional[int] = None
         self._dropped: Optional[Counter] = None
         self._overflow_child: Optional[_Metric] = None
@@ -386,7 +388,7 @@ class HistogramVec(_MetricVec):
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         # Always registered: a scrape must be able to report its own
         # partial failures (a set_function callback raising must not
         # take the whole /metrics response down — see expose()).

@@ -22,6 +22,8 @@ import subprocess
 import threading
 from typing import Optional, Tuple
 
+from ..analysis.witness import make_lock
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
@@ -36,7 +38,7 @@ _LIB_PATH = os.environ.get(
     os.path.join(_NATIVE_DIR, "build", "libtpu_operator.so"))
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("native.lib")
 _load_error: Optional[str] = None
 
 
@@ -177,6 +179,7 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
                 with open(os.path.join(_NATIVE_DIR, "build", ".lock"),
                           "w") as lockf:
                     fcntl.flock(lockf, fcntl.LOCK_EX)
+                    # lint: blocking-in-lock-ok one-time lazy build; _lib_lock exists precisely to serialize this compile so no thread CDLLs a half-linked .so
                     subprocess.run(
                         ["make", "-C", _NATIVE_DIR],
                         check=True, capture_output=True, text=True,
@@ -377,6 +380,7 @@ class NativeExpectations:
 
             exp = _Expectation(adds=adds.value, dels=dels.value)
             # carry over the native store's real age so expired() agrees
+            # lint: wall-clock-ok the native expectations store ages entries on the C++ steady clock; reconstructing the Python view must use the same real-clock domain
             exp.timestamp = time.monotonic() - age.value
             return exp
         return None

@@ -17,6 +17,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Tuple
 
+from ..analysis.witness import make_lock
 from ..k8s import serde
 from ..k8s.errors import ApiError
 from ..k8s.objects import OwnerReference, Pod, Service
@@ -49,7 +50,7 @@ def create_fanout_width() -> int:
 
 
 _fanout_pools: dict = {}
-_fanout_pool_lock = threading.Lock()
+_fanout_pool_lock = make_lock("controls.fanout-pools")
 
 
 def _fanout_pool_for(width: int) -> ThreadPoolExecutor:
@@ -145,7 +146,7 @@ class FanoutExecutor:
     def __init__(self, width: Optional[int] = None):
         self.width = max(1, int(width)) if width is not None else None
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("controls.fanout")
         self._shutdown = False
 
     def _own_pool(self) -> ThreadPoolExecutor:
@@ -260,12 +261,16 @@ def submit_deletes_with_expectations(
 
 class PodControl:
     def __init__(self, pods_client, recorder, registry=None,
-                 executor: Optional[FanoutExecutor] = None):
+                 executor: Optional[FanoutExecutor] = None,
+                 clock: Callable[[], float] = time.perf_counter):
         self._pods = pods_client
         self._recorder = recorder
         # constructor-injected fan-out (JobController owns one and
         # shuts it down on stop); None keeps the env-knob module pools
         self._executor = executor
+        # batch-latency time source; a VirtualClock's ``now`` makes the
+        # histograms deterministic under the simulator
+        self._clock = clock
         self._create_batch_hist, self._delete_batch_hist = (
             _batch_histograms(registry, "pod"))
 
@@ -314,7 +319,7 @@ class PodControl:
         sequential path records them; the aligned result list carries one
         error per failed create so expectations can be rolled back
         per-failure without aborting the rest of the batch."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             return self._run_batch(
                 lambda pod: self.create_pod_with_controller_ref(
@@ -323,7 +328,7 @@ class PodControl:
                 pods,
             )
         finally:
-            self._create_batch_hist.observe(time.perf_counter() - t0)
+            self._create_batch_hist.observe(self._clock() - t0)
 
     def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
@@ -354,11 +359,11 @@ class PodControl:
             self.delete_pod(namespace, name, controller_obj)
             return name
 
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             return self._run_batch(_one, names)
         finally:
-            self._delete_batch_hist.observe(time.perf_counter() - t0)
+            self._delete_batch_hist.observe(self._clock() - t0)
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
         return self._pods.patch(namespace, name, patch)
@@ -366,10 +371,12 @@ class PodControl:
 
 class ServiceControl:
     def __init__(self, services_client, recorder, registry=None,
-                 executor: Optional[FanoutExecutor] = None):
+                 executor: Optional[FanoutExecutor] = None,
+                 clock: Callable[[], float] = time.perf_counter):
         self._services = services_client
         self._recorder = recorder
         self._executor = executor
+        self._clock = clock
         self._create_batch_hist, self._delete_batch_hist = (
             _batch_histograms(registry, "service"))
 
@@ -405,7 +412,7 @@ class ServiceControl:
         controller_ref: OwnerReference,
     ) -> List[Tuple[Optional[dict], Optional[Exception]]]:
         """Bounded-fan-out batch create; see PodControl.create_many."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             return self._run_batch(
                 lambda service: self.create_service_with_controller_ref(
@@ -414,7 +421,7 @@ class ServiceControl:
                 services,
             )
         finally:
-            self._create_batch_hist.observe(time.perf_counter() - t0)
+            self._create_batch_hist.observe(self._clock() - t0)
 
     def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
@@ -440,11 +447,11 @@ class ServiceControl:
             self.delete_service(namespace, name, controller_obj)
             return name
 
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             return self._run_batch(_one, names)
         finally:
-            self._delete_batch_hist.observe(time.perf_counter() - t0)
+            self._delete_batch_hist.observe(self._clock() - t0)
 
     def patch_service(self, namespace: str, name: str, patch: dict) -> dict:
         return self._services.patch(namespace, name, patch)

@@ -10,41 +10,49 @@ duplicate creations from stale caches.
 
 from __future__ import annotations
 
-import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
+
+from ..analysis.witness import make_lock
 
 # client-go's ExpectationsTimeout.
 EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0
 
 
 class _Expectation:
-    __slots__ = ("adds", "dels", "timestamp")
+    __slots__ = ("adds", "dels", "timestamp", "_clock")
 
-    def __init__(self, adds: int = 0, dels: int = 0):
+    def __init__(self, adds: int = 0, dels: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
         self.adds = adds
         self.dels = dels
-        self.timestamp = time.monotonic()
+        self._clock = clock
+        self.timestamp = clock()
 
     def fulfilled(self) -> bool:
         return self.adds <= 0 and self.dels <= 0
 
     def expired(self) -> bool:
-        return time.monotonic() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
+        return self._clock() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
 
 
 class ControllerExpectations:
-    def __init__(self):
-        self._lock = threading.Lock()
+    """``clock`` stamps expectation timestamps (expiry measurement) —
+    a VirtualClock's ``now`` makes expiry deterministic under the
+    simulator."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = make_lock("expectations")
         self._store: Dict[str, _Expectation] = {}
 
     def expect_creations(self, key: str, count: int) -> None:
         with self._lock:
-            self._store[key] = _Expectation(adds=count)
+            self._store[key] = _Expectation(adds=count, clock=self._clock)
 
     def expect_deletions(self, key: str, count: int) -> None:
         with self._lock:
-            self._store[key] = _Expectation(dels=count)
+            self._store[key] = _Expectation(dels=count, clock=self._clock)
 
     def raise_expectations(self, key: str, adds: int = 0, dels: int = 0) -> None:
         with self._lock:

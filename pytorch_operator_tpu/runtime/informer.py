@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.witness import make_lock, make_rlock
+
 _log = logging.getLogger(__name__)
 
 
@@ -108,7 +110,7 @@ class Store:
     """Thread-safe object cache keyed by ``namespace/name``."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("informer.store")
         self._items: Dict[str, dict] = {}
 
     def add(self, obj: dict) -> None:
@@ -167,8 +169,10 @@ class Informer:
     reference its periodic reconcile, controller.go:129)."""
 
     def __init__(self, source, resync_period: float = 0.0, coalesce=None,
-                 name: Optional[str] = None, registry=None):
+                 name: Optional[str] = None, registry=None,
+                 clock: Callable[[], float] = time.monotonic):
         self._source = source
+        self._clock = clock
         self.store = _make_store()
         # ``name`` opts into per-informer metrics (events by type,
         # coalesced count, resyncs, watch lag, store size) on
@@ -196,7 +200,7 @@ class Informer:
         self._handlers = EventHandlers()
         self._synced = False
         self._started = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("informer.state")
         self._resync_period = resync_period
         self._resync_stop = threading.Event()
         self._resync_thread: Optional[threading.Thread] = None
@@ -212,7 +216,7 @@ class Informer:
         # run under this lock and may mutate the source synchronously
         # (e.g. add_job patches job status; the fake store then notifies
         # this same informer on the same thread), which must re-enter.
-        self._apply_lock = threading.RLock()
+        self._apply_lock = make_rlock("informer.apply")
         self._mutation_seq = 0
         # highest integer resourceVersion this informer has applied —
         # the "since" mark a watch-cache-aware source (list_changes)
@@ -269,7 +273,7 @@ class Informer:
         self._resync_stop.set()
         try:
             self._source.remove_listener(self._on_watch_event)
-        except Exception:
+        except Exception:  # lint: swallowed-except-ok shutdown path; the source may already be torn down and there is nothing left to unhook
             pass
 
     def has_synced(self) -> bool:
@@ -287,7 +291,7 @@ class Informer:
         last = self._last_event_mono
         if last is None:
             return -1.0
-        return round(time.monotonic() - last, 6)
+        return round(self._clock() - last, 6)
 
     # -- resync ------------------------------------------------------------
     def _resync_loop(self) -> None:
@@ -470,7 +474,7 @@ class Informer:
                 self.resync(prefer_windowed=True)
             return
         key = meta_namespace_key(obj)
-        self._last_event_mono = time.monotonic()
+        self._last_event_mono = self._clock()
         with self._apply_lock:
             self._mutation_seq += 1
             self._note_rv(obj)

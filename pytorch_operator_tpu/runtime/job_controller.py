@@ -22,8 +22,10 @@ The concrete controller supplies job-type specifics through the
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.witness import make_lock
 from ..api.v1 import constants
 from ..k8s import serde
 from ..k8s.errors import NotFoundError
@@ -125,7 +127,8 @@ def _make_runtime_core(clock=None):
     pair — the native queue's delay heap lives in C++ against the real
     clock and cannot be driven by a virtual one."""
     if clock is not None:
-        return ControllerExpectations(), WorkQueue(clock=clock)
+        return (ControllerExpectations(clock=clock),
+                WorkQueue(clock=clock))
     from pytorch_operator_tpu.native import (
         NativeExpectations,
         NativeWorkQueue,
@@ -160,17 +163,25 @@ class JobController:
             from ..metrics import default_registry
             registry = default_registry
         self.registry = registry
-        self.recorder = recorder or EventRecorder(cluster.events, self.CONTROLLER_NAME)
+        # one injectable monotonic source for everything this controller
+        # times (sync durations, queue metrics, informer lag) — the
+        # simulator's virtual ``now`` when config.clock is set
+        self.mono_clock = self.config.clock or time.monotonic
+        self.recorder = recorder or EventRecorder(
+            cluster.events, self.CONTROLLER_NAME, clock=self.config.clock)
         # The fan-out executor is OWNED by the controller (constructor-
         # injected into both controls, shut down in shutdown()) so each
         # replica of a sharded fleet can run its own width.
         self.fanout = FanoutExecutor(self.config.create_fanout_width)
+        batch_clock = self.config.clock or time.perf_counter
         self.pod_control = PodControl(cluster.pods, self.recorder,
                                       registry=registry,
-                                      executor=self.fanout)
+                                      executor=self.fanout,
+                                      clock=batch_clock)
         self.service_control = ServiceControl(cluster.services, self.recorder,
                                               registry=registry,
-                                              executor=self.fanout)
+                                              executor=self.fanout,
+                                              clock=batch_clock)
         self.expectations, self.work_queue = _make_runtime_core(
             self.config.clock)
         # shard-runtime registry (populated by the concrete controller
@@ -179,17 +190,20 @@ class JobController:
         # the shard's jobs.  Empty in single-replica mode, where every
         # queue operation resolves to self.work_queue unchanged.
         self._shard_runtimes: Dict[int, object] = {}
-        self._shard_lock = threading.Lock()
+        self._shard_lock = make_lock("controller.shards")
         # client-go workqueue metric families for the one sync queue;
         # both the Python and the native C++ queue take the same hooks.
-        self.work_queue_metrics = WorkQueueMetrics(registry, "pytorchjob")
+        self.work_queue_metrics = WorkQueueMetrics(registry, "pytorchjob",
+                                                   clock=self.mono_clock)
         self.work_queue.set_metrics(self.work_queue_metrics)
         resync = self.config.resync_period_seconds
         self.pod_informer = Informer(cluster.pods, resync_period=resync,
-                                     name="pods", registry=registry)
+                                     name="pods", registry=registry,
+                                     clock=self.mono_clock)
         self.service_informer = Informer(cluster.services,
                                          resync_period=resync,
-                                         name="services", registry=registry)
+                                         name="services", registry=registry,
+                                         clock=self.mono_clock)
         # Node informer: only materialized when disruption handling is on
         # and the cluster backend models Nodes (FakeCluster/RestCluster
         # both do; bare test doubles may not).  The concrete controller's
@@ -200,7 +214,8 @@ class JobController:
             if nodes is not None:
                 self.node_informer = Informer(nodes, resync_period=resync,
                                               name="nodes",
-                                              registry=registry)
+                                              registry=registry,
+                                              clock=self.mono_clock)
         self._stop = threading.Event()
 
         self.pod_informer.add_event_handler(

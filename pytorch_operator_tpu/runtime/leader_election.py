@@ -40,6 +40,7 @@ def _micro_time_now() -> str:
     time of record changes (see ``_observed_at``), never by comparing a
     remote clock with ours.
     """
+    # lint: wall-clock-ok renewTime is cosmetic wire metadata; election liveness is judged by LOCAL observation of record changes, never by parsing this timestamp
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import time
 import uuid
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..api.v1.constants import LABEL_SHARD as _LABEL_SHARD
 
@@ -17,16 +17,22 @@ EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
 
 
-def _now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+def _now_iso(now: Optional[float] = None) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
 
 
 class EventRecorder:
-    """Writes Events to an ``events`` resource client."""
+    """Writes Events to an ``events`` resource client.
 
-    def __init__(self, events_client, component: str = "pytorch-operator"):
+    ``clock`` (epoch-seconds callable, e.g. a VirtualClock's ``now``)
+    stamps first/lastTimestamp; None means the real wall clock, so
+    events recorded under the simulator carry deterministic times."""
+
+    def __init__(self, events_client, component: str = "pytorch-operator",
+                 clock: Optional[Callable[[], float]] = None):
         self._events = events_client
         self.component = component
+        self._clock = clock
 
     def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
         if not isinstance(obj, dict):
@@ -61,13 +67,13 @@ class EventRecorder:
             "type": event_type,
             "count": 1,
             "source": {"component": self.component},
-            "firstTimestamp": _now_iso(),
-            "lastTimestamp": _now_iso(),
+            "firstTimestamp": _now_iso(ts := (
+                self._clock() if self._clock is not None else None)),
+            "lastTimestamp": _now_iso(ts),
         }
         try:
             self._events.create(namespace, ev)
-        except Exception:
-            # Event emission must never break reconciliation.
+        except Exception:  # lint: swallowed-except-ok event emission is best-effort by design; a failed create must never break the reconcile that raised it
             pass
 
     def eventf(self, obj: dict, event_type: str, reason: str, fmt: str, *args) -> None:

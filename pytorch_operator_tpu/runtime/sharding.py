@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ..analysis.witness import make_lock
 from ..k8s.errors import ApiError
 from .leader_election import LeaderElector
 
@@ -220,7 +221,7 @@ class ShardManager:
         # replica-lease name -> ((holder, renewTime), locally observed at)
         self._member_obs: Dict[str, Tuple[tuple, float]] = {}
         self._owned: Set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard-manager")
         self._stop = threading.Event()
         self._release_on_stop = True
         self._thread: Optional[threading.Thread] = None

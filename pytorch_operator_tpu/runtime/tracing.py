@@ -32,13 +32,14 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.witness import make_lock, make_rlock
 from .logger import with_fields
 
 _local = threading.local()
 
-_id_lock = threading.Lock()
+_id_lock = make_lock("tracing.id")
 _next_id = 0
 
 
@@ -86,8 +87,8 @@ class Span:
         self.parent = parent
         self.attrs = dict(attrs or {})
         self.children: List["Span"] = []
-        self.start_time = time.time()
-        self._start_mono = time.monotonic()
+        self.start_time = tracer._wall()
+        self._start_mono = tracer._clock()
         self.duration: Optional[float] = None
         self.error: Optional[str] = None
         if parent is not None:
@@ -100,13 +101,13 @@ class Span:
     def end(self) -> None:
         if self.duration is not None:
             return
-        self.duration = time.monotonic() - self._start_mono
+        self.duration = self.tracer._clock() - self._start_mono
         if self.parent is None:
             self.tracer._finish_root(self)
 
     def to_dict(self) -> dict:
         duration = (self.duration if self.duration is not None
-                    else time.monotonic() - self._start_mono)
+                    else self.tracer._clock() - self._start_mono)
         d: dict = {
             "name": self.name,
             "span_id": self.span_id,
@@ -144,13 +145,23 @@ class Tracer:
 
     ``buffer_size`` 0 keeps nothing (``/debug/traces`` serves an empty
     list) while slow-trace logging still fires; ``slow_threshold`` None
-    or <= 0 disables the slow log line."""
+    or <= 0 disables the slow log line.
+
+    ``clock`` paces span durations and ``wall`` stamps span start
+    times; both default to the real clock and accept a VirtualClock's
+    ``now`` so traces captured under the simulator are deterministic
+    (same seed, byte-identical span timings)."""
 
     def __init__(self, buffer_size: int = 256,
                  slow_threshold: Optional[float] = None,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Optional[Callable[[], float]] = None):
         self._buf: deque = deque(maxlen=max(0, int(buffer_size)))
-        self._lock = threading.RLock()
+        self._lock = make_rlock("tracer")
+        self._clock = clock
+        self._wall = wall if wall is not None \
+            else (time.time if clock is time.monotonic else clock)
         self.slow_threshold = slow_threshold
         self.logger = logger or logging.getLogger("pytorch-operator.trace")
 

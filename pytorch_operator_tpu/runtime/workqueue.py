@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis.witness import make_lock
+
 
 class WorkQueueMetrics:
     """client-go's util/workqueue metrics provider for ONE named queue.
@@ -38,9 +40,11 @@ class WorkQueueMetrics:
     #: resolution is noise for a Python control loop, so start at 10us.
     DURATION_BUCKETS = (1e-05, 1e-04, 1e-03, 0.01, 0.1, 1.0, 10.0, 30.0)
 
-    def __init__(self, registry, name: str):
+    def __init__(self, registry, name: str,
+                 clock: Callable[[], float] = time.monotonic):
         self.name = name
-        self._lock = threading.Lock()
+        self._clock = clock
+        self._lock = make_lock(f"workqueue.metrics.{name}")
         self._added_at: Dict[Any, float] = {}
         self._started_at: Dict[Any, float] = {}
         label = {"name": name}
@@ -85,10 +89,10 @@ class WorkQueueMetrics:
     def on_add(self, item: Any) -> None:
         self.adds.inc()
         with self._lock:
-            self._added_at.setdefault(item, time.monotonic())
+            self._added_at.setdefault(item, self._clock())
 
     def on_get(self, item: Any) -> None:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             added = self._added_at.pop(item, None)
             self._started_at[item] = now
@@ -96,7 +100,7 @@ class WorkQueueMetrics:
             self.queue_duration.observe(now - added)
 
     def on_done(self, item: Any) -> None:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             started = self._started_at.pop(item, None)
         if started is not None:
@@ -107,12 +111,12 @@ class WorkQueueMetrics:
 
     # -- scrape-time gauges -------------------------------------------------
     def _unfinished_seconds(self) -> float:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             return round(sum(now - t for t in self._started_at.values()), 6)
 
     def _longest_running_seconds(self) -> float:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             if not self._started_at:
                 return 0.0
@@ -130,7 +134,7 @@ class RateLimiter:
         self.base_delay = base_delay
         self.max_delay = max_delay
         self._failures: Dict[Any, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("workqueue.ratelimiter")
 
     def when(self, item: Any) -> float:
         with self._lock:
@@ -164,7 +168,7 @@ class WorkQueue:
     def __init__(self, rate_limiter: Optional[RateLimiter] = None,
                  clock: Callable[[], float] = time.monotonic):
         self._clock = clock
-        self._lock = threading.Condition()
+        self._lock = threading.Condition(make_lock("workqueue"))
         self._queue: List[Any] = []
         self._dirty: set = set()
         self._processing: set = set()

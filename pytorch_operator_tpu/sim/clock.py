@@ -42,15 +42,16 @@ class VirtualTimer:
     need no branching.
     """
 
-    __slots__ = ("_clock", "_delay", "_fn", "_args", "_cancelled",
-                 "_started", "daemon")
+    __slots__ = ("_clock", "_delay", "_fn", "_args", "_kwargs",
+                 "_cancelled", "_started", "daemon")
 
     def __init__(self, clock: "VirtualClock", delay: float,
-                 fn: Callable, args: Tuple = ()):
+                 fn: Callable, args: Tuple = (), kwargs: Optional[dict] = None):
         self._clock = clock
         self._delay = max(0.0, float(delay))
         self._fn = fn
         self._args = tuple(args)
+        self._kwargs = dict(kwargs) if kwargs else {}
         self._cancelled = False
         self._started = False
         self.daemon = True
@@ -66,7 +67,7 @@ class VirtualTimer:
 
     def _fire(self) -> None:
         if not self._cancelled:
-            self._fn(*self._args)
+            self._fn(*self._args, **self._kwargs)
 
 
 class VirtualClock:
@@ -104,9 +105,10 @@ class VirtualClock:
 
     # -- scheduling --------------------------------------------------------
     def timer(self, delay: float, fn: Callable,
-              args: Tuple = ()) -> VirtualTimer:
+              args: Tuple = (),
+              kwargs: Optional[dict] = None) -> VirtualTimer:
         """An unarmed ``threading.Timer`` stand-in; call ``start()``."""
-        return VirtualTimer(self, delay, fn, args)
+        return VirtualTimer(self, delay, fn, args, kwargs)
 
     def call_later(self, delay: float, fn: Callable,
                    *args) -> VirtualTimer:

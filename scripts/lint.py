@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Concurrency & determinism lint driver.
+
+Runs the :mod:`pytorch_operator_tpu.analysis` AST rules over the tree
+(default: the package + scripts/) and reports findings.  Waived
+findings (``# lint: <rule>-ok <reason>``) are listed but do not fail
+the gate; every waiver must carry a reason.
+
+Exit codes: 0 clean (possibly with waived findings), 1 unwaived
+findings, 2 usage error.
+
+    python scripts/lint.py                 # whole tree
+    python scripts/lint.py path/to/file.py # specific files/dirs
+    python scripts/lint.py --json          # machine-readable
+    python scripts/lint.py --list-rules    # rule catalog + pragmas
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from pytorch_operator_tpu.analysis import engine  # noqa: E402
+from pytorch_operator_tpu.analysis.rules import RULES  # noqa: E402
+
+
+def _list_rules() -> str:
+    lines = ["rule catalog (pragma: # lint: <rule>-ok <reason>):", ""]
+    for key, (fn, scope) in sorted(RULES.items()):
+        doc = (fn.__doc__ or "").strip().splitlines()[0]
+        where = {"is_clock_injectable": "clock-injectable modules",
+                 "is_reconcile_path": "reconcile-path modules",
+                 None: "whole tree"}[scope]
+        lines.append(f"  {key:18s} [{where}]")
+        lines.append(f"    {doc}")
+    lines += ["", "engine findings (not waivable):",
+              "  parse-error, waiver-missing-reason, unused-waiver, "
+              "unknown-pragma"]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="concurrency & determinism lint")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: whole tree)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress waived findings in the listing")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    if args.paths:
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            print(f"lint: no such path: {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+        findings = engine.scan_paths(args.paths, root=os.getcwd())
+    else:
+        findings = engine.scan_tree(_REPO_ROOT)
+
+    bad = engine.unwaived(findings)
+    waived = [f for f in findings if f.waived]
+
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in bad:
+            print(f.format())
+        if not args.quiet:
+            for f in waived:
+                print(f.format())
+        print(f"lint: {len(bad)} finding(s), {len(waived)} waived")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,13 +12,37 @@ cd "$(dirname "$0")/.."
 # (the full 10k-job / 50k-pod determinism check, pytest -m slow) after
 # the regular gate — kept out of the default run so CI stays inside
 # its time budget.
+# --lint runs ONLY the concurrency & determinism lint gate (the fast
+# pre-commit path); the same gate always runs ahead of the test tier.
+# --tsan additionally builds and runs the native ThreadSanitizer tier.
+# --witness runs the test tier under the runtime lock-order witness
+# (pytest --lock-witness): any observed lock-order cycle fails the run.
 RUN_SCALE=0
+LINT_ONLY=0
+RUN_TSAN=0
+WITNESS_ARGS=()
 for arg in "$@"; do
   case "$arg" in
     --scale) RUN_SCALE=1 ;;
-    *) echo "unknown argument: $arg (supported: --scale)" >&2; exit 2 ;;
+    --lint) LINT_ONLY=1 ;;
+    --tsan) RUN_TSAN=1 ;;
+    --witness) WITNESS_ARGS=(--lock-witness) ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --witness)" >&2; exit 2 ;;
   esac
 done
+
+echo "=== concurrency & determinism lint ==="
+# AST rules over the whole tree (wall-clock in clock-injectable paths,
+# builtin hash(), unseeded random, blocking calls under locks,
+# swallowed exceptions on reconcile paths); exit 1 on any unwaived
+# finding.  Runs FIRST: a determinism regression makes the simulator
+# tiers below meaningless.
+python scripts/lint.py --quiet
+
+if [ "$LINT_ONLY" = 1 ]; then
+  echo "lint gate passed (--lint: skipping the rest)"
+  exit 0
+fi
 
 echo "=== build: native runtime core ==="
 make -C native
@@ -48,13 +72,13 @@ echo "=== tests ==="
 # slow tiers (the 10k-job scale simulation) stay out of the default
 # gate; opt in with --scale
 if python -c "import pytest_cov" >/dev/null 2>&1; then
-  python -m pytest tests/ -q -m "not slow" --cov=pytorch_operator_tpu --cov-report=term
+  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}" --cov=pytorch_operator_tpu --cov-report=term
 elif python -m coverage --version >/dev/null 2>&1; then
-  python -m coverage run -m pytest tests/ -q -m "not slow"
+  python -m coverage run -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}"
   python -m coverage report --include="pytorch_operator_tpu/*"
 else
   echo "(coverage tooling not in image — running plain pytest)"
-  python -m pytest tests/ -q -m "not slow"
+  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}"
 fi
 
 echo "=== sanitize: native core under ASan+UBSan ==="
@@ -73,6 +97,16 @@ if [ -f "$LIBASAN" ]; then
       tests/test_rest.py tests/test_rest_tls.py -q
 else
   echo "libasan not found in toolchain — sanitize tier skipped"
+fi
+
+if [ "$RUN_TSAN" = 1 ]; then
+  echo "=== tsan: native core under ThreadSanitizer ==="
+  # A dedicated stress binary (not the .so under Python: TSan must see
+  # every thread, and an uninstrumented CPython host would bury real
+  # races in false positives) hammering the workqueue, expectations
+  # store and object store from concurrent producers/consumers.
+  make -C native tsan
+  TSAN_OPTIONS="halt_on_error=1" ./native/build/tsan_stress
 fi
 
 echo "=== driver compile checks ==="

@@ -36,6 +36,41 @@ import pytest  # noqa: E402
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lock-witness", action="store_true", default=False,
+        help="record the runtime lock-acquisition graph for the whole "
+             "session and fail it if the observed order has a cycle "
+             "(a latent deadlock)")
+
+
+def pytest_configure(config):
+    if config.getoption("--lock-witness"):
+        from pytorch_operator_tpu.analysis.witness import enable_witness
+
+        config._lock_witness = enable_witness()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The --lock-witness gate: at session end, any cycle in the
+    observed lock order fails the run with both acquisition stacks of
+    every edge — the deadlock report BEFORE the deadlock."""
+    witness = getattr(session.config, "_lock_witness", None)
+    if witness is None:
+        return
+    from pytorch_operator_tpu.analysis.witness import disable_witness
+
+    disable_witness()
+    report = witness.report()
+    edges = len(witness.edge_names())
+    sys.stderr.write(
+        f"\n[lock-witness] {witness.acquisitions} acquisitions, "
+        f"{edges} ordered pair(s), {len(witness.cycles())} cycle(s)\n")
+    if report:
+        sys.stderr.write(report + "\n")
+        session.exitstatus = 1
+
+
 def _artifact_dir() -> str:
     return os.environ.get(
         "E2E_ARTIFACTS_DIR", os.path.join(_REPO_ROOT, "test-artifacts"))

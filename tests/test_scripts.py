@@ -62,6 +62,58 @@ def test_all_shell_scripts_parse():
         assert proc.returncode == 0, f"{path}: {proc.stderr}"
 
 
+def _run_lint(*argv):
+    return subprocess.run(
+        ["python", os.path.join(REPO, "scripts", "lint.py"), *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_lint_driver_exit_codes(tmp_path):
+    """The CI contract for scripts/lint.py: clean=0, findings=1,
+    pragma'd=0 (and the pragma must carry a reason to count)."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("import hashlib\nx = hashlib.blake2b(b'k')\n")
+    proc = _run_lint(str(clean))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+    # builtin-hash is tree-wide scoped, so it fires even on a tmp file
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("x = hash('k') % 8\n")
+    proc = _run_lint(str(dirty))
+    assert proc.returncode == 1
+    assert "[builtin-hash]" in proc.stdout
+
+    waived = tmp_path / "waived.py"
+    waived.write_text(
+        "x = hash('k') % 8  # lint: builtin-hash-ok process-local memo\n")
+    proc = _run_lint(str(waived))
+    assert proc.returncode == 0
+    assert "waived: process-local memo" in proc.stdout
+
+    # a reasonless waiver does NOT count as clean
+    bad_waiver = tmp_path / "bad_waiver.py"
+    bad_waiver.write_text("x = hash('k')  # lint: builtin-hash-ok\n")
+    assert _run_lint(str(bad_waiver)).returncode == 1
+
+    # usage error: missing path
+    assert _run_lint(str(tmp_path / "no_such.py")).returncode == 2
+
+
+def test_lint_tree_gate_and_rule_catalog():
+    """The repo itself must pass its own gate (exit 0 over the default
+    roots), and --list-rules documents the pragma vocabulary."""
+    proc = _run_lint("--quiet")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+    proc = _run_lint("--list-rules")
+    assert proc.returncode == 0
+    for key in ("wall-clock", "builtin-hash", "unseeded-random",
+                "blocking-in-lock", "swallowed-except"):
+        assert key in proc.stdout
+
+
 def test_storm_tier_smoke(monkeypatch):
     """The event-storm bench tier (round-5 verdict item 5) must run:
     active watch streams receive generated events while jobs complete,
