@@ -23,7 +23,13 @@ This package is the checking machinery itself:
   * :mod:`.witness` — a runtime lock-order witness: instrumented
     Lock/RLock factories the runtime's locks are built through, which
     (when enabled) record the per-thread lock-acquisition graph and
-    report any cycle with the two offending acquisition stacks.
+    report any cycle with the two offending acquisition stacks;
+  * :mod:`.ownership` — the shared-cache read-only contract: the
+    blessed ``owned()`` deep-copy helper the ``cache-mutation`` rule
+    recognizes as an ownership transfer, plus a client-go-style
+    ``CacheMutationDetector`` that fingerprints sampled cached objects
+    and reports any in-place mutation with key, field diff, and the
+    handler that last received the object.
 """
 
 from .engine import Finding, scan_file, scan_paths, scan_tree  # noqa: F401
@@ -33,4 +39,12 @@ from .witness import (  # noqa: F401
     witness_active,
     enable_witness,
     disable_witness,
+)
+from .ownership import (  # noqa: F401
+    owned,
+    CacheMutationDetector,
+    MutationRecord,
+    enable_cache_mutation_detector,
+    disable_cache_mutation_detector,
+    cache_mutation_detector_active,
 )

@@ -18,16 +18,20 @@ from typing import Sequence, Tuple
 #: ``VirtualClock.timer``) somewhere in their construction chain — a raw
 #: wall-clock call here either bypasses the injection (breaking the
 #: simulator's same-seed determinism) or marks a path the injection has
-#: not reached yet.  The sim package itself is excluded: it IS the
-#: clock, and its driver deliberately measures real wall time to report
-#: the simulator's leverage (virtual vs real seconds).
+#: not reached yet.  The sim driver's deliberate real-wall-time reads
+#: (it reports the simulator's leverage, virtual vs real seconds) carry
+#: reasoned waivers rather than a scope exclusion, so any NEW wall
+#: reads in sim/ must justify themselves too.
 CLOCK_INJECTABLE: Tuple[str, ...] = (
     "pytorch_operator_tpu/runtime/",
     "pytorch_operator_tpu/controller/",
     "pytorch_operator_tpu/disruption/",
+    "pytorch_operator_tpu/telemetry/",
     "pytorch_operator_tpu/k8s/resilience.py",
     "pytorch_operator_tpu/k8s/fake_kubelet.py",
     "pytorch_operator_tpu/native/__init__.py",
+    "pytorch_operator_tpu/sim/fleet.py",
+    "pytorch_operator_tpu/sim/scale.py",
 )
 
 #: Modules on the reconcile path, where a silently swallowed exception
@@ -37,6 +41,20 @@ RECONCILE_PATHS: Tuple[str, ...] = (
     "pytorch_operator_tpu/controller/",
     "pytorch_operator_tpu/runtime/",
     "pytorch_operator_tpu/disruption/",
+)
+
+#: Modules that consume shared-cache objects — informer store reads,
+#: event-handler payloads, ``FakeCluster``/``RestCluster`` watch
+#: deliveries.  The ``cache-mutation`` rule tracks cache-sourced
+#: variables here and flags in-place writes that lack an ownership
+#: transfer (``copy.deepcopy`` / ``_copy_obj`` / serde parse /
+#: ``analysis.owned``).
+CACHE_CONSUMER_PATHS: Tuple[str, ...] = (
+    "pytorch_operator_tpu/controller/",
+    "pytorch_operator_tpu/runtime/",
+    "pytorch_operator_tpu/disruption/",
+    "pytorch_operator_tpu/sim/",
+    "pytorch_operator_tpu/k8s/fake_kubelet.py",
 )
 
 #: Default scan roots for the tree-wide run (scripts/lint.py with no
@@ -51,15 +69,16 @@ DEFAULT_SCAN_ROOTS: Tuple[str, ...] = (
 class AnalysisConfig:
     """Which paths each scoped rule applies to.
 
-    ``clock_injectable`` / ``reconcile_paths``: path-prefix lists; a
-    file matches when its repo-relative POSIX path starts with any
-    entry.  An empty tuple disables the scoped rule everywhere; tests
-    use ``("",)`` (matches everything) to run a scoped rule on fixture
-    files.
+    ``clock_injectable`` / ``reconcile_paths`` / ``cache_consumer_paths``:
+    path-prefix lists; a file matches when its repo-relative POSIX path
+    starts with any entry.  An empty tuple disables the scoped rule
+    everywhere; tests use ``("",)`` (matches everything) to run a
+    scoped rule on fixture files.
     """
 
     clock_injectable: Sequence[str] = field(default=CLOCK_INJECTABLE)
     reconcile_paths: Sequence[str] = field(default=RECONCILE_PATHS)
+    cache_consumer_paths: Sequence[str] = field(default=CACHE_CONSUMER_PATHS)
 
     @staticmethod
     def _matches(rel_path: str, prefixes: Sequence[str]) -> bool:
@@ -71,6 +90,9 @@ class AnalysisConfig:
 
     def is_reconcile_path(self, rel_path: str) -> bool:
         return self._matches(rel_path, self.reconcile_paths)
+
+    def is_cache_consumer(self, rel_path: str) -> bool:
+        return self._matches(rel_path, self.cache_consumer_paths)
 
 
 #: Shared default — what scripts/lint.py and test_analysis.py use.

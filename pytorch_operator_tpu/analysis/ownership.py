@@ -1,0 +1,335 @@
+"""Object ownership & cache-mutation detection.
+
+The informer caches and the fake apiserver's watch fan-out hand out
+SHARED objects: ``Store.get_by_key``/``Store.list`` return the cached
+dicts directly, and ``FakeResourceStore._notify`` delivers ONE copy to
+every listener of a watch event.  The contract (client-go's informer
+contract, inherited wholesale) is that consumers treat those objects as
+read-only and take an explicit ownership transfer — ``copy.deepcopy``,
+``k8s.fake._copy_obj``, a serde parse, or :func:`owned` — before
+mutating.  One handler that writes into its event object silently
+corrupts every sibling informer, the label index, and the simulator's
+determinism fingerprint.
+
+Two enforcement sides live here:
+
+  * :func:`owned` — the blessed deep-copy helper the static
+    ``cache-mutation`` rule (:mod:`.rules`) recognizes as an ownership
+    transfer;
+  * :class:`CacheMutationDetector` — the runtime side, modeled on
+    client-go's ``KUBE_CACHE_MUTATION_DETECTOR``: cache write points
+    record a structural fingerprint of sampled objects and re-verify on
+    a count-based cadence and at teardown, reporting the object key, a
+    field-level diff, and the handler registration that last received
+    the object.  Armed via the pytest ``--cache-mutation-detector``
+    flag (fails the session on any detected mutation) or the
+    ``PYTORCH_OPERATOR_CACHE_MUTATION_DETECTOR`` env var on a live
+    operator (which then counts detections in
+    ``pytorch_operator_cache_mutations_total``).
+
+Determinism: the detector reads no clock and draws no randomness — the
+sampling and verification cadences are pure operation counts — so
+arming it under the virtual-time simulator leaves the same-seed
+fingerprint byte-identical.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "owned", "CacheMutationDetector", "MutationRecord",
+    "enable_cache_mutation_detector", "disable_cache_mutation_detector",
+    "cache_mutation_detector_active",
+]
+
+#: the active detector, or None (the common case: one global read and
+#: zero recording on every cache write / handler dispatch)
+_detector: Optional["CacheMutationDetector"] = None
+
+
+def owned(obj: Any) -> Any:
+    """Deep copy that marks an explicit ownership transfer.
+
+    ``mine = owned(store.get_by_key(key))`` reads as intent — this code
+    is about to mutate — and the static ``cache-mutation`` rule treats
+    the result as launderable, exactly like ``copy.deepcopy`` or a
+    serde parse.  Wire-format trees (dict/list/scalars — what every
+    cache in this repo holds) take a direct recursive copy (~5x cheaper
+    than ``copy.deepcopy``'s memo bookkeeping); anything else falls
+    back to ``copy.deepcopy``.
+    """
+    t = type(obj)
+    if t is dict:
+        return {k: owned(v) for k, v in obj.items()}
+    if t is list:
+        return [owned(v) for v in obj]
+    if t is str or t is int or t is float or t is bool or obj is None:
+        return obj
+    return copy.deepcopy(obj)
+
+
+# -- structural fingerprints -------------------------------------------------
+
+def _walk(obj: Any, update: Callable[[bytes], None]) -> None:
+    """Feed a canonical byte stream of ``obj``'s structure+values to
+    ``update``.  Dict keys are visited sorted so logically equal trees
+    digest equally regardless of insertion order; type tags keep
+    ``{"a": 1}`` and ``["a", 1]`` from colliding."""
+    t = type(obj)
+    if t is dict:
+        update(b"{")
+        for k in sorted(obj):
+            update(str(k).encode("utf-8", "replace"))
+            update(b"=")
+            _walk(obj[k], update)
+            update(b";")
+        update(b"}")
+    elif t is list or t is tuple:
+        update(b"[")
+        for v in obj:
+            _walk(v, update)
+            update(b",")
+        update(b"]")
+    elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        # reuse the serde field plans (cached per class) instead of
+        # paying dataclasses.fields reflection per fingerprint
+        from ..k8s.serde import _plan
+
+        update(b"<")
+        update(type(obj).__name__.encode())
+        for name, _wire, _hint, _opt in _plan(type(obj)):
+            update(name.encode())
+            update(b"=")
+            _walk(getattr(obj, name), update)
+            update(b";")
+        update(b">")
+    elif obj is None:
+        update(b"~")
+    else:
+        update(type(obj).__name__.encode())
+        update(b":")
+        update(repr(obj).encode("utf-8", "replace"))
+
+
+def fingerprint(obj: Any) -> bytes:
+    """Cheap structural digest of a cached object."""
+    h = hashlib.blake2b(digest_size=16)
+    _walk(obj, h.update)
+    return h.digest()
+
+
+def _diff_paths(snapshot: Any, live: Any, path: str = "") -> Iterator[str]:
+    """Dotted field paths where ``live`` diverged from ``snapshot``,
+    each with a short before/after rendering."""
+    if type(snapshot) is dict and type(live) is dict:
+        for k in sorted(set(snapshot) | set(live)):
+            sub = f"{path}.{k}" if path else str(k)
+            if k not in snapshot:
+                yield f"{sub}: <absent> -> {_short(live[k])}"
+            elif k not in live:
+                yield f"{sub}: {_short(snapshot[k])} -> <removed>"
+            else:
+                yield from _diff_paths(snapshot[k], live[k], sub)
+    elif type(snapshot) is list and type(live) is list:
+        if len(snapshot) != len(live):
+            yield (f"{path}: list length {len(snapshot)} -> {len(live)}")
+        for i, (a, b) in enumerate(zip(snapshot, live)):
+            yield from _diff_paths(a, b, f"{path}[{i}]")
+    elif snapshot != live or type(snapshot) is not type(live):
+        yield f"{path or '<root>'}: {_short(snapshot)} -> {_short(live)}"
+
+
+def _short(v: Any, limit: int = 60) -> str:
+    text = repr(v)
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+# -- the detector ------------------------------------------------------------
+
+class _Sample:
+    __slots__ = ("live", "snapshot", "digest", "last_handler")
+
+    def __init__(self, live: Any):
+        self.live = live
+        self.snapshot = owned(live)
+        self.digest = fingerprint(live)
+        self.last_handler: Optional[str] = None
+
+
+class MutationRecord:
+    """One detected in-place mutation of a cached object."""
+
+    __slots__ = ("source", "key", "diffs", "last_handler")
+
+    def __init__(self, source: str, key: str, diffs: List[str],
+                 last_handler: Optional[str]):
+        self.source = source
+        self.key = key
+        self.diffs = diffs
+        self.last_handler = last_handler
+
+    def format(self) -> str:
+        handler = self.last_handler or "(no handler delivery recorded)"
+        lines = [f"cached object MUTATED: {self.key} (source {self.source})",
+                 f"  last delivered to: {handler}"]
+        lines += [f"  {d}" for d in (self.diffs or ["(no field diff — "
+                                                    "identical re-digest?)"])]
+        return "\n".join(lines)
+
+
+class CacheMutationDetector:
+    """Runtime cache-mutation detection by sampling + re-verification.
+
+    Cache write points call :meth:`record`; handler dispatch loops call
+    :meth:`note_delivery` so a detection can name the registration that
+    last received the object.  Every ``sample_every``-th record of a
+    (source, key) is sampled: the live reference is kept alongside an
+    owned snapshot and a structural fingerprint.  Verification re-digests
+    the live reference against the recorded fingerprint — on mismatch
+    the owned snapshot yields the field-level diff — and runs:
+
+      * when a sample is REPLACED by a newer object for the same key
+        (the store applied a fresh watch event);
+      * when the bounded sample table evicts its oldest entry;
+      * every ``verify_every`` record operations (the cadence);
+      * at :meth:`verify_all` (pytest sessionfinish / operator
+        shutdown).
+
+    All cadences are operation counts — no clocks, no RNG — so an armed
+    detector cannot perturb the simulator's virtual timeline.
+    """
+
+    def __init__(self, sample_every: int = 4, verify_every: int = 256,
+                 max_samples: int = 2048,
+                 on_mutation: Optional[Callable[[MutationRecord],
+                                                None]] = None):
+        # plain threading.Lock, NOT witness.make_lock: record() runs
+        # under the informer-store and fake-cluster locks, and routing
+        # this lock through the witness would make every armed-detector
+        # run's lock graph differ from the unarmed one it certifies
+        self._mu = threading.Lock()
+        self._sample_every = max(1, int(sample_every))
+        self._verify_every = max(1, int(verify_every))
+        self._max_samples = max(1, int(max_samples))
+        self._on_mutation = on_mutation
+        self._samples: Dict[Tuple[str, str], _Sample] = {}
+        self._ops = 0
+        self.records = 0
+        self.sampled = 0
+        self.verified = 0
+        self.mutations: List[MutationRecord] = []
+
+    # -- hooks (hot path) --------------------------------------------------
+    def record(self, source: str, key: str, obj: Any) -> None:
+        """Note one cache write of ``obj`` under ``key``; sampled on a
+        count cadence.  Replacing an existing sample verifies the old
+        one first — the displaced object was still covered by the
+        read-only contract up to this write."""
+        overdue = []
+        with self._mu:
+            self._ops += 1
+            self.records += 1
+            sk = (source, key)
+            old = self._samples.get(sk)
+            if old is not None and old.live is not obj:
+                overdue.append((sk, self._samples.pop(sk)))
+            if old is None and self._ops % self._sample_every == 0:
+                self._samples[sk] = _Sample(obj)
+                self.sampled += 1
+                while len(self._samples) > self._max_samples:
+                    evict_key = next(iter(self._samples))
+                    overdue.append((evict_key,
+                                    self._samples.pop(evict_key)))
+            cadence = self._ops % self._verify_every == 0
+        for sk, sample in overdue:
+            self._verify_one(sk, sample)
+        if cadence:
+            self.verify_all(drop=False)
+
+    def note_delivery(self, source: str, key: str, handler: str) -> None:
+        """Attribute the handler registration that just received the
+        (source, key) object — the "who last touched it" in reports."""
+        with self._mu:
+            sample = self._samples.get((source, key))
+            if sample is not None:
+                sample.last_handler = handler
+
+    # -- verification ------------------------------------------------------
+    def _verify_one(self, sk: Tuple[str, str], sample: _Sample) -> None:
+        self.verified += 1
+        if fingerprint(sample.live) == sample.digest:
+            return
+        record = MutationRecord(
+            sk[0], sk[1],
+            list(_diff_paths(sample.snapshot, sample.live)),
+            sample.last_handler)
+        with self._mu:
+            self.mutations.append(record)
+        if self._on_mutation is not None:
+            try:
+                self._on_mutation(record)
+            except Exception:
+                pass  # detection reporting must never break the caller
+
+    def verify_all(self, drop: bool = True) -> List[MutationRecord]:
+        """Re-verify every current sample; ``drop`` empties the table
+        (teardown).  Returns all mutations detected so far."""
+        with self._mu:
+            items = list(self._samples.items())
+            if drop:
+                self._samples.clear()
+        for sk, sample in items:
+            self._verify_one(sk, sample)
+            if not drop:
+                # keep watching, but re-baseline a mutated sample so one
+                # corrupted object reports once, not once per cadence
+                if self.mutations and self.mutations[-1].key == sk[1]:
+                    sample.snapshot = owned(sample.live)
+                    sample.digest = fingerprint(sample.live)
+        return list(self.mutations)
+
+    def report(self) -> str:
+        """Human-readable account of every detected mutation; empty
+        string when the read-only contract held."""
+        if not self.mutations:
+            return ""
+        out = [f"CACHE MUTATIONS DETECTED: {len(self.mutations)}"]
+        out += [m.format() for m in self.mutations]
+        return "\n".join(out)
+
+
+def enable_cache_mutation_detector(**kwargs) -> CacheMutationDetector:
+    """Install (and return) a fresh detector; every subsequent cache
+    write through the instrumented stores is observed until
+    :func:`disable_cache_mutation_detector`."""
+    global _detector
+    d = CacheMutationDetector(**kwargs)
+    _detector = d
+    return d
+
+
+def disable_cache_mutation_detector() -> Optional[CacheMutationDetector]:
+    """Stop observing; returns the detector that was active (its
+    samples and mutation list stay queryable) or None."""
+    global _detector
+    d = _detector
+    _detector = None
+    return d
+
+
+def cache_mutation_detector_active() -> Optional[CacheMutationDetector]:
+    return _detector
+
+
+def handler_name(fn: Any) -> str:
+    """Stable display name for a handler registration."""
+    name = getattr(fn, "__qualname__", None)
+    if name:
+        module = getattr(fn, "__module__", "")
+        return f"{module}.{name}" if module else name
+    return repr(fn)

@@ -14,7 +14,8 @@ only the *call* ``time.monotonic()`` bypasses it.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 RawFinding = Tuple[int, int, str]
 
@@ -341,6 +342,215 @@ def rule_swallowed_except(tree: ast.AST) -> Iterator[RawFinding]:
                     f"path — log, count, or re-raise")
 
 
+# -- rule: cache-mutation ---------------------------------------------------
+
+#: informer event handlers and watch callbacks — their object parameters
+#: are shared cache references, never owned
+_HANDLER_NAME_RE = re.compile(
+    r"^_?(?:on_)?(?:add|update|delete)_(?:job|pod|service|node)s?$")
+
+#: functions whose return value is a cached object handed out by
+#: reference (controller cache accessors)
+_CACHE_ACCESSOR_FNS = {"_get_job_from_cache", "_resolve_controller_ref"}
+
+#: methods that read *into* a tainted container without transferring
+#: ownership — the result aliases the cached tree
+_ALIASING_METHODS = {"get", "items", "values", "keys"}
+
+#: in-place mutators on dicts/lists — writing through any of these on a
+#: cached object corrupts every other consumer of the same reference
+_MUTATOR_METHODS = {
+    "update", "setdefault", "pop", "popitem", "clear",
+    "append", "extend", "insert", "remove", "sort", "reverse",
+}
+
+
+def _is_cache_source_call(call: ast.Call) -> bool:
+    """Calls that hand out a cached object by reference."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "get_by_key":
+            return True
+        if fn.attr == "list" and "store" in _expr_text(fn.value).lower():
+            return True
+        if fn.attr in _CACHE_ACCESSOR_FNS:
+            return True
+    elif isinstance(fn, ast.Name) and fn.id in _CACHE_ACCESSOR_FNS:
+        return True
+    return False
+
+
+def _is_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``expr`` evaluate to (part of) a cache-owned object?
+
+    Attribute/Subscript access and the aliasing dict methods propagate
+    taint; every other call is treated as an ownership transfer — that
+    is exactly the laundering vocabulary (``copy.deepcopy``,
+    ``_copy_obj``, a serde parse, ``analysis.owned``) plus ordinary
+    value-producing calls, which cannot return the cached tree itself.
+    """
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Starred)):
+        return _is_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        if _is_cache_source_call(expr):
+            return True
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in _ALIASING_METHODS):
+            return _is_tainted(expr.func.value, tainted)
+        return False
+    if isinstance(expr, ast.BoolOp):
+        return any(_is_tainted(v, tainted) for v in expr.values)
+    if isinstance(expr, ast.IfExp):
+        return (_is_tainted(expr.body, tainted)
+                or _is_tainted(expr.orelse, tainted))
+    if isinstance(expr, ast.NamedExpr):
+        return _is_tainted(expr.value, tainted)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_is_tainted(v, tainted) for v in expr.elts)
+    return False
+
+
+def _bind(target: ast.AST, is_tainted: bool, tainted: Set[str]) -> None:
+    """Record a (re)binding: tainted values taint the name, owned
+    values clear it."""
+    if isinstance(target, ast.Name):
+        if is_tainted:
+            tainted.add(target.id)
+        else:
+            tainted.discard(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            _bind(el, is_tainted, tainted)
+    elif isinstance(target, ast.Starred):
+        _bind(target.value, is_tainted, tainted)
+
+
+def _expr_calls(expr: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes lexically inside ``expr`` (not inside lambdas)."""
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _mutator_sinks(expr: ast.AST, tainted: Set[str],
+                   out: List[RawFinding]) -> None:
+    for call in _expr_calls(expr):
+        fn = call.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in _MUTATOR_METHODS
+                and _is_tainted(fn.value, tainted)):
+            lo, hi = _span(call)
+            out.append((lo, hi, (
+                f".{fn.attr}() mutates a cache-owned object in place — "
+                f"take analysis.owned()/copy.deepcopy first")))
+
+
+def _write_sink(target: ast.AST, tainted: Set[str], stmt: ast.stmt,
+                out: List[RawFinding], what: str) -> None:
+    if (isinstance(target, (ast.Attribute, ast.Subscript))
+            and _is_tainted(target.value, tainted)):
+        lo, hi = _span(stmt)
+        out.append((lo, hi, (
+            f"{what} writes into a cache-owned object — informer/watch "
+            f"objects are shared read-only; take analysis.owned()/"
+            f"copy.deepcopy before mutating")))
+
+
+def _scan_stmts(stmts: Sequence[ast.stmt], tainted: Set[str],
+                out: List[RawFinding]) -> None:
+    """Ordered, single-pass taint walk — no CFG, no fixpoint.  Branch
+    bodies are walked in source order against one shared taint set: a
+    rebinding anywhere clears the name for everything after, which
+    trades a few theoretical false negatives for zero loop-analysis
+    cost and very predictable findings."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # runs in its own scope; checked independently
+        if isinstance(stmt, ast.Assign):
+            _mutator_sinks(stmt.value, tainted, out)
+            value_tainted = _is_tainted(stmt.value, tainted)
+            for tgt in stmt.targets:
+                _write_sink(tgt, tainted, stmt, out, "assignment")
+                _bind(tgt, value_tainted, tainted)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                _mutator_sinks(stmt.value, tainted, out)
+                _write_sink(stmt.target, tainted, stmt, out, "assignment")
+                _bind(stmt.target, _is_tainted(stmt.value, tainted),
+                      tainted)
+        elif isinstance(stmt, ast.AugAssign):
+            _mutator_sinks(stmt.value, tainted, out)
+            _write_sink(stmt.target, tainted, stmt, out,
+                        "augmented assignment")
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                _write_sink(tgt, tainted, stmt, out, "del")
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _mutator_sinks(stmt.iter, tainted, out)
+            _bind(stmt.target, _is_tainted(stmt.iter, tainted), tainted)
+            _scan_stmts(stmt.body, tainted, out)
+            _scan_stmts(stmt.orelse, tainted, out)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            _mutator_sinks(stmt.test, tainted, out)
+            _scan_stmts(stmt.body, tainted, out)
+            _scan_stmts(stmt.orelse, tainted, out)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                _mutator_sinks(item.context_expr, tainted, out)
+                if item.optional_vars is not None:
+                    _bind(item.optional_vars,
+                          _is_tainted(item.context_expr, tainted), tainted)
+            _scan_stmts(stmt.body, tainted, out)
+        elif isinstance(stmt, ast.Try):
+            _scan_stmts(stmt.body, tainted, out)
+            for handler in stmt.handlers:
+                _scan_stmts(handler.body, tainted, out)
+            _scan_stmts(stmt.orelse, tainted, out)
+            _scan_stmts(stmt.finalbody, tainted, out)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                _mutator_sinks(child, tainted, out)
+
+
+def rule_cache_mutation(tree: ast.AST) -> Iterator[RawFinding]:
+    """In-place writes to objects handed out by a shared cache.
+
+    ``Store.get_by_key``/``Store.list`` return the cached dicts
+    directly, ``FakeCluster._notify`` shares one copy per watch event
+    across all listeners, and informer event handlers receive those
+    same references.  A single ``obj["status"] = ...`` therefore
+    corrupts every sibling consumer and the sim's determinism
+    fingerprint.  Take an explicit ownership transfer
+    (``analysis.owned()``, ``copy.deepcopy``, ``k8s.fake._copy_obj``,
+    a serde parse) before mutating, or waive with
+    ``# lint: cache-mutation-ok <reason>``.
+    """
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted: Set[str] = set()
+        if (_HANDLER_NAME_RE.match(node.name)
+                or node.name.endswith("_event")):
+            params = list(node.args.posonlyargs) + list(node.args.args)
+            for i, arg in enumerate(params):
+                if i == 0 and arg.arg in ("self", "cls"):
+                    continue
+                tainted.add(arg.arg)
+            for arg in node.args.kwonlyargs:
+                tainted.add(arg.arg)
+        out: List[RawFinding] = []
+        _scan_stmts(node.body, tainted, out)
+        yield from out
+
+
 # -- registry ---------------------------------------------------------------
 
 #: rule key -> (rule fn, scope attribute on AnalysisConfig or None for
@@ -352,4 +562,5 @@ RULES = {
     "unseeded-random": (rule_unseeded_random, None),
     "blocking-in-lock": (rule_blocking_in_lock, None),
     "swallowed-except": (rule_swallowed_except, "is_reconcile_path"),
+    "cache-mutation": (rule_cache_mutation, "is_cache_consumer"),
 }

@@ -244,6 +244,26 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     is_leader_gauge = registry.gauge(
         "pytorch_operator_is_leader", "Whether this instance is the leader")
 
+    if os.environ.get("PYTORCH_OPERATOR_CACHE_MUTATION_DETECTOR"):
+        # client-go KUBE_CACHE_MUTATION_DETECTOR parity: sample cached
+        # objects, re-verify their fingerprints on a cadence, and count
+        # (plus log) any in-place mutation of shared cache state
+        from pytorch_operator_tpu.analysis.ownership import (
+            enable_cache_mutation_detector)
+
+        mutations_counter = registry.counter(
+            "pytorch_operator_cache_mutations_total",
+            "In-place mutations of shared informer/watch cache objects "
+            "detected by the cache mutation detector (armed via "
+            "PYTORCH_OPERATOR_CACHE_MUTATION_DETECTOR)")
+
+        def _on_cache_mutation(record):
+            mutations_counter.inc()
+            logger.error("cache mutation detected: %s", record.format())
+
+        enable_cache_mutation_detector(on_mutation=_on_cache_mutation)
+        logger.info("cache mutation detector armed")
+
     kubelet = None
     if args.fake_cluster:
         cluster = cluster if cluster is not None else FakeCluster()

@@ -22,6 +22,7 @@ import uuid
 from collections import deque, namedtuple
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..analysis import ownership as _ownership
 from ..analysis.witness import make_lock, make_rlock
 from .errors import AlreadyExistsError, ConflictError, InvalidError, NotFoundError
 from .objects import match_labels
@@ -183,7 +184,22 @@ class FakeResourceStore:
         # hottest allocation).  The copy still isolates listeners from
         # the STORE's object, which later mutations replace wholesale.
         shared = _copy_obj(obj)
+        det = _ownership._detector
+        if det is None:
+            for listener in listeners:
+                listener(event_type, shared)
+            return
+        # detector armed: sample the shared copy (it is exactly the
+        # object every listener aliases) and attribute each delivery so
+        # a detection can name the listener that last received it
+        meta = obj.get("metadata") or {}
+        key = (f"{meta.get('namespace', 'default')}/"
+               f"{meta.get('name', '')}"
+               f"@{meta.get('resourceVersion', '')}")
+        det.record(f"fake.{self.kind}", key, shared)
         for listener in listeners:
+            det.note_delivery(f"fake.{self.kind}", key,
+                              _ownership.handler_name(listener))
             listener(event_type, shared)
 
     def _record_event(self, event_type: str, obj: dict) -> None:

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis import ownership as _ownership
 from ..analysis.witness import make_lock, make_rlock
 
 _log = logging.getLogger(__name__)
@@ -114,8 +115,14 @@ class Store:
         self._items: Dict[str, dict] = {}
 
     def add(self, obj: dict) -> None:
+        key = meta_namespace_key(obj)
         with self._lock:
-            self._items[meta_namespace_key(obj)] = obj
+            self._items[key] = obj
+        det = _ownership._detector
+        if det is not None:
+            # the cached object is handed out by reference from here on;
+            # sample it so any later in-place write is caught
+            det.record("informer.store", key, obj)
 
     def update(self, obj: dict) -> None:
         self.add(obj)
@@ -240,6 +247,27 @@ class Informer:
         if on_delete:
             self._handlers.delete_funcs.append(on_delete)
 
+    def _dispatch(self, fns, key: str, args: tuple) -> None:
+        """Invoke handler registrations for one event.  When the cache
+        mutation detector is armed, each delivery is attributed before
+        the call so a detection can name the registration that last
+        received the object."""
+        det = _ownership._detector
+        if det is None:
+            for fn in fns:
+                fn(*args)
+            return
+        # one event object is shared across every registration (and with
+        # the store when the Python Store backs the cache); sample it
+        # here too so the native deep-copy-on-read store still gets
+        # handler-level coverage.  args[-1] is the stored/current object
+        # for add, update and delete alike.
+        det.record("informer.store", key, args[-1])
+        for fn in fns:
+            det.note_delivery("informer.store", key,
+                              _ownership.handler_name(fn))
+            fn(*args)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Subscribe to watch events, then LIST into the store.
@@ -254,15 +282,15 @@ class Informer:
         self._source.add_listener(self._on_watch_event)
         for obj in self._source.list():
             self._note_rv(obj)
+            key = meta_namespace_key(obj)
             # contains(): presence check without deserialising (the native
             # store would otherwise json-parse every object just for this)
-            if self.store.contains(meta_namespace_key(obj)):
+            if self.store.contains(key):
                 continue
             self.store.add(obj)
             if self._metrics is not None:
                 self._metrics.added.inc()
-            for fn in self._handlers.add_funcs:
-                fn(obj)
+            self._dispatch(self._handlers.add_funcs, key, (obj,))
         self._synced = True
         if self._resync_period > 0 and self._resync_thread is None:
             self._resync_thread = threading.Thread(
@@ -364,8 +392,8 @@ class Informer:
                         self.store.add(obj)
                         if self._metrics is not None:
                             self._metrics.added.inc()
-                        for fn in self._handlers.add_funcs:
-                            fn(obj)
+                        self._dispatch(self._handlers.add_funcs, key,
+                                       (obj,))
                     else:
                         self.store.update(obj)
                         if (self._coalesce is not None
@@ -375,16 +403,16 @@ class Informer:
                             continue  # already dirty: pending sync covers it
                         if self._metrics is not None:
                             self._metrics.modified.inc()
-                        for fn in self._handlers.update_funcs:
-                            fn(cur, obj)
+                        self._dispatch(self._handlers.update_funcs, key,
+                                       (cur, obj))
                 for key in stale_keys:
                     cur = self.store.get_by_key(key)
                     if cur is not None:
                         self.store.delete(cur)
                         if self._metrics is not None:
                             self._metrics.deleted.inc()
-                        for fn in self._handlers.delete_funcs:
-                            fn(cur)
+                        self._dispatch(self._handlers.delete_funcs, key,
+                                       (cur,))
                 if self._metrics is not None:
                     self._metrics.resyncs.inc()
                 return
@@ -429,8 +457,8 @@ class Informer:
                         self.store.add(obj)
                         if self._metrics is not None:
                             self._metrics.added.inc()
-                        for fn in self._handlers.add_funcs:
-                            fn(obj)
+                        self._dispatch(self._handlers.add_funcs, key,
+                                       (obj,))
                     else:
                         self.store.update(obj)
                         if (self._coalesce is not None
@@ -440,8 +468,8 @@ class Informer:
                             continue
                         if self._metrics is not None:
                             self._metrics.modified.inc()
-                        for fn in self._handlers.update_funcs:
-                            fn(cur, obj)
+                        self._dispatch(self._handlers.update_funcs, key,
+                                       (cur, obj))
                 for obj in changes.deleted:
                     key = meta_namespace_key(obj)
                     cur = self.store.get_by_key(key)
@@ -450,8 +478,8 @@ class Informer:
                     self.store.delete(cur)
                     if self._metrics is not None:
                         self._metrics.deleted.inc()
-                    for fn in self._handlers.delete_funcs:
-                        fn(cur)
+                    self._dispatch(self._handlers.delete_funcs, key,
+                                   (cur,))
                 if changes.resource_version is not None:
                     if (self._last_rv is None
                             or changes.resource_version > self._last_rv):
@@ -502,8 +530,7 @@ class Informer:
                 self.store.add(obj)
                 if self._metrics is not None:
                     self._metrics.added.inc()
-                for fn in self._handlers.add_funcs:
-                    fn(obj)
+                self._dispatch(self._handlers.add_funcs, key, (obj,))
             elif event_type == "MODIFIED":
                 old = self.store.get_by_key(key)
                 self.store.update(obj)
@@ -514,11 +541,10 @@ class Informer:
                     return  # burst coalesced: store fresh, dispatch skipped
                 if self._metrics is not None:
                     self._metrics.modified.inc()
-                for fn in self._handlers.update_funcs:
-                    fn(old if old is not None else obj, obj)
+                self._dispatch(self._handlers.update_funcs, key,
+                               (old if old is not None else obj, obj))
             elif event_type == "DELETED":
                 self.store.delete(obj)
                 if self._metrics is not None:
                     self._metrics.deleted.inc()
-                for fn in self._handlers.delete_funcs:
-                    fn(obj)
+                self._dispatch(self._handlers.delete_funcs, key, (obj,))

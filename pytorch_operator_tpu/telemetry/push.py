@@ -30,12 +30,12 @@ Wire format (one POST, any number of samples)::
 from __future__ import annotations
 
 import json
-import threading
 import urllib.request
 from typing import Dict, List, Optional
 
 from pytorch_operator_tpu.metrics.prometheus import Registry
 
+from ..analysis.witness import make_lock
 from .step_timer import StepRecord
 
 #: Default cap on ``job``-labeled series per pushed family; one slice
@@ -119,7 +119,7 @@ class PushGateway:
                 vec = registry.counter_vec(name, help_text, ("job",))
             self._vecs[name] = vec.with_budget(series_budget, dropped)
         self._dropped = dropped
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.push")
 
     def ingest(self, payload: dict) -> dict:
         """Apply one POST body; returns per-request accounting

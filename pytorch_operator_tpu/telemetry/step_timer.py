@@ -24,11 +24,12 @@ measured/skipped/failed trend machinery via :func:`read_step_log`.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass
 from typing import Any, Callable, Dict, IO, Optional
+
+from ..analysis.witness import make_lock
 
 #: Peak dense-matmul FLOPs per chip (bf16), from the public TPU/GPU
 #: spec sheets.  Keys match ``jax.devices()[0].device_kind`` prefixes
@@ -106,6 +107,7 @@ class StepProfiler:
         jsonl_file: Optional[IO[str]] = None,
         on_record: Optional[Callable[[StepRecord], None]] = None,
         loss_key: str = "loss",
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.job = job
         self.n_params = int(n_params)
@@ -120,7 +122,8 @@ class StepProfiler:
         self._file: Optional[IO[str]] = jsonl_file
         self._on_record = on_record
         self._loss_key = loss_key
-        self._lock = threading.Lock()
+        self._clock = clock
+        self._lock = make_lock("telemetry.step-profiler")
         self.step_count = 0
         self.compile_time_s: Optional[float] = None
         # bounded: million-step runs must not accumulate a record per
@@ -237,10 +240,10 @@ class StepProfiler:
         import jax
 
         def profiled_step(*args, **kw):
-            t0 = time.monotonic()
+            t0 = self._clock()
             out = step_fn(*args, **kw)
             out = jax.block_until_ready(out)
-            elapsed = time.monotonic() - t0
+            elapsed = self._clock() - t0
             loss = self._extract_loss(out)
             self.observe(elapsed, loss=loss)
             return out

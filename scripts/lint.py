@@ -18,8 +18,10 @@ findings, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import os
+import re
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,13 +37,97 @@ def _list_rules() -> str:
         doc = (fn.__doc__ or "").strip().splitlines()[0]
         where = {"is_clock_injectable": "clock-injectable modules",
                  "is_reconcile_path": "reconcile-path modules",
+                 "is_cache_consumer": "cache-consumer modules",
                  None: "whole tree"}[scope]
         lines.append(f"  {key:18s} [{where}]")
         lines.append(f"    {doc}")
     lines += ["", "engine findings (not waivable):",
               "  parse-error, waiver-missing-reason, unused-waiver, "
-              "unknown-pragma"]
+              "unknown-pragma",
+              "  flag-docs-drift (tree runs: cmd/operator.py flags vs "
+              "developer_guide.md)"]
     return "\n".join(lines)
+
+
+#: repo-local flags look like ``--resync-period``; the pattern excludes
+#: underscores on purpose so external XLA/absl-style flags mentioned in
+#: prose (``--xla_force_host_platform_device_count``) are never checked
+_FLAG_RE = re.compile(r"--[A-Za-z0-9][A-Za-z0-9-]*")
+
+#: where repo flags are DEFINED — the universe a guide-documented flag
+#: must exist in (operator argparse, helper scripts, pytest conftest
+#: options, the stub apiserver's CLI, run-tests.sh knobs)
+_FLAG_UNIVERSE_GLOBS = (
+    ("pytorch_operator_tpu/cmd", ".py"),
+    ("scripts", ".py"),
+    ("scripts", ".sh"),
+    ("tests", ".py"),
+    ("pytorch_operator_tpu/k8s", ".py"),
+)
+
+
+def _flag_docs_findings(root: str):
+    """Flags-vs-docs drift, mirroring the metric doc-drift test: every
+    ``cmd/operator.py`` flag must appear in developer_guide.md, and
+    every repo-style flag the guide documents must be defined somewhere
+    in the tree (a renamed/removed flag leaves the doc stale)."""
+    guide_path = os.path.join(root, "developer_guide.md")
+    op_rel = "pytorch_operator_tpu/cmd/operator.py"
+    op_path = os.path.join(root, op_rel)
+    if not (os.path.exists(guide_path) and os.path.exists(op_path)):
+        return []
+    findings = []
+
+    with open(guide_path) as fh:
+        guide_lines = fh.read().splitlines()
+    guide_flags = {}
+    for lineno, line in enumerate(guide_lines, 1):
+        for m in _FLAG_RE.finditer(line):
+            guide_flags.setdefault(m.group(0), lineno)
+
+    with open(op_path) as fh:
+        tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            continue
+        spellings = [a.value for a in node.args
+                     if isinstance(a, ast.Constant)
+                     and isinstance(a.value, str)
+                     and a.value.startswith("--")]
+        if spellings and not any(s in guide_flags for s in spellings):
+            findings.append(engine.Finding(
+                rule="flag-docs-drift", path=op_rel, line=node.lineno,
+                message=(f"operator flag {spellings[0]} is not documented "
+                         f"in developer_guide.md — add it to the flag "
+                         f"reference (or drop the flag)"),
+                end_line=node.lineno))
+
+    universe = set()
+    for rel_dir, suffix in _FLAG_UNIVERSE_GLOBS:
+        dir_path = os.path.join(root, rel_dir)
+        if not os.path.isdir(dir_path):
+            continue
+        for name in os.listdir(dir_path):
+            if not name.endswith(suffix):
+                continue
+            try:
+                with open(os.path.join(dir_path, name),
+                          errors="replace") as fh:
+                    universe.update(_FLAG_RE.findall(fh.read()))
+            except OSError:
+                continue
+    for flag, lineno in sorted(guide_flags.items()):
+        if flag not in universe:
+            findings.append(engine.Finding(
+                rule="flag-docs-drift", path="developer_guide.md",
+                line=lineno,
+                message=(f"documented flag {flag} is not defined anywhere "
+                         f"in the tree — stale doc (renamed or removed "
+                         f"flag?)"),
+                end_line=lineno))
+    return findings
 
 
 def main(argv=None) -> int:
@@ -70,6 +156,7 @@ def main(argv=None) -> int:
         findings = engine.scan_paths(args.paths, root=os.getcwd())
     else:
         findings = engine.scan_tree(_REPO_ROOT)
+        findings.extend(_flag_docs_findings(_REPO_ROOT))
 
     bad = engine.unwaived(findings)
     waived = [f for f in findings if f.waived]
@@ -83,6 +170,22 @@ def main(argv=None) -> int:
             for f in waived:
                 print(f.format())
         print(f"lint: {len(bad)} finding(s), {len(waived)} waived")
+    if bad and not args.paths:
+        # tree-wide gate failed: archive the machine-readable findings
+        # next to the e2e flight-recorder captures so CI keeps evidence
+        out_dir = os.environ.get(
+            "E2E_ARTIFACTS_DIR",
+            os.path.join(_REPO_ROOT, "test-artifacts"))
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            out_path = os.path.join(out_dir, "lint-findings.json")
+            with open(out_path, "w") as fh:
+                json.dump([f.__dict__ for f in findings], fh, indent=2)
+            print(f"lint: findings archived to {out_path}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"lint: could not archive findings: {e}",
+                  file=sys.stderr)
     return 1 if bad else 0
 
 

@@ -17,25 +17,31 @@ cd "$(dirname "$0")/.."
 # --tsan additionally builds and runs the native ThreadSanitizer tier.
 # --witness runs the test tier under the runtime lock-order witness
 # (pytest --lock-witness): any observed lock-order cycle fails the run.
+# --mutation-detector runs the test tier under the cache mutation
+# detector (pytest --cache-mutation-detector): any in-place mutation of
+# a shared informer/watch cache object fails the run.
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
 WITNESS_ARGS=()
+DETECTOR_ARGS=()
 for arg in "$@"; do
   case "$arg" in
     --scale) RUN_SCALE=1 ;;
     --lint) LINT_ONLY=1 ;;
     --tsan) RUN_TSAN=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --witness)" >&2; exit 2 ;;
+    --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
 echo "=== concurrency & determinism lint ==="
 # AST rules over the whole tree (wall-clock in clock-injectable paths,
 # builtin hash(), unseeded random, blocking calls under locks,
-# swallowed exceptions on reconcile paths); exit 1 on any unwaived
-# finding.  Runs FIRST: a determinism regression makes the simulator
+# swallowed exceptions on reconcile paths, cache-mutation dataflow,
+# flags-vs-docs drift); exit 1 on any unwaived finding — the findings
+# JSON is archived into $E2E_ARTIFACTS_DIR on failure.  Runs FIRST: a determinism regression makes the simulator
 # tiers below meaningless.
 python scripts/lint.py --quiet
 
@@ -72,13 +78,13 @@ echo "=== tests ==="
 # slow tiers (the 10k-job scale simulation) stay out of the default
 # gate; opt in with --scale
 if python -c "import pytest_cov" >/dev/null 2>&1; then
-  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}" --cov=pytorch_operator_tpu --cov-report=term
+  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}" "${DETECTOR_ARGS[@]}" --cov=pytorch_operator_tpu --cov-report=term
 elif python -m coverage --version >/dev/null 2>&1; then
-  python -m coverage run -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}"
+  python -m coverage run -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}" "${DETECTOR_ARGS[@]}"
   python -m coverage report --include="pytorch_operator_tpu/*"
 else
   echo "(coverage tooling not in image — running plain pytest)"
-  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}"
+  python -m pytest tests/ -q -m "not slow" "${WITNESS_ARGS[@]}" "${DETECTOR_ARGS[@]}"
 fi
 
 echo "=== sanitize: native core under ASan+UBSan ==="

@@ -42,6 +42,12 @@ def pytest_addoption(parser):
         help="record the runtime lock-acquisition graph for the whole "
              "session and fail it if the observed order has a cycle "
              "(a latent deadlock)")
+    parser.addoption(
+        "--cache-mutation-detector", action="store_true", default=False,
+        help="sample informer-store and fake-watch objects, re-verify "
+             "their structural fingerprints through the session, and "
+             "fail it on any in-place mutation of a shared cached "
+             "object (the client-go KUBE_CACHE_MUTATION_DETECTOR)")
 
 
 def pytest_configure(config):
@@ -49,12 +55,36 @@ def pytest_configure(config):
         from pytorch_operator_tpu.analysis.witness import enable_witness
 
         config._lock_witness = enable_witness()
+    if config.getoption("--cache-mutation-detector"):
+        from pytorch_operator_tpu.analysis.ownership import (
+            enable_cache_mutation_detector)
+
+        config._cache_mutation_detector = enable_cache_mutation_detector()
 
 
 def pytest_sessionfinish(session, exitstatus):
     """The --lock-witness gate: at session end, any cycle in the
     observed lock order fails the run with both acquisition stacks of
-    every edge — the deadlock report BEFORE the deadlock."""
+    every edge — the deadlock report BEFORE the deadlock.  The
+    --cache-mutation-detector gate works the same way: any sampled
+    cached object whose fingerprint no longer matches fails the run
+    with the object key, field diff, and last receiving handler."""
+    detector = getattr(session.config, "_cache_mutation_detector", None)
+    if detector is not None:
+        from pytorch_operator_tpu.analysis.ownership import (
+            disable_cache_mutation_detector)
+
+        disable_cache_mutation_detector()
+        detector.verify_all()
+        sys.stderr.write(
+            f"\n[cache-mutation-detector] {detector.records} cache "
+            f"writes observed, {detector.sampled} sampled, "
+            f"{detector.verified} verified, "
+            f"{len(detector.mutations)} mutation(s)\n")
+        report = detector.report()
+        if report:
+            sys.stderr.write(report + "\n")
+            session.exitstatus = 1
     witness = getattr(session.config, "_lock_witness", None)
     if witness is None:
         return

@@ -12,8 +12,14 @@ import threading
 
 import pytest
 
-from pytorch_operator_tpu.analysis import engine, witness
+from pytorch_operator_tpu.analysis import engine, ownership, witness
 from pytorch_operator_tpu.analysis.engine import scan_source, unwaived
+from pytorch_operator_tpu.analysis.ownership import (
+    CacheMutationDetector,
+    disable_cache_mutation_detector,
+    enable_cache_mutation_detector,
+    owned,
+)
 from pytorch_operator_tpu.analysis.witness import (
     LockWitness,
     disable_witness,
@@ -232,6 +238,83 @@ class TestSwallowedExceptRule:
 
 
 # -- engine findings --------------------------------------------------------
+
+# -- rule: cache-mutation ---------------------------------------------------
+
+class TestCacheMutationRule:
+    def test_handler_param_write_flagged(self):
+        src = ("def add_job(obj):\n"
+               "    obj['status']['phase'] = 'Running'\n")
+        (f,) = _hits(src, RECONCILE_PATH, "cache-mutation")
+        assert f.line == 2
+
+    def test_store_read_binding_then_write_flagged(self):
+        src = ("def sync(store, key):\n"
+               "    cur = store.get_by_key(key)\n"
+               "    cur['metadata']['labels'] = {}\n")
+        assert _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_store_list_loop_binding_flagged(self):
+        src = ("def sweep(job_store):\n"
+               "    for obj in job_store.list():\n"
+               "        obj['seen'] = True\n")
+        assert _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_alias_through_get_or_default_flagged(self):
+        # the repo's pervasive `obj.get("metadata") or {}` idiom still
+        # aliases the cached sub-tree — writing through it is a finding
+        src = ("def update_pod(old, new):\n"
+               "    meta = new.get('metadata') or {}\n"
+               "    meta['x'] = 1\n")
+        assert _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_mutator_methods_flagged(self):
+        src = ("def delete_pod(obj):\n"
+               "    obj.setdefault('status', {})\n"
+               "    obj['metadata']['finalizers'].remove('x')\n")
+        assert len(_hits(src, RECONCILE_PATH, "cache-mutation")) == 2
+
+    def test_deepcopy_launders_ownership(self):
+        src = ("import copy\n\n"
+               "def add_job(obj):\n"
+               "    mine = copy.deepcopy(obj)\n"
+               "    mine['status']['phase'] = 'X'\n")
+        assert not _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_owned_launders_ownership(self):
+        src = ("from pytorch_operator_tpu.analysis import owned\n\n"
+               "def update_job(old, new):\n"
+               "    mine = owned(new)\n"
+               "    mine['spec']['replicas'] = 3\n")
+        assert not _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_rebinding_clears_taint(self):
+        src = ("def add_job(obj):\n"
+               "    obj = {'fresh': True}\n"
+               "    obj['fresh'] = False\n")
+        assert not _hits(src, RECONCILE_PATH, "cache-mutation")
+
+    def test_self_param_of_method_handler_not_tainted(self):
+        src = ("class C:\n"
+               "    def add_pod(self, obj):\n"
+               "        self.count = 1\n"
+               "        obj['x'] = 1\n")
+        hits = _hits(src, RECONCILE_PATH, "cache-mutation")
+        assert len(hits) == 1 and hits[0].line == 4
+
+    def test_out_of_scope_module_not_scanned(self):
+        src = ("def add_job(obj):\n"
+               "    obj['x'] = 1\n")
+        assert not _hits(src, UNSCOPED_PATH, "cache-mutation")
+
+    def test_pragma_with_reason_waives(self):
+        src = ("def add_job(obj):\n"
+               "    # lint: cache-mutation-ok fixture owns this dict\n"
+               "    obj['x'] = 1\n")
+        assert not _hits(src, RECONCILE_PATH, "cache-mutation")
+        (f,) = _waived(src, RECONCILE_PATH, "cache-mutation")
+        assert f.reason == "fixture owns this dict"
+
 
 class TestEngineFindings:
     def test_unused_waiver_flagged(self):
@@ -462,3 +545,158 @@ def test_witness_suite_smoke_zero_cycles():
         witness._witness = prev
     assert w.acquisitions > 0
     assert w.cycles() == []
+
+
+# -- the cache mutation detector --------------------------------------------
+
+class TestOwned:
+    def test_wire_trees_are_deep_copied(self):
+        src = {"metadata": {"labels": {"a": "1"}}, "items": [1, [2]]}
+        cp = owned(src)
+        assert cp == src and cp is not src
+        cp["metadata"]["labels"]["a"] = "2"
+        cp["items"][1].append(3)
+        assert src["metadata"]["labels"]["a"] == "1"
+        assert src["items"][1] == [2]
+
+    def test_non_wire_objects_fall_back_to_deepcopy(self):
+        class Box:
+            def __init__(self):
+                self.v = [1]
+
+        cp = owned({"box": Box()})
+        cp["box"].v.append(2)
+        assert owned({"box": Box()})["box"].v == [1]
+
+
+class TestCacheMutationDetector:
+    def test_mutation_reported_with_key_and_field_diff(self):
+        det = CacheMutationDetector(sample_every=1)
+        obj = {"metadata": {"name": "a"}, "status": {"phase": "Pending"}}
+        det.record("informer.store", "ns/a", obj)
+        obj["status"]["phase"] = "Oops"
+        (m,) = det.verify_all()
+        assert m.source == "informer.store" and m.key == "ns/a"
+        assert any("status.phase" in d and "Oops" in d for d in m.diffs)
+        assert "ns/a" in m.format()
+
+    def test_untouched_objects_verify_clean(self):
+        det = CacheMutationDetector(sample_every=1)
+        det.record("informer.store", "ns/a", {"metadata": {"name": "a"}})
+        assert det.verify_all() == []
+        assert det.report() == ""
+        assert det.verified == 1
+
+    def test_delivery_attribution_names_last_handler(self):
+        det = CacheMutationDetector(sample_every=1)
+        obj = {"spec": {}}
+        det.record("informer.store", "ns/a", obj)
+        det.note_delivery("informer.store", "ns/a", "tests.handlers.on_add")
+        obj["spec"]["replicas"] = 9
+        (m,) = det.verify_all()
+        assert m.last_handler == "tests.handlers.on_add"
+        assert "tests.handlers.on_add" in m.format()
+
+    def test_replacing_a_sample_verifies_the_displaced_object(self):
+        # the displaced object was still under the read-only contract up
+        # to the moment the store applied the fresh watch event, so the
+        # mutation is caught AT replacement, not deferred to teardown
+        det = CacheMutationDetector(sample_every=1)
+        old = {"metadata": {"resourceVersion": "1"}}
+        det.record("informer.store", "ns/a", old)
+        old["metadata"]["resourceVersion"] = "hacked"
+        det.record("informer.store", "ns/a",
+                   {"metadata": {"resourceVersion": "2"}})
+        assert len(det.mutations) == 1
+
+    def test_on_mutation_callback_fires(self):
+        seen = []
+        det = CacheMutationDetector(sample_every=1, on_mutation=seen.append)
+        obj = {"x": 1}
+        det.record("s", "k", obj)
+        obj["x"] = 2
+        det.verify_all()
+        assert len(seen) == 1 and seen[0].key == "k"
+
+    def test_sampling_cadence_is_count_based(self):
+        det = CacheMutationDetector(sample_every=2)
+        for i in range(4):
+            det.record("s", f"k{i}", {"i": i})
+        assert det.records == 4 and det.sampled == 2
+
+
+@pytest.fixture
+def fresh_detector():
+    # save/restore the global: a --cache-mutation-detector session's own
+    # detector must survive these tests installing (and then seeding
+    # mutations into) their private ones
+    prev = ownership.disable_cache_mutation_detector()
+    det = enable_cache_mutation_detector(sample_every=1)
+    try:
+        yield det
+    finally:
+        disable_cache_mutation_detector()
+        ownership._detector = prev
+
+
+class TestCacheMutationDetectorIntegration:
+    """The acceptance criterion: seed a deliberate in-place mutation at
+    a real cache consumer and the armed detector must report the object
+    key, the field-level diff, and the handler that received it."""
+
+    def test_mutating_informer_handler_is_named(self, fresh_detector):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime.informer import Informer
+
+        c = FakeCluster()
+        inf = Informer(c.pods)
+
+        def dirty_add(obj):
+            # the seeded bug: writing into the shared event object
+            obj.setdefault("status", {})["phase"] = "Corrupted"
+
+        inf.add_event_handler(on_add=dirty_add)
+        inf.start()
+        try:
+            c.pods.create("ns", {"metadata": {"name": "p0",
+                                              "namespace": "ns"}})
+        finally:
+            inf.stop()
+        muts = fresh_detector.verify_all()
+        m = next(m for m in muts if m.source == "informer.store")
+        assert m.key == "ns/p0"
+        assert "dirty_add" in (m.last_handler or "")
+        assert any("status" in d and "Corrupted" in d for d in m.diffs)
+
+    def test_mutating_watch_listener_is_named(self, fresh_detector):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+
+        c = FakeCluster()
+
+        def greedy(event_type, obj):
+            obj["metadata"]["labels"] = {"stolen": "yes"}
+
+        c.pods.add_listener(greedy)
+        c.pods.create("ns", {"metadata": {"name": "w", "namespace": "ns"}})
+        muts = fresh_detector.verify_all()
+        m = next(m for m in muts if m.source == "fake.Pod")
+        assert m.key.startswith("ns/w@")
+        assert "greedy" in (m.last_handler or "")
+        assert any("metadata.labels" in d for d in m.diffs)
+
+    def test_clean_informer_session_reports_nothing(self, fresh_detector):
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime.informer import Informer
+
+        c = FakeCluster()
+        inf = Informer(c.pods)
+        inf.add_event_handler(on_add=lambda o: o.get("status"))
+        inf.start()
+        try:
+            c.pods.create("ns", {"metadata": {"name": "ok",
+                                              "namespace": "ns"}})
+            c.pods.set_status("ns", "ok", {"phase": "Running"})
+        finally:
+            inf.stop()
+        assert fresh_detector.verify_all() == []
+        assert fresh_detector.records > 0

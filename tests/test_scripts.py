@@ -96,6 +96,14 @@ def test_lint_driver_exit_codes(tmp_path):
     bad_waiver.write_text("x = hash('k')  # lint: builtin-hash-ok\n")
     assert _run_lint(str(bad_waiver)).returncode == 1
 
+    # an unused waiver is itself a gate failure (engine finding): a
+    # pragma that stops matching anything must be deleted, not rot
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # lint: builtin-hash-ok nothing here\n")
+    proc = _run_lint(str(stale))
+    assert proc.returncode == 1
+    assert "unused-waiver" in proc.stdout
+
     # usage error: missing path
     assert _run_lint(str(tmp_path / "no_such.py")).returncode == 2
 
@@ -110,8 +118,42 @@ def test_lint_tree_gate_and_rule_catalog():
     proc = _run_lint("--list-rules")
     assert proc.returncode == 0
     for key in ("wall-clock", "builtin-hash", "unseeded-random",
-                "blocking-in-lock", "swallowed-except"):
+                "blocking-in-lock", "swallowed-except", "cache-mutation",
+                "flag-docs-drift"):
         assert key in proc.stdout
+
+
+def test_flag_docs_drift_check_both_directions(tmp_path):
+    """The flags-vs-docs drift check mirrors the metric doc-drift test:
+    an operator flag missing from developer_guide.md AND a guide flag
+    defined nowhere in the tree are both findings; a documented,
+    defined flag is neither."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_driver_under_test", os.path.join(REPO, "scripts", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    cmd = tmp_path / "pytorch_operator_tpu" / "cmd"
+    cmd.mkdir(parents=True)
+    (cmd / "operator.py").write_text(
+        "import argparse\n"
+        "p = argparse.ArgumentParser()\n"
+        'p.add_argument("--known-flag")\n'
+        'p.add_argument("--undocumented-flag")\n')
+    (tmp_path / "developer_guide.md").write_text(
+        "Run with `--known-flag` or the removed `--ghost-flag`.\n")
+
+    findings = lint._flag_docs_findings(str(tmp_path))
+    assert all(f.rule == "flag-docs-drift" for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("--undocumented-flag" in m and "not documented" in m
+               for m in msgs)
+    assert any("--ghost-flag" in m and "not defined" in m for m in msgs)
+    assert not any("--known-flag" in m for m in msgs)
+    # absent guide or operator file: the check degrades to no findings
+    assert lint._flag_docs_findings(str(tmp_path / "nope")) == []
 
 
 def test_storm_tier_smoke(monkeypatch):

@@ -388,6 +388,30 @@ class TestScaleScenario:
         assert fingerprint(res["runs"][0]) == fingerprint(res["runs"][1])
         assert fingerprint(res["runs"][0]) != fingerprint(res["runs"][2])
 
+    def test_armed_mutation_detector_leaves_fingerprint_byte_identical(self):
+        """Arming the runtime cache-mutation detector must observe the
+        sim tier without perturbing it: zero mutations (the sim's own
+        consumers honour the read-only contract) and the same-seed
+        fingerprint stays byte-identical — the detector's cadences are
+        pure operation counts, no clock reads, no RNG draws."""
+        import json
+
+        from pytorch_operator_tpu.analysis import ownership
+
+        baseline = run_scenario(_small_cfg(jobs=5))
+        prev = ownership.disable_cache_mutation_detector()
+        det = ownership.enable_cache_mutation_detector()
+        try:
+            armed = run_scenario(_small_cfg(jobs=5))
+        finally:
+            ownership.disable_cache_mutation_detector()
+            ownership._detector = prev
+        assert det.verify_all() == []
+        assert det.records > 0, "detector observed no cache writes"
+        assert baseline["converged"] and armed["converged"]
+        assert (json.dumps(fingerprint(armed), sort_keys=True)
+                == json.dumps(fingerprint(baseline), sort_keys=True))
+
     def test_pump_reports_a_stall_instead_of_hanging(self):
         from pytorch_operator_tpu.controller import PyTorchController
         from pytorch_operator_tpu.metrics.prometheus import Registry
