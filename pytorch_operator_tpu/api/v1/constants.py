@@ -85,6 +85,36 @@ LABEL_SHARD = "pytorch.kubeflow.org/shard"
 LABEL_LEASE_COMPONENT = "pytorch.kubeflow.org/lease-component"
 LEASE_COMPONENT_SHARD = "shard"
 LEASE_COMPONENT_HEARTBEAT = "replica-heartbeat"
+LEASE_COMPONENT_RING = "ring"
+LEASE_COMPONENT_MIGRATION = "reshard"
+
+# Live resharding (ISSUE 12).  The fleet's authoritative ring geometry
+# lives in ONE Lease (the "ring record"): its annotations carry the
+# current shard count, a monotonically increasing ring epoch, and —
+# while a migration is in flight — the target shard count.  Changing
+# --shard-count live means patching the target annotation
+# (``--reshard-to``); a migration Lease serializes the label re-stamp
+# sweep, and the epoch bump at the end is the commit point every
+# replica observes.
+RING_LEASE_NAME = "pytorch-operator-ring"
+MIGRATION_LEASE_NAME = "pytorch-operator-reshard"
+ANNOTATION_RING_SHARD_COUNT = "pytorch.kubeflow.org/shard-count"
+ANNOTATION_RING_EPOCH = "pytorch.kubeflow.org/ring-epoch"
+ANNOTATION_RING_TARGET = "pytorch.kubeflow.org/target-shard-count"
+# Ring-epoch label stamped NEXT TO the shard label during a migration
+# sweep: epoch 0 (the pre-resharding world) is encoded as the label's
+# ABSENCE so every object and Lease minted before this feature parses
+# unchanged, epochs >= 1 are the decimal value.  A shard index is only
+# meaningful together with its epoch — informers for a new-ring shard
+# select on (shard, ring-epoch) and old-ring runtimes drop re-stamped
+# objects, which is what makes a job PATCHed between rings land in
+# exactly one workqueue.
+LABEL_RING_EPOCH = "pytorch.kubeflow.org/ring-epoch"
+# Heartbeat-Lease annotation through which each replica publishes its
+# per-owned-shard workqueue depth (JSON: shard index -> depth); the
+# autoscaler policy reads the fleet's load from these instead of
+# needing a metrics scrape path into every replica.
+ANNOTATION_SHARD_LOAD = "pytorch.kubeflow.org/shard-load"
 
 # --- Rendezvous environment ------------------------------------------------
 # Reference c10d wiring (pod.go:234-281), kept for backend='xla'

@@ -213,6 +213,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "membership heartbeat (default: hostname + "
                         "random suffix; set to the pod name in a "
                         "StatefulSet/Deployment via the downward API)")
+    p.add_argument("--shard-lease-duration", default="15s",
+                   help="shard/heartbeat Lease duration (duration "
+                        "string): how long a crashed replica's shards "
+                        "stay orphaned before survivors may take them")
+    p.add_argument("--shard-renew-interval", default="5s",
+                   help="shard manager tick: Lease renewal, membership "
+                        "scan and rebalance cadence (duration string)")
+    p.add_argument("--reshard-to", type=int, default=0,
+                   help="one-shot: request a LIVE shard-count change to "
+                        "this many shards (patches the ring record "
+                        "Lease's target annotation and exits; the "
+                        "running fleet re-stamps every job onto the new "
+                        "ring under the migration Lease and flips "
+                        "epochs without a restart).  Requires a running "
+                        "sharded fleet (the ring record is minted by "
+                        "the shard-0 owner)")
+    p.add_argument("--autoscale-target-depth", type=float, default=32.0,
+                   help="queue-depth budget per replica for the "
+                        "autoscale recommendation (total fleet "
+                        "workqueue depth / this = recommended "
+                        "replicas); published as "
+                        "pytorch_operator_autoscale_recommended_replicas")
+    p.add_argument("--autoscale-min-replicas", type=int, default=1,
+                   help="floor for the autoscale recommendation")
+    p.add_argument("--autoscale-max-replicas", type=int, default=8,
+                   help="ceiling for the autoscale recommendation")
     p.add_argument("--fake-cluster", action="store_true",
                    help="run against the in-memory API server + fake kubelet")
     p.add_argument("--fake-cluster-seed-job", default="",
@@ -230,6 +256,94 @@ def setup_logging(json_format: bool) -> None:
     root = logging.getLogger()
     root.handlers[:] = [handler]
     root.setLevel(logging.INFO)
+
+
+def make_readyz(controller, stop_event, leader_state, cluster):
+    """/readyz callable, factored out so tests can drive it directly.
+
+    Non-sharded: a LEADING replica is ready once its informer caches
+    completed their initial LISTs; a standby is ready as soon as it
+    serves.  Sharded: readiness gates ONLY on the admission and node
+    informers — per-shard runtimes still replaying their initial LIST
+    (fresh acquisitions, ring migrations) and an in-flight reshard
+    report DEGRADED with a 200, because shard handoff is routine and
+    flapping the replica unready on every rebalance would eject it from
+    service exactly when it picked up work."""
+
+    def readyz():
+        leading = leader_state["leading"]
+        sharded = getattr(controller, "shard_manager", None) is not None
+        if sharded:
+            synced = controller.base_informers_synced()
+            ok = not stop_event.is_set() and synced
+            detail = {"leader": leading, "informers_synced": synced,
+                      "shards": sorted(controller.owned_shards())}
+            pending = controller.unsynced_shards()
+            resharding = controller.resharding_in_progress()
+            if pending or resharding:
+                detail["degraded"] = True
+                if pending:
+                    detail["unsynced_shards"] = pending
+                if resharding:
+                    detail["resharding"] = True
+        else:
+            synced = controller.informers_synced()
+            ok = not stop_event.is_set() and (synced if leading else True)
+            detail = {"leader": leading, "informers_synced": synced}
+        # An open apiserver circuit breaker reports DEGRADED, not
+        # unready: the informer caches still serve and flipping /readyz
+        # to 503 during an apiserver outage would only thrash Service
+        # endpoints while nothing this replica does can help.
+        snapshot = getattr(cluster, "resilience_snapshot", None)
+        if snapshot is not None:
+            breaker = snapshot()
+            detail["circuit_breaker"] = breaker["state"]
+            if breaker["state"] == "open":
+                detail["degraded"] = True
+        return ok, detail
+
+    return readyz
+
+
+def run_reshard_request(args) -> int:
+    """--reshard-to one-shot: patch the ring record's target annotation
+    and exit; the running fleet picks it up on its next tick."""
+    from pytorch_operator_tpu.k8s.errors import ApiError, NotFoundError
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestCluster
+    from pytorch_operator_tpu.runtime.sharding import request_reshard
+
+    if args.reshard_to < 1:
+        logger.error("--reshard-to must be >= 1")
+        return 1
+    try:
+        if args.master:
+            kube_config = KubeConfig.from_url(args.master)
+        elif args.kubeconfig or not os.path.isdir(
+                "/var/run/secrets/kubernetes.io"):
+            kube_config = KubeConfig.from_kubeconfig(args.kubeconfig or None)
+        else:
+            kube_config = KubeConfig.in_cluster()
+    except (OSError, KeyError, StopIteration) as e:
+        logger.error("no API server configured (%s); pass "
+                     "--master/--kubeconfig", e)
+        return 1
+    cluster = RestCluster(kube_config, namespace=args.namespace or None)
+    try:
+        request_reshard(cluster.resource("leases"), args.reshard_to,
+                        namespace=args.namespace or "default")
+    except NotFoundError:
+        logger.error(
+            "no ring record Lease found — is a sharded fleet "
+            "(--shard-count > 1) running?  The shard-0 owner mints the "
+            "record on its first tick")
+        return 1
+    except (ValueError, ApiError) as e:
+        logger.error("reshard request failed: %s", e)
+        return 1
+    finally:
+        cluster.close()
+    logger.info("requested live reshard to %d shards", args.reshard_to)
+    return 0
 
 
 def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
@@ -317,6 +431,12 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     except ValueError as e:
         logger.error("invalid --drain-deadline: %s", e)
         return 1
+    try:
+        shard_lease_duration = parse_duration(args.shard_lease_duration)
+        shard_renew_interval = parse_duration(args.shard_renew_interval)
+    except ValueError as e:
+        logger.error("invalid shard lease duration flag: %s", e)
+        return 1
     config = JobControllerConfig(
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
@@ -329,6 +449,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         max_elastic_resizes=args.max_elastic_resizes,
         shard_count=max(1, args.shard_count),
         replica_id=args.replica_id,
+        shard_lease_duration=max(0.1, shard_lease_duration),
+        shard_renew_interval=max(0.02, shard_renew_interval),
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)
@@ -354,22 +476,7 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     def healthz():
         return not stop_event.is_set(), {"leader": leader_state["leading"]}
 
-    def readyz():
-        synced = controller.informers_synced()
-        leading = leader_state["leading"]
-        ok = not stop_event.is_set() and (synced if leading else True)
-        detail = {"leader": leading, "informers_synced": synced}
-        # An open apiserver circuit breaker reports DEGRADED, not
-        # unready: the informer caches still serve and flipping /readyz
-        # to 503 during an apiserver outage would only thrash Service
-        # endpoints while nothing this replica does can help.
-        snapshot = getattr(cluster, "resilience_snapshot", None)
-        if snapshot is not None:
-            breaker = snapshot()
-            detail["circuit_breaker"] = breaker["state"]
-            if breaker["state"] == "open":
-                detail["degraded"] = True
-        return ok, detail
+    readyz = make_readyz(controller, stop_event, leader_state, cluster)
 
     metrics_server = None
     if args.monitoring_port:
@@ -424,6 +531,33 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         # owned shards' informer sync.
         is_leader_gauge.set(1)
         leader_state["leading"] = True
+        # queue-depth autoscale recommendation, recomputed at scrape
+        # time from the fleet's heartbeat-Lease load annotations (one
+        # Lease LIST per scrape — the same call membership scans make
+        # every renew interval)
+        from pytorch_operator_tpu.runtime.autoscaler import (
+            AutoscalePolicy, fleet_loads)
+
+        autoscale_policy = AutoscalePolicy(
+            target_depth_per_replica=max(0.001,
+                                         args.autoscale_target_depth),
+            min_replicas=args.autoscale_min_replicas,
+            max_replicas=args.autoscale_max_replicas)
+        lease_store = cluster.resource("leases")
+
+        def _recommended_replicas() -> int:
+            loads = fleet_loads(lease_store,
+                                namespace=args.namespace or "default")
+            return autoscale_policy.recommend(
+                loads,
+                current_shard_count=config.shard_count).replicas
+
+        registry.gauge(
+            "pytorch_operator_autoscale_recommended_replicas",
+            "Replica count the queue-depth autoscale policy recommends "
+            "for the fleet (total heartbeat-reported workqueue depth / "
+            "--autoscale-target-depth, clamped and scale-down damped)",
+        ).set_function(_recommended_replicas)
         logger.info(
             "sharded control plane: %d shards, replica id %s, "
             "%d workers", config.shard_count,
@@ -465,6 +599,8 @@ def main(argv=None) -> int:
               f"(git {version_mod.git_sha()})")
         return 0
     setup_logging(args.json_log_format)
+    if args.reshard_to:
+        return run_reshard_request(args)
     logger.info("pytorch-operator %s starting", version_mod.VERSION)
 
     stop_event = threading.Event()

@@ -224,12 +224,16 @@ class PodReconcilerMixin:
         if master_role:
             labels[constants.LABEL_JOB_ROLE] = "master"
         # sharded control plane: children inherit the job's shard label
-        # so the owning replica's shard-filtered pod informer sees them
-        # (absent on unsharded operators — existing pods byte-identical)
-        shard = ((job_dict.get("metadata") or {}).get("labels")
-                 or {}).get(constants.LABEL_SHARD)
-        if shard is not None:
-            labels[constants.LABEL_SHARD] = shard
+        # — and its ring-epoch label after a live reshard — so the
+        # owning replica's shard-filtered (epoch-fenced) pod informer
+        # sees them (absent on unsharded operators — existing pods
+        # byte-identical)
+        job_labels = ((job_dict.get("metadata") or {}).get("labels")
+                      or {})
+        for ring_key in (constants.LABEL_SHARD,
+                         constants.LABEL_RING_EPOCH):
+            if job_labels.get(ring_key) is not None:
+                labels[ring_key] = job_labels[ring_key]
 
         template = serde.to_dict(spec.template)
         pod = {

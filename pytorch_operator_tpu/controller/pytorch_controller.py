@@ -38,7 +38,13 @@ from ..runtime.informer import Informer, split_meta_namespace_key
 from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
-from ..runtime.sharding import ShardManager, shard_of, sharded_source
+from ..runtime.sharding import (
+    EpochFencedSource,
+    ShardManager,
+    ring_epoch_of,
+    shard_of,
+    sharded_source,
+)
 from ..runtime.workqueue import WorkQueue, WorkQueueMetrics
 from . import status as status_machine
 from .job import (
@@ -177,7 +183,27 @@ class PyTorchController(
                 renew_interval=self.config.shard_renew_interval,
                 on_acquired=self._on_shard_acquired,
                 on_released=self._on_shard_released,
+                on_acquired_next=self._on_next_shard_acquired,
+                on_released_next=self._on_next_shard_released,
+                on_ring_flipped=self._on_ring_flipped,
+                migration_sweep=self._run_migration_sweep,
+                load_provider=self._shard_loads,
                 clock=self.config.clock or time.monotonic)
+            # live-reshard observability: the 0/1 migration-window gauge
+            # plus the ring epoch itself, so a scrape can tell WHICH
+            # ring a replica is reconciling for while the window is open
+            registry.gauge(
+                "pytorch_operator_resharding_in_progress",
+                "1 while a live shard-count migration is in flight on "
+                "this replica (old and new rings coexist), 0 otherwise",
+            ).set_function(
+                lambda: 1 if self.resharding_in_progress() else 0)
+            registry.gauge(
+                "pytorch_operator_ring_epoch",
+                "Current shard-ring epoch this replica reconciles for "
+                "(bumps by one at every completed live reshard)",
+            ).set_function(lambda: (self.shard_manager.ring_epoch
+                                    if self.shard_manager else 0))
         # Handlers are attributes so tier-2 tests can stub the status write
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
@@ -218,41 +244,94 @@ class PyTorchController(
             return set()
         return self.shard_manager.owned_shards()
 
+    def resharding_in_progress(self) -> bool:
+        return (self.shard_manager is not None
+                and self.shard_manager.resharding_in_progress())
+
+    def _ring_epochs(self):
+        mgr = self.shard_manager
+        if mgr is None:
+            return 0, None
+        return mgr.ring_epoch, mgr.next_ring_epoch
+
+    def _ring_target(self):
+        """(shard_count, epoch) newly stamped jobs are assigned to: the
+        TARGET ring while a migration is in flight — stamping straight
+        into the new ring is what makes the sweep converge — the
+        current ring otherwise."""
+        mgr = self.shard_manager
+        if mgr is None:
+            return 1, 0
+        if mgr.next_shard_count is not None:
+            return mgr.next_shard_count, int(mgr.next_ring_epoch or 0)
+        return mgr.shard_count, mgr.ring_epoch
+
+    def _target_owned(self):
+        """Shards this replica owns ON THE TARGET RING (next-ring
+        leases during a migration, current otherwise) — the admission
+        ownership gate."""
+        mgr = self.shard_manager
+        if mgr is None:
+            return set()
+        if mgr.next_shard_count is not None:
+            return mgr.owned_next_shards()
+        return mgr.owned_shards()
+
+    @staticmethod
+    def _ring_labels(shard: int, epoch: int):
+        """The label pair identifying (shard, ring): epoch 0 is label
+        absence (legacy objects parse unchanged), epochs >= 1 carry the
+        ring-epoch label next to the shard index."""
+        labels = {constants.LABEL_SHARD: str(shard)}
+        if epoch > 0:
+            labels[constants.LABEL_RING_EPOCH] = str(epoch)
+        return labels
+
+    @staticmethod
+    def _needs_stamp(obj: dict, epoch: int) -> bool:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        return (constants.LABEL_SHARD not in labels
+                or ring_epoch_of(obj) != epoch)
+
     def _admit_job(self, obj: dict) -> None:
-        """Admission stamping: a job without a shard label is assigned
-        ``shard_of(namespace, uid)`` — by the replica that OWNS that
-        shard (every replica computes the same index, so exactly one
-        stamps; a lost race is a no-op merge patch).  The label then
-        routes the job into the owner's shard-filtered informers, which
-        is where reconciliation begins."""
+        """Admission stamping: a job without a shard label — or still
+        carrying a retired ring's stamp — is assigned
+        ``shard_of(namespace, uid)`` under the TARGET ring, by the
+        replica that OWNS that target shard (every replica computes the
+        same index, so exactly one stamps; a lost race is a no-op merge
+        patch).  The label then routes the job into the owner's
+        shard-filtered informers, which is where reconciliation
+        begins."""
         meta = obj.get("metadata") or {}
-        if constants.LABEL_SHARD in (meta.get("labels") or {}):
+        count, epoch = self._ring_target()
+        if not self._needs_stamp(obj, epoch):
             return
         shard = shard_of(meta.get("namespace", "default"),
-                         meta.get("uid", ""), self.config.shard_count)
-        if shard not in self.owned_shards():
+                         meta.get("uid", ""), count)
+        if shard not in self._target_owned():
             return
         try:
             self.cluster.jobs.patch(
                 meta.get("namespace", "default"), meta.get("name", ""),
-                {"metadata": {"labels": {constants.LABEL_SHARD:
-                                         str(shard)}}})
+                {"metadata": {"labels": self._ring_labels(shard, epoch)}})
         except ApiError:
             return  # job gone / apiserver blip: the next event retries
-        self._stamp_existing_children(meta, shard)
+        self._stamp_existing_children(meta, shard, epoch)
 
-    def _stamp_existing_children(self, job_meta: dict, shard: int) -> None:
+    def _stamp_existing_children(self, job_meta: dict, shard: int,
+                                 epoch: int = 0) -> None:
         """Migration path: a job admitted BEFORE sharding was enabled
-        (or before this shard had an owner) already has unsharded
-        children, which the shard-filtered pod/service informers would
-        never see — their status transitions would stop re-enqueuing
-        the job.  Stamp the shard label onto every existing child once,
-        at job-stamp time (new children inherit it at creation; for
-        freshly admitted jobs this LIST finds nothing)."""
+        (or re-stamped onto a new ring) already has children carrying
+        no — or the old ring's — shard labels, which the shard-filtered
+        pod/service informers would never see — their status
+        transitions would stop re-enqueuing the job.  Stamp the ring
+        labels onto every existing child with its job (new children
+        inherit them at creation; for freshly admitted jobs this LIST
+        finds nothing)."""
         namespace = job_meta.get("namespace", "default")
         selector = self.gen_labels(job_meta.get("name", ""))
-        patch = {"metadata": {"labels": {constants.LABEL_SHARD:
-                                         str(shard)}}}
+        labels = self._ring_labels(shard, epoch)
+        patch = {"metadata": {"labels": labels}}
         for client in (self.cluster.pods, self.cluster.services):
             try:
                 children = client.list(namespace=namespace,
@@ -260,35 +339,78 @@ class PyTorchController(
             except ApiError:
                 continue
             for child in children:
-                child_meta = child.get("metadata") or {}
-                if constants.LABEL_SHARD in (child_meta.get("labels")
-                                             or {}):
+                child_labels = ((child.get("metadata") or {}).get(
+                    "labels") or {})
+                if all(child_labels.get(k) == v
+                       for k, v in labels.items()):
                     continue
+                child_meta = child.get("metadata") or {}
                 try:
                     client.patch(namespace, child_meta.get("name", ""),
                                  patch)
                 except ApiError:
                     pass  # child raced deletion / blip: resync heals
 
-    def _stamp_pending_jobs(self, shard: int) -> None:
-        """Label sweep on shard acquisition: jobs admitted while the
-        shard had no owner (or whose owner died before stamping) are in
-        the admission informer's store unlabeled — stamp the ones that
-        hash here."""
+    def _stamp_pending_jobs(self, shard: Optional[int] = None) -> None:
+        """Label sweep on shard acquisition: jobs admitted while their
+        shard had no owner (or whose owner died before stamping, or
+        that still carry a retired ring's labels after a missed sweep
+        window) sit in the admission informer's store — re-admit
+        everything; ``_admit_job``'s target-ring hash and ownership
+        gate make each call stamp exactly the jobs that land in a shard
+        this replica owns."""
         informer = self._admission_informer
         if informer is None:
             return
+        _count, epoch = self._ring_target()
         for obj in informer.store.list():
-            meta = obj.get("metadata") or {}
-            if constants.LABEL_SHARD in (meta.get("labels") or {}):
-                continue
-            if shard_of(meta.get("namespace", "default"),
-                        meta.get("uid", ""),
-                        self.config.shard_count) == shard:
+            if self._needs_stamp(obj, epoch):
                 self._admit_job(obj)
 
+    #: bounded re-stamp batch per migration-sweep call: keeps one sweep
+    #: pass short relative to the migration Lease's renew interval, so
+    #: an aborted sweep loses at most one batch of progress (the next
+    #: fence holder resumes idempotently)
+    MIGRATION_SWEEP_BATCH = 50
+
+    def _run_migration_sweep(self, old_count: int, new_count: int,
+                             new_epoch: int) -> bool:
+        """The fenced re-stamp sweep (runs ONLY on the migration-Lease
+        holder, from the shard manager's tick): move every job still
+        missing the target ring's labels onto it, children included,
+        exactly as admission stamping does.  Bounded and idempotent —
+        returns True only when a full pass over the admission store
+        found nothing left to move."""
+        informer = self._admission_informer
+        if informer is None or not informer.has_synced():
+            return False  # can't prove completeness from an unsynced cache
+        stamped = 0
+        for obj in informer.store.list():
+            if not self._needs_stamp(obj, new_epoch):
+                continue
+            meta = obj.get("metadata") or {}
+            shard = shard_of(meta.get("namespace", "default"),
+                             meta.get("uid", ""), new_count)
+            try:
+                self.cluster.jobs.patch(
+                    meta.get("namespace", "default"),
+                    meta.get("name", ""),
+                    {"metadata": {"labels": self._ring_labels(
+                        shard, new_epoch)}})
+            except NotFoundError:
+                continue  # deleted mid-sweep: nothing to migrate
+            except ApiError:
+                return False  # blip: resume next tick (idempotent)
+            self._stamp_existing_children(meta, shard, new_epoch)
+            stamped += 1
+            if stamped >= self.MIGRATION_SWEEP_BATCH:
+                return False  # bounded batch; resume next tick
+        return stamped == 0
+
     def _on_shard_acquired(self, shard: int) -> None:
-        runtime = _ShardRuntime(self, shard, workers=self._shard_workers)
+        epoch = self.shard_manager.ring_epoch if self.shard_manager else 0
+        runtime = _ShardRuntime(self, shard, workers=self._shard_workers,
+                                epoch=epoch)
         with self._shard_lock:
             self._shard_runtimes[shard] = runtime
         # per-shard nodeName index registered BEFORE the informer
@@ -298,15 +420,15 @@ class PyTorchController(
         if self._pod_index_union is not None:
             from ..disruption.watcher import PodNodeIndex
 
-            self._pod_index_union.add_index(
-                shard, PodNodeIndex(runtime.pod_informer))
+            runtime.pod_index = PodNodeIndex(runtime.pod_informer)
+            self._pod_index_union.add_index(shard, runtime.pod_index)
         # registered BEFORE informers start: the very first ADDED must
         # already route into this shard's queue
         runtime.start(self._stop_event or threading.Event())
         self._shard_jobs_gauge.labels(shard=str(shard)).set_function(
             lambda s=shard: self._shard_store_size(s))
-        self.logger.info("replica %s acquired shard %d",
-                         self.replica_id, shard)
+        self.logger.info("replica %s acquired shard %d (epoch %d)",
+                         self.replica_id, shard, epoch)
         self._stamp_pending_jobs(shard)
         # disruptions that struck while this shard had NO owner were
         # dropped by every replica's ownership gate — replay current
@@ -324,6 +446,99 @@ class PyTorchController(
             runtime.stop()
             self.logger.info("replica %s released shard %d",
                              self.replica_id, shard)
+
+    def _on_next_shard_acquired(self, shard: int) -> None:
+        """Acquired a shard of the TARGET ring mid-migration: run its
+        runtime alongside the old ring's (fresh ListWatches fenced on
+        the new epoch's selector), keyed into the next-ring table until
+        the flip promotes it."""
+        mgr = self.shard_manager
+        epoch = int(mgr.next_ring_epoch or 0) if mgr else 0
+        runtime = _ShardRuntime(self, shard, workers=self._shard_workers,
+                                epoch=epoch)
+        with self._shard_lock:
+            self._next_shard_runtimes[shard] = runtime
+        if self._pod_index_union is not None:
+            from ..disruption.watcher import PodNodeIndex
+
+            runtime.pod_index = PodNodeIndex(runtime.pod_informer)
+            self._pod_index_union.add_index(f"e{epoch}:{shard}",
+                                            runtime.pod_index)
+        runtime.start(self._stop_event or threading.Event())
+        self.logger.info(
+            "replica %s acquired next-ring shard %d (epoch %d)",
+            self.replica_id, shard, epoch)
+        self._stamp_pending_jobs(shard)
+
+    def _on_next_shard_released(self, shard: int) -> None:
+        with self._shard_lock:
+            runtime = self._next_shard_runtimes.pop(shard, None)
+        if runtime is not None:
+            if self._pod_index_union is not None:
+                self._pod_index_union.remove_index(
+                    f"e{runtime.epoch}:{shard}")
+            runtime.stop()
+            self.logger.info("replica %s released next-ring shard %d",
+                             self.replica_id, shard)
+
+    def _on_ring_flipped(self, epoch: int, count: int) -> None:
+        """The migration's commit point (old-ring runtimes are already
+        torn down — the manager releases old shards first): promote
+        every next-ring runtime into the live routing table and adopt
+        the new geometry."""
+        with self._shard_lock:
+            promoted = dict(self._next_shard_runtimes)
+            self._next_shard_runtimes.clear()
+            self._shard_runtimes.update(promoted)
+        self.config.shard_count = count
+        for shard, runtime in promoted.items():
+            if (self._pod_index_union is not None
+                    and runtime.pod_index is not None):
+                self._pod_index_union.remove_index(f"e{epoch}:{shard}")
+                self._pod_index_union.add_index(shard, runtime.pod_index)
+            self._shard_jobs_gauge.labels(shard=str(shard)).set_function(
+                lambda s=shard: self._shard_store_size(s))
+        self.logger.info(
+            "replica %s flipped to ring epoch %d (%d shards, "
+            "%d runtimes promoted)",
+            self.replica_id, epoch, count, len(promoted))
+
+    def _shard_loads(self):
+        """{shard: workqueue depth} across owned runtimes — the
+        heartbeat Lease's load payload (autoscaler input)."""
+        with self._shard_lock:
+            runtimes = dict(self._shard_runtimes)
+        return {shard: float(len(runtime.queue))
+                for shard, runtime in runtimes.items()}
+
+    def unsynced_shards(self) -> List[str]:
+        """Shard runtimes still replaying their initial LIST, as
+        display keys (``"2"`` current ring, ``"e2:1"`` next ring) —
+        the degraded-readiness detail."""
+        with self._shard_lock:
+            current = dict(self._shard_runtimes)
+            nxt = dict(self._next_shard_runtimes)
+        out = [str(shard) for shard, rt in sorted(current.items())
+               if not rt.synced()]
+        out += [f"e{rt.epoch}:{shard}" for shard, rt in sorted(nxt.items())
+                if not rt.synced()]
+        return out
+
+    def base_informers_synced(self) -> bool:
+        """The non-negotiable half of sharded readiness: admission and
+        node informers.  Per-shard sync state is reported as DEGRADED
+        (200) instead — see ``unsynced_shards`` — because shard
+        acquisition is routine (rebalances, reshards) and flapping the
+        whole replica unready on every handoff would eject it from
+        service just when it picked up work."""
+        if self.shard_manager is None:
+            return self.informers_synced()
+        informers = []
+        if self._admission_informer is not None:
+            informers.append(self._admission_informer)
+        if self.node_informer is not None:
+            informers.append(self.node_informer)
+        return all(i.has_synced() for i in informers)
 
     def _shard_store_size(self, shard: int) -> int:
         with self._shard_lock:
@@ -890,17 +1105,31 @@ class _ShardRuntime:
     tick thread; torn down on release/shutdown."""
 
     def __init__(self, controller: PyTorchController, shard: int,
-                 workers: int = 1):
+                 workers: int = 1, epoch: int = 0):
         self.shard = shard
+        self.epoch = int(epoch)
         self.controller = controller
+        self.pod_index = None  # set by the acquire hooks
         self.queue = WorkQueue(clock=controller.mono_clock)
+        # epoch >= 1 rings qualify the queue name: during a migration a
+        # next-ring runtime for shard i coexists with the old ring's,
+        # and the registry is get-or-create — a shared name would let
+        # two live queues fight over one depth gauge
+        queue_name = (f"pytorchjob-shard{shard}" if self.epoch == 0
+                      else f"pytorchjob-e{self.epoch}-shard{shard}")
         self.queue.set_metrics(WorkQueueMetrics(
-            controller.registry, f"pytorchjob-shard{shard}",
+            controller.registry, queue_name,
             clock=controller.mono_clock))
         cluster = controller.cluster
-        self._sources = [sharded_source(cluster, plural, shard)
+        self._sources = [sharded_source(cluster, plural, shard, epoch)
                          for plural in ("pytorchjobs", "pods", "services")]
-        jobs_src, pods_src, services_src = self._sources
+        # epoch membrane: the shard-label selector alone cannot exclude
+        # a LATER ring's objects that happen to hash to the same index
+        # (epoch-0 selectors are equality-only), so every source is
+        # fenced on this runtime's exact epoch before the informer sees
+        # it — the double-enqueue half of the migration fence
+        jobs_src, pods_src, services_src = [
+            EpochFencedSource(src, epoch) for src in self._sources]
         self.job_informer = Informer(
             jobs_src,
             coalesce=lambda key, old, new:

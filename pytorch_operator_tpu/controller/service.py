@@ -100,9 +100,11 @@ class ServiceReconcilerMixin:
         # replica, and widening it would strand pods created before the
         # job was stamped
         metadata_labels = dict(labels)
-        shard = (job.metadata.labels or {}).get(constants.LABEL_SHARD)
-        if shard is not None:
-            metadata_labels[constants.LABEL_SHARD] = shard
+        job_labels = job.metadata.labels or {}
+        for ring_key in (constants.LABEL_SHARD,
+                         constants.LABEL_RING_EPOCH):
+            if job_labels.get(ring_key) is not None:
+                metadata_labels[ring_key] = job_labels[ring_key]
 
         port = get_port_from_job(job, constants.REPLICA_TYPE_MASTER)
         return {

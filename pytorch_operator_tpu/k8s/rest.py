@@ -380,23 +380,55 @@ class RestClient:
         self._closed = True
 
     def request_text(self, method: str, path: str) -> str:
-        """Raw-text request (pod logs endpoint returns plain text)."""
-        if self.native is not None:
-            status, data = self.native.request(
-                method, path, headers=self._headers())
-            if status >= 400:
-                self._raise_for(status, data)
-            return data.decode(errors="replace")
-        conn = self._connect()
+        """Raw-text request (pod logs, /metrics scrapes): single-shot
+        (callers poll, so retries add nothing) but breaker-aware — a
+        connection failure here is the same endpoint-down evidence a
+        JSON request would count, and the multicore bench scrapes
+        per-replica /metrics through this path hard enough to matter.
+        The closed-client guard applies exactly as in :meth:`request`:
+        a transport error after our own ``close()`` is teardown, not
+        endpoint health — it must never strike the shared per-endpoint
+        breaker (a replica exiting mid-scrape would otherwise fail the
+        scraper's breaker open against a healthy endpoint)."""
+        if self.breaker is not None and not self.breaker.allow():
+            raise CircuitOpenError(
+                f"apiserver circuit breaker open; {method} {path} "
+                f"failed fast ({self.breaker.snapshot()})",
+                retry_in=self.breaker.remaining_open())
         try:
-            conn.request(method, path, headers=self._headers())
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status >= 400:
-                self._raise_for(resp.status, data)
-            return data.decode(errors="replace")
-        finally:
-            conn.close()
+            if self.native is not None:
+                status, data = self.native.request(
+                    method, path, headers=self._headers())
+            else:
+                conn = self._connect()
+                try:
+                    conn.request(method, path, headers=self._headers())
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                finally:
+                    conn.close()
+        except (OSError, HTTPException):
+            if self.breaker is not None:
+                if self._closed:
+                    self.breaker.release_probe()
+                else:
+                    self.breaker.on_failure()
+            raise
+        except BaseException:
+            # unexpected local error: hand back an admitted half-open
+            # probe slot or the breaker wedges (same rule as request())
+            if self.breaker is not None:
+                self.breaker.release_probe()
+            raise
+        if self.breaker is not None:
+            # any ANSWERED status means the endpoint is alive; this
+            # path is single-shot, so flow control (not the breaker)
+            # owns shedding on 429/5xx answers
+            self.breaker.on_success()
+        if status >= 400:
+            self._raise_for(status, data)
+        return data.decode(errors="replace")
 
     def stream_text_lines(self, method: str, path: str):
         """Stream a plain-text response line by line (generator).

@@ -221,19 +221,29 @@ class StubApiServer:
                     return None
                 changed, deleted, rv = delta
 
-                def keep(obj):
+                def in_ns(obj):
                     meta = obj.get("metadata") or {}
-                    if ns and meta.get("namespace") != ns:
-                        return False
-                    if selector:
-                        labels = meta.get("labels") or {}
-                        return all(labels.get(k) == v
-                                   for k, v in selector.items())
-                    return True
+                    return not ns or meta.get("namespace") == ns
 
+                def matches(obj):
+                    if not selector:
+                        return True
+                    labels = (obj.get("metadata") or {}).get(
+                        "labels") or {}
+                    return all(labels.get(k) == v
+                               for k, v in selector.items())
+
+                # an object changed OUT of the selector's view since the
+                # caller's RV is a deletion FROM that view (mirrors the
+                # watch stream's synthesized DELETED for re-labeled
+                # objects) — without it a windowed relist would strand
+                # re-sharded jobs in the old shard's store
                 return {"kind": "List", "windowed": True,
-                        "items": [o for o in changed if keep(o)],
-                        "deleted": [o for o in deleted if keep(o)],
+                        "items": [o for o in changed
+                                  if in_ns(o) and matches(o)],
+                        "deleted": ([o for o in deleted if in_ns(o)]
+                                    + [o for o in changed
+                                       if in_ns(o) and not matches(o)]),
                         "metadata": {"resourceVersion": str(rv)}}
 
             def _follow_log(self, store, ns, name):
@@ -338,6 +348,13 @@ class StubApiServer:
                             "labels") or {}
                         if not all(labels.get(k) == v
                                    for k, v in selector.items()):
+                            # kube-apiserver semantics: an object
+                            # MODIFIED out of a selector-scoped watch's
+                            # view leaves it as DELETED (a live-reshard
+                            # re-stamp must evict the job from the old
+                            # shard's informer, not strand it there)
+                            if et == "MODIFIED":
+                                events.put(("DELETED", obj))
                             return
                     events.put((et, obj))
 

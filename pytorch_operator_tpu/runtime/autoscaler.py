@@ -1,0 +1,127 @@
+"""Queue-depth-driven control-plane autoscaling (ISSUE 12 part 3).
+
+Each replica's :class:`~pytorch_operator_tpu.runtime.sharding.ShardManager`
+publishes its per-owned-shard workqueue depth in the heartbeat Lease's
+shard-load annotation (the ``workqueue_depth`` series PR 3 exports,
+summarized per shard).  This module closes the loop WITHOUT a metrics
+scrape path into every replica:
+
+  * :func:`fleet_loads` LISTs the heartbeat Leases (the same selector
+    membership scans use) and parses each live replica's load payload;
+  * :class:`AutoscalePolicy` turns the fleet-wide depth picture into a
+    :class:`Recommendation` — target replica count and target shard
+    count — consumed by the multicore bench harness today and by a
+    Deployment scaler later.
+
+The policy is deliberately small and deterministic: total queued work
+divided by a per-replica depth budget, clamped to ``[min_replicas,
+max_replicas]``, with the shard count held at ``max(current, replicas)``
+so every recommended replica can own at least one shard.  Scale-down
+is damped (one step at a time) so a momentarily drained queue does not
+thrash the fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, NamedTuple, Optional
+
+from ..k8s.errors import ApiError
+
+#: default depth budget: a replica is "busy enough" when the work
+#: queued against its shards exceeds this many items
+DEFAULT_TARGET_DEPTH_PER_REPLICA = 32.0
+
+
+class Recommendation(NamedTuple):
+    replicas: int
+    shard_count: int
+    reason: str
+
+
+def fleet_loads(lease_store, namespace: str = "default",
+                ) -> Dict[str, Dict[int, float]]:
+    """``{replica identity: {shard index: queue depth}}`` parsed from
+    every heartbeat Lease's shard-load annotation.  Replicas running a
+    build that predates load publishing simply contribute no entry —
+    absence of telemetry, not a zero-load claim."""
+    from ..api.v1 import constants
+
+    try:
+        leases = lease_store.list(
+            namespace=namespace,
+            label_selector={constants.LABEL_LEASE_COMPONENT:
+                            constants.LEASE_COMPONENT_HEARTBEAT})
+    except ApiError:
+        return {}
+    loads: Dict[str, Dict[int, float]] = {}
+    for lease in leases:
+        meta = lease.get("metadata") or {}
+        holder = ((lease.get("spec") or {}).get("holderIdentity")) or ""
+        raw = (meta.get("annotations") or {}).get(
+            constants.ANNOTATION_SHARD_LOAD)
+        if not holder or not raw:
+            continue
+        try:
+            payload = json.loads(raw)
+            loads[holder] = {int(shard): float(depth)
+                             for shard, depth in payload.items()}
+        except (ValueError, TypeError, AttributeError):
+            continue  # malformed payload: skip the replica, not the scan
+    return loads
+
+
+class AutoscalePolicy:
+    """Deterministic queue-depth policy: how many replicas (and shards)
+    should this fleet run right now?"""
+
+    def __init__(
+        self,
+        target_depth_per_replica: float = DEFAULT_TARGET_DEPTH_PER_REPLICA,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+    ):
+        if target_depth_per_replica <= 0:
+            raise ValueError("target_depth_per_replica must be > 0")
+        self.target_depth_per_replica = float(target_depth_per_replica)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+
+    def recommend(self, loads: Dict[str, Dict[int, float]],
+                  current_replicas: Optional[int] = None,
+                  current_shard_count: int = 1) -> Recommendation:
+        """``loads`` is :func:`fleet_loads` output (or any equivalent
+        snapshot).  ``current_replicas`` defaults to the number of
+        reporting replicas."""
+        replicas_now = (len(loads) if current_replicas is None
+                        else max(1, int(current_replicas)))
+        total_depth = sum(depth for per_shard in loads.values()
+                          for depth in per_shard.values())
+        wanted = math.ceil(total_depth / self.target_depth_per_replica)
+        target = max(self.min_replicas,
+                     min(self.max_replicas, max(1, wanted)))
+        if target < replicas_now - 1:
+            target = replicas_now - 1  # damped scale-down: one step
+        shard_count = max(1, int(current_shard_count), target)
+        if target > replicas_now:
+            reason = (f"queued depth {total_depth:.0f} exceeds "
+                      f"{self.target_depth_per_replica:.0f}/replica "
+                      f"across {replicas_now} replica(s)")
+        elif target < replicas_now:
+            reason = (f"queued depth {total_depth:.0f} sustains only "
+                      f"{target} replica(s); stepping down from "
+                      f"{replicas_now}")
+        else:
+            reason = (f"queued depth {total_depth:.0f} within budget "
+                      f"for {replicas_now} replica(s)")
+        return Recommendation(replicas=target, shard_count=shard_count,
+                              reason=reason)
+
+
+__all__ = [
+    "AutoscalePolicy",
+    "DEFAULT_TARGET_DEPTH_PER_REPLICA",
+    "Recommendation",
+    "fleet_loads",
+]

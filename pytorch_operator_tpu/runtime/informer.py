@@ -544,6 +544,16 @@ class Informer:
                 self._dispatch(self._handlers.update_funcs, key,
                                (old if old is not None else obj, obj))
             elif event_type == "DELETED":
+                if self.store.get_by_key(key) is None:
+                    # DELETED for a key this view never delivered:
+                    # drop it (client-go DeltaFIFO does the same for
+                    # unknown objects).  The normal route here is the
+                    # synthesized leave-selector DELETED a re-stamped
+                    # object fans out to every shard view it does NOT
+                    # match — dispatching those would enqueue the key
+                    # on every non-owning runtime at each migration
+                    # re-stamp.
+                    return
                 self.store.delete(obj)
                 if self._metrics is not None:
                     self._metrics.deleted.inc()

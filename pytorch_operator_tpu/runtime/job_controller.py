@@ -190,6 +190,10 @@ class JobController:
         # the shard's jobs.  Empty in single-replica mode, where every
         # queue operation resolves to self.work_queue unchanged.
         self._shard_runtimes: Dict[int, object] = {}
+        # target-ring runtimes during a live reshard (shard index under
+        # the NEW ring geometry -> runtime); promoted wholesale into
+        # _shard_runtimes at the ring flip.  Empty outside a migration.
+        self._next_shard_runtimes: Dict[int, object] = {}
         self._shard_lock = make_lock("controller.shards")
         # client-go workqueue metric families for the one sync queue;
         # both the Python and the native C++ queue take the same hooks.
@@ -248,10 +252,17 @@ class JobController:
 
     # -- enqueue -----------------------------------------------------------
     def _shard_runtime_snapshot(self) -> List[object]:
-        if not self._shard_runtimes:
+        if not self._shard_runtimes and not self._next_shard_runtimes:
             return []
         with self._shard_lock:
-            return list(self._shard_runtimes.values())
+            return (list(self._shard_runtimes.values())
+                    + list(self._next_shard_runtimes.values()))
+
+    def _ring_epochs(self):
+        """(current ring epoch, next ring epoch or None) — overridden
+        by the sharded controller, which reads its ShardManager.  The
+        base is permanently pre-resharding."""
+        return 0, None
 
     def _owns_job_key(self, key: str) -> bool:
         """Sharded ownership test: is ``key`` in one of this replica's
@@ -278,12 +289,27 @@ class JobController:
 
     def enqueue_job(self, job: dict) -> None:
         key = meta_namespace_key(job)
-        if self._shard_runtimes:
-            shard = ((job.get("metadata") or {}).get("labels")
-                     or {}).get(constants.LABEL_SHARD)
+        if self._shard_runtimes or self._next_shard_runtimes:
+            labels = (job.get("metadata") or {}).get("labels") or {}
+            shard = labels.get(constants.LABEL_SHARD)
             if shard is not None and shard.isdigit():
+                # a shard index is only meaningful together with its
+                # ring epoch: during a live reshard the same index
+                # exists in BOTH rings, and routing by index alone
+                # would double-deliver re-stamped jobs
+                from .sharding import ring_epoch_of
+
+                current_epoch, next_epoch = self._ring_epochs()
+                obj_epoch = ring_epoch_of(job)
                 with self._shard_lock:
-                    runtime = self._shard_runtimes.get(int(shard))
+                    if obj_epoch == current_epoch:
+                        runtime = self._shard_runtimes.get(int(shard))
+                    elif (next_epoch is not None
+                          and obj_epoch == next_epoch):
+                        runtime = self._next_shard_runtimes.get(
+                            int(shard))
+                    else:
+                        runtime = None
                 if runtime is not None:
                     runtime.queue.add(key)
                     return
@@ -301,8 +327,10 @@ class JobController:
         if manager is not None:
             manager.stop()
         with self._shard_lock:
-            runtimes = list(self._shard_runtimes.values())
+            runtimes = (list(self._shard_runtimes.values())
+                        + list(self._next_shard_runtimes.values()))
             self._shard_runtimes.clear()
+            self._next_shard_runtimes.clear()
         for runtime in runtimes:
             runtime.stop()
         self.fanout.shutdown()

@@ -59,6 +59,8 @@ class LeaderElector:
         on_stopped_leading: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         labels: Optional[Dict[str, str]] = None,
+        annotations: Optional[Callable[[], Dict[str, str]]] = None,
+        create_gate: Optional[Callable[[], bool]] = None,
     ):
         self.lease_store = lease_store
         self.identity = identity
@@ -74,6 +76,18 @@ class LeaderElector:
         # labels): lets membership scans LIST with a selector instead
         # of deserializing every Lease in the namespace
         self.labels = dict(labels) if labels else None
+        # annotations PROVIDER (not a static dict): resolved at every
+        # creation/renewal so the lease can carry live payload — the
+        # shard manager's heartbeat publishes per-shard workqueue depth
+        # through this.  A failing provider never blocks the renewal
+        # (liveness beats telemetry).
+        self.annotations = annotations
+        # mint fence: when set, a missing Lease is POSTed only while the
+        # gate returns True — every other caller keeps GETting 404 and
+        # CASes the record once the fenced minter has created it.  Used
+        # for leases ALL replicas target at once (migration fence),
+        # where unfenced create-on-404 is a guaranteed 409 race.
+        self.create_gate = create_gate
         self.is_leader = False
         self._stop = threading.Event()
         self._active_stop = self._stop
@@ -93,11 +107,22 @@ class LeaderElector:
 
     # -- lease record helpers ---------------------------------------------
 
+    def _provided_annotations(self) -> Dict[str, str]:
+        if self.annotations is None:
+            return {}
+        try:
+            return dict(self.annotations() or {})
+        except Exception:
+            return {}
+
     def _lease_obj(self) -> dict:
         ts = _micro_time_now()
         meta: dict = {"name": self.name, "namespace": self.namespace}
         if self.labels:
             meta["labels"] = dict(self.labels)
+        annotations = self._provided_annotations()
+        if annotations:
+            meta["annotations"] = annotations
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
@@ -129,6 +154,12 @@ class LeaderElector:
         try:
             lease = self.lease_store.get(self.namespace, self.name)
         except NotFoundError:
+            if self.create_gate is not None:
+                try:
+                    if not self.create_gate():
+                        return False  # not the designated minter
+                except Exception:
+                    return False
             try:
                 self.lease_store.create(self.namespace, self._lease_obj())
                 self._last_renew = now
@@ -166,6 +197,16 @@ class LeaderElector:
             if any(labels.get(k) != v for k, v in self.labels.items()):
                 labels.update(self.labels)
                 meta["labels"] = labels
+        annotations = self._provided_annotations()
+        if annotations:
+            # refresh the provider's annotations on every renewal (the
+            # heartbeat's load payload changes per tick); keys the
+            # provider stops emitting keep their last value — staleness
+            # is bounded by the lease expiry consumers already apply
+            meta = lease.setdefault("metadata", {})
+            merged = dict(meta.get("annotations") or {})
+            merged.update(annotations)
+            meta["annotations"] = merged
         lease["spec"] = {
             "holderIdentity": self.identity,
             "leaseDurationSeconds": max(1, int(round(self.lease_duration))),

@@ -35,6 +35,7 @@ mid-storm replica kill.
 from __future__ import annotations
 
 import hashlib
+import json
 import re
 import threading
 import time
@@ -66,6 +67,43 @@ def shard_selector(shard: int) -> Dict[str, str]:
     from ..api.v1 import constants
 
     return {constants.LABEL_SHARD: str(shard)}
+
+
+def ring_selector(shard: int, epoch: int) -> Dict[str, str]:
+    """The label selector confining a list+watch to one shard OF ONE
+    RING.  Epoch 0 is encoded as label absence (every pre-resharding
+    object parses as epoch 0), so the epoch term only appears for
+    epochs >= 1 — an epoch-0 selector is equality-only and therefore
+    cannot EXCLUDE re-stamped objects server-side; the old-ring
+    runtime's client-side epoch guard handles that half of the fence."""
+    selector = shard_selector(shard)
+    if epoch > 0:
+        from ..api.v1 import constants
+
+        selector[constants.LABEL_RING_EPOCH] = str(epoch)
+    return selector
+
+
+def ring_epoch_of(obj: dict) -> int:
+    """The ring epoch an object was stamped for (label absence = 0)."""
+    from ..api.v1 import constants
+
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    raw = labels.get(constants.LABEL_RING_EPOCH)
+    try:
+        return int(raw) if raw is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def ring_lease_name(prefix: str, shard: int, epoch: int) -> str:
+    """Shard-Lease name for (shard, epoch): epoch 0 keeps the legacy
+    un-suffixed ``<prefix>-<i>`` name (Leases minted before live
+    resharding existed stay valid); later epochs get ``-e<epoch>-``
+    so both rings' Leases coexist during a migration."""
+    if epoch <= 0:
+        return f"{prefix}-{shard}"
+    return f"{prefix}-e{epoch}-{shard}"
 
 
 def sanitize_identity(identity: str) -> str:
@@ -110,14 +148,25 @@ class LabelFilteredSource:
         changes = inner(since_rv)
         if changes is None:
             return None
+        # objects changed OUT of the selector's view count as deletions
+        # from this view (mirrors the watch wrapper's synthesized
+        # DELETED) — a windowed relist must heal the same way
         return changes._replace(
             items=[o for o in changes.items if self._matches(o)],
-            deleted=[o for o in changes.deleted if self._matches(o)])
+            deleted=([o for o in changes.deleted]
+                     + [o for o in changes.items if not self._matches(o)]))
 
     def add_listener(self, fn: Callable[[str, dict], None]) -> None:
         def wrapper(event_type: str, obj: dict) -> None:
             if event_type == "GAP" or self._matches(obj):
                 fn(event_type, obj)
+            elif event_type == "MODIFIED":
+                # kube-apiserver selector-watch semantics: an object
+                # MODIFIED out of the selector's view leaves the watch
+                # as DELETED — without this, a job re-stamped to a new
+                # ring would linger in the old shard's informer store
+                # forever (the migration-fence orphan)
+                fn("DELETED", obj)
 
         self._wrappers[fn] = wrapper
         self._store.add_listener(wrapper)
@@ -128,17 +177,133 @@ class LabelFilteredSource:
             self._store.remove_listener(wrapper)
 
 
-def sharded_source(cluster, plural: str, shard: int):
+class EpochFencedSource:
+    """Client-side ring-epoch membrane around a shard informer source.
+
+    Label selectors are equality-only, so an EPOCH-0 selector (epoch 0
+    = label absence) cannot exclude objects re-stamped for a later
+    ring server-side: a job whose new-ring shard index happens to equal
+    its old one still matches the old runtime's ``{shard: i}`` watch.
+    This adapter applies ``ring_epoch_of(obj) == epoch`` on top:
+    matching events pass, an object MODIFIED onto a different ring
+    leaves this view as a synthesized DELETED (the same semantics a
+    selector-scoped kube-apiserver watch has), and foreign-epoch ADDs
+    never enter the store.  Together with the epoch term the >=1-epoch
+    selectors DO carry, this is what makes a job PATCHed between rings
+    land in exactly one shard's workqueue."""
+
+    def __init__(self, source, epoch: int):
+        self._source = source
+        self.epoch = int(epoch)
+        self.kind = getattr(source, "kind", "")
+        self._wrappers: Dict[Callable, Callable] = {}
+
+    def _matches(self, obj: dict) -> bool:
+        return ring_epoch_of(obj) == self.epoch
+
+    def list(self, namespace=None, label_selector=None) -> List[dict]:
+        return [o for o in self._source.list(
+            namespace=namespace, label_selector=label_selector)
+            if self._matches(o)]
+
+    def list_changes(self, since_rv):
+        inner = getattr(self._source, "list_changes", None)
+        if inner is None:
+            return None
+        changes = inner(since_rv)
+        if changes is None:
+            return None
+        return changes._replace(
+            items=[o for o in changes.items if self._matches(o)],
+            deleted=([o for o in changes.deleted]
+                     + [o for o in changes.items if not self._matches(o)]))
+
+    def add_listener(self, fn: Callable[[str, dict], None]) -> None:
+        def wrapper(event_type: str, obj: dict) -> None:
+            if event_type in ("GAP", "DELETED") or self._matches(obj):
+                fn(event_type, obj)
+            elif event_type == "MODIFIED":
+                fn("DELETED", obj)
+
+        self._wrappers[fn] = wrapper
+        self._source.add_listener(wrapper)
+
+    def remove_listener(self, fn: Callable[[str, dict], None]) -> None:
+        wrapper = self._wrappers.pop(fn, None)
+        if wrapper is not None:
+            self._source.remove_listener(wrapper)
+
+    def stop_watch(self) -> None:
+        stop = getattr(self._source, "stop_watch", None)
+        if stop is not None:
+            stop()
+
+
+def sharded_source(cluster, plural: str, shard: int, epoch: int = 0):
     """A shard-confined informer source for ``plural`` on ``cluster``:
     server-side selector filtering when the backend supports it
     (``RestCluster.filtered`` — a fresh list+watch per acquisition, the
     handoff fencing the expectations machinery assumes), client-side
-    :class:`LabelFilteredSource` otherwise (FakeCluster)."""
-    selector = shard_selector(shard)
+    :class:`LabelFilteredSource` otherwise (FakeCluster).  ``epoch``
+    re-fences the selector on a ring-epoch change: acquiring a shard of
+    a NEW ring always builds a fresh ListWatch whose selector carries
+    the epoch label term."""
+    selector = ring_selector(shard, epoch)
     filtered = getattr(cluster, "filtered", None)
     if filtered is not None:
         return filtered(plural, selector)
     return LabelFilteredSource(cluster.resource(plural), selector)
+
+
+# -- ring record ------------------------------------------------------------
+
+def read_ring(lease_store, namespace: str = "default"
+              ) -> Optional[Tuple[int, int, Optional[int]]]:
+    """``(shard_count, ring_epoch, target_shard_count)`` from the ring
+    record Lease, or None when the record is absent/unreadable.  The
+    target is None unless a migration is pending/in flight."""
+    from ..api.v1 import constants
+
+    try:
+        lease = lease_store.get(namespace, constants.RING_LEASE_NAME)
+    except ApiError:
+        return None
+    ann = (lease.get("metadata") or {}).get("annotations") or {}
+    try:
+        count = int(ann.get(constants.ANNOTATION_RING_SHARD_COUNT) or 0)
+        epoch = int(ann.get(constants.ANNOTATION_RING_EPOCH) or 0)
+    except (TypeError, ValueError):
+        return None
+    if count < 1:
+        return None
+    raw_target = str(ann.get(constants.ANNOTATION_RING_TARGET) or "")
+    target = int(raw_target) if raw_target.isdigit() else None
+    return count, epoch, target
+
+
+def request_reshard(lease_store, target: int,
+                    namespace: str = "default") -> dict:
+    """Ask the live fleet to migrate to ``target`` shards: CAS the
+    target annotation onto the ring record Lease (the ``--reshard-to``
+    admin op).  Raises NotFoundError when no fleet has minted the ring
+    record yet, ValueError on a non-positive target.  Requesting the
+    current count clears any pending target (cancel before the sweep
+    leader has started acting on it)."""
+    from ..api.v1 import constants
+
+    target = int(target)
+    if target < 1:
+        raise ValueError(f"target shard count must be >= 1, got {target}")
+    lease = lease_store.get(namespace, constants.RING_LEASE_NAME)
+    meta = lease.setdefault("metadata", {})
+    ann = dict(meta.get("annotations") or {})
+    current = int(ann.get(constants.ANNOTATION_RING_SHARD_COUNT) or 0)
+    if target == current:
+        ann.pop(constants.ANNOTATION_RING_TARGET, None)
+    else:
+        ann[constants.ANNOTATION_RING_TARGET] = str(target)
+    meta["annotations"] = ann
+    return lease_store.update(lease)
 
 
 class ShardManager:
@@ -180,6 +345,11 @@ class ShardManager:
         renew_interval: float = 5.0,
         on_acquired: Optional[Callable[[int], None]] = None,
         on_released: Optional[Callable[[int], None]] = None,
+        on_acquired_next: Optional[Callable[[int], None]] = None,
+        on_released_next: Optional[Callable[[int], None]] = None,
+        on_ring_flipped: Optional[Callable[[int, int], None]] = None,
+        migration_sweep: Optional[Callable[[int, int, int], bool]] = None,
+        load_provider: Optional[Callable[[], Dict[int, float]]] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.lease_store = lease_store
@@ -192,6 +362,22 @@ class ShardManager:
         self.renew_interval = renew_interval
         self.on_acquired = on_acquired
         self.on_released = on_released
+        # next-ring ownership callbacks (fire during a migration, same
+        # contract as on_acquired/on_released but for the TARGET ring);
+        # on_ring_flipped(epoch, shard_count) is the commit point —
+        # after it fires the next ring IS the current ring
+        self.on_acquired_next = on_acquired_next
+        self.on_released_next = on_released_next
+        self.on_ring_flipped = on_ring_flipped
+        # migration_sweep(old_count, new_count, new_epoch) -> bool:
+        # re-stamp a bounded batch of old-ring jobs (and their
+        # children) with new-ring labels, returning True when nothing
+        # is left.  Called ONLY while this replica holds the migration
+        # Lease; must be idempotent and resumable (the fence can move).
+        self.migration_sweep = migration_sweep
+        # zero-arg provider of {shard index: workqueue depth}, published
+        # as the heartbeat Lease's shard-load annotation every renewal
+        self.load_provider = load_provider
         self.clock = clock
         from ..api.v1 import constants as _constants
 
@@ -200,16 +386,9 @@ class ShardManager:
         # instead of deserializing every Lease in the namespace — at
         # fleet scale the namespace also holds one Lease per SHARD
         # plus whatever other controllers keep there
-        self._electors: Dict[int, LeaderElector] = {
-            i: LeaderElector(
-                lease_store, identity, name=f"{lease_prefix}-{i}",
-                namespace=namespace, lease_duration=lease_duration,
-                renew_interval=renew_interval, clock=clock,
-                labels={_constants.LABEL_LEASE_COMPONENT:
-                        _constants.LEASE_COMPONENT_SHARD,
-                        _constants.LABEL_SHARD: str(i)})
-            for i in range(self.shard_count)
-        }
+        self.ring_epoch = 0
+        self._electors: Dict[int, LeaderElector] = self._make_electors(
+            self.shard_count, self.ring_epoch)
         self._heartbeat_name = (
             f"{replica_prefix}-{sanitize_identity(identity)}")
         self._heartbeat = LeaderElector(
@@ -217,10 +396,19 @@ class ShardManager:
             namespace=namespace, lease_duration=lease_duration,
             renew_interval=renew_interval, clock=clock,
             labels={_constants.LABEL_LEASE_COMPONENT:
-                    _constants.LEASE_COMPONENT_HEARTBEAT})
+                    _constants.LEASE_COMPONENT_HEARTBEAT},
+            annotations=self._heartbeat_annotations)
         # replica-lease name -> ((holder, renewTime), locally observed at)
         self._member_obs: Dict[str, Tuple[tuple, float]] = {}
         self._owned: Set[int] = set()
+        # migration state: populated while the ring record carries a
+        # target count, cleared at the flip (or on cancel)
+        self.next_shard_count: Optional[int] = None
+        self.next_ring_epoch: Optional[int] = None
+        self._next_electors: Dict[int, LeaderElector] = {}
+        self._owned_next: Set[int] = set()
+        self._migration: Optional[LeaderElector] = None
+        self._scan_offset_next = 0
         self._lock = make_lock("shard-manager")
         self._stop = threading.Event()
         self._release_on_stop = True
@@ -229,10 +417,59 @@ class ShardManager:
         # replicas start their acquisition sweep at different shards
         self._scan_offset = shard_of("", identity, self.shard_count)
 
+    def _make_electors(self, count: int,
+                       epoch: int) -> Dict[int, LeaderElector]:
+        from ..api.v1 import constants as _constants
+
+        electors = {}
+        for i in range(count):
+            labels = {_constants.LABEL_LEASE_COMPONENT:
+                      _constants.LEASE_COMPONENT_SHARD,
+                      _constants.LABEL_SHARD: str(i)}
+            if epoch > 0:
+                labels[_constants.LABEL_RING_EPOCH] = str(epoch)
+            electors[i] = LeaderElector(
+                self.lease_store, self.identity,
+                name=ring_lease_name(self.lease_prefix, i, epoch),
+                namespace=self.namespace,
+                lease_duration=self.lease_duration,
+                renew_interval=self.renew_interval, clock=self.clock,
+                labels=labels)
+        return electors
+
+    def _heartbeat_annotations(self) -> Dict[str, str]:
+        """Per-shard load payload for the heartbeat Lease (the
+        autoscaler's input).  Empty when no provider is wired — the
+        annotation then simply never appears."""
+        if self.load_provider is None:
+            return {}
+        try:
+            loads = self.load_provider() or {}
+        except Exception:
+            return {}
+        from ..api.v1 import constants as _constants
+
+        payload = {str(int(shard)): float(depth)
+                   for shard, depth in loads.items()}
+        return {_constants.ANNOTATION_SHARD_LOAD:
+                json.dumps(payload, sort_keys=True)}
+
     # -- state -------------------------------------------------------------
     def owned_shards(self) -> Set[int]:
         with self._lock:
             return set(self._owned)
+
+    def owned_next_shards(self) -> Set[int]:
+        """Shards of the TARGET ring this replica owns (empty outside a
+        migration)."""
+        with self._lock:
+            return set(self._owned_next)
+
+    def resharding_in_progress(self) -> bool:
+        """True between observing a reshard target and the ring flip —
+        exactly the window the ``pytorch_operator_resharding_in_progress``
+        gauge exposes."""
+        return self.next_shard_count is not None
 
     def _fire(self, hook: Optional[Callable[[int], None]],
               shard: int) -> None:
@@ -246,12 +483,27 @@ class ShardManager:
             logging.getLogger(__name__).warning(
                 "shard %d ownership callback failed", shard, exc_info=True)
 
-    def _mark_owned(self, shard: int, owned: bool) -> None:
+    def _fire_flipped(self, epoch: int, count: int) -> None:
+        if self.on_ring_flipped is None:
+            return
+        try:
+            self.on_ring_flipped(epoch, count)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ring-flip callback failed (epoch %d, %d shards)",
+                epoch, count, exc_info=True)
+
+    def _mark(self, owned_set: Set[int], shard: int, owned: bool) -> None:
         with self._lock:
             if owned:
-                self._owned.add(shard)
+                owned_set.add(shard)
             else:
-                self._owned.discard(shard)
+                owned_set.discard(shard)
+
+    def _mark_owned(self, shard: int, owned: bool) -> None:
+        self._mark(self._owned, shard, owned)
 
     # -- membership --------------------------------------------------------
     def live_members(self) -> Set[str]:
@@ -304,7 +556,7 @@ class ShardManager:
         return members
 
     # -- the rebalance tick ------------------------------------------------
-    def _quota(self, members) -> int:
+    def _quota(self, members, shard_count: Optional[int] = None) -> int:
         """This replica's shard quota under the floor/remainder split:
         members ranked by sorted identity, the first ``shards % members``
         get ``floor + 1``, the rest ``floor``.  A plain ceil-for-everyone
@@ -314,56 +566,288 @@ class ShardManager:
         computes the same split from the same membership set, so the
         sum is exactly ``shard_count`` and everyone converges to a
         nonzero share."""
+        shards = self.shard_count if shard_count is None else shard_count
         ranked = sorted(members)
         count = max(1, len(ranked))
-        base, remainder = divmod(self.shard_count, count)
+        base, remainder = divmod(shards, count)
         try:
             rank = ranked.index(self.identity)
         except ValueError:
             rank = count - 1
         return base + (1 if rank < remainder else 0)
 
-    def tick(self) -> None:
-        """One acquire/renew/release round (public so tests can drive
-        the state machine with fake clocks, no thread)."""
-        self._heartbeat.try_acquire_or_renew()
-        members = self.live_members()
-        fair = self._quota(members)
-        owned = sorted(self.owned_shards())
+    def _balance(self, electors: Dict[int, LeaderElector],
+                 owned_set: Set[int], fair: int, scan_offset: int,
+                 on_acquired, on_released) -> None:
+        """One renew/release/acquire round over ONE ring.  During a
+        migration this runs twice per tick — once for the current ring,
+        once for the target ring — with independent ownership sets."""
+        with self._lock:
+            owned = sorted(owned_set)
 
         # renew what we own; a lost CAS means another replica took over
         for shard in list(owned):
-            elector = self._electors[shard]
+            elector = electors[shard]
             if elector.try_acquire_or_renew():
                 elector.is_leader = True
             else:
                 elector.is_leader = False
                 owned.remove(shard)
-                self._mark_owned(shard, False)
-                self._fire(self.on_released, shard)
+                self._mark(owned_set, shard, False)
+                self._fire(on_released, shard)
 
         # release overage so joining replicas can pick shards up
         while len(owned) > fair:
             shard = owned.pop()  # highest index first: deterministic
-            self._electors[shard].release()
-            self._mark_owned(shard, False)
-            self._fire(self.on_released, shard)
+            electors[shard].release()
+            self._mark(owned_set, shard, False)
+            self._fire(on_released, shard)
 
         # observe every foreign shard (expiry clocks keep running even
         # when fairness forbids acquiring), acquire while under fair
-        for step in range(self.shard_count):
-            shard = (self._scan_offset + step) % self.shard_count
+        ring_size = len(electors)
+        for step in range(ring_size):
+            shard = (scan_offset + step) % ring_size
             if shard in owned:
                 continue
-            elector = self._electors[shard]
+            elector = electors[shard]
             _holder, acquirable = elector.observe()
             if not acquirable or len(owned) >= fair:
                 continue
             if elector.try_acquire_or_renew():
                 elector.is_leader = True
                 owned.append(shard)
-                self._mark_owned(shard, True)
-                self._fire(self.on_acquired, shard)
+                self._mark(owned_set, shard, True)
+                self._fire(on_acquired, shard)
+
+    def tick(self) -> None:
+        """One acquire/renew/release round (public so tests can drive
+        the state machine with fake clocks, no thread)."""
+        self._heartbeat.try_acquire_or_renew()
+        self._observe_ring()
+        members = self.live_members()
+        self._balance(self._electors, self._owned,
+                      self._quota(members, self.shard_count),
+                      self._scan_offset, self.on_acquired,
+                      self.on_released)
+        if self.next_shard_count is not None:
+            self._balance(self._next_electors, self._owned_next,
+                          self._quota(members, self.next_shard_count),
+                          self._scan_offset_next, self.on_acquired_next,
+                          self.on_released_next)
+            self._drive_migration()
+
+    # -- ring record / live resharding -------------------------------------
+    def _ring_lease_obj(self, count: int, epoch: int) -> dict:
+        from ..api.v1 import constants as _constants
+
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": _constants.RING_LEASE_NAME,
+                "namespace": self.namespace,
+                "labels": {_constants.LABEL_LEASE_COMPONENT:
+                           _constants.LEASE_COMPONENT_RING},
+                "annotations": {
+                    _constants.ANNOTATION_RING_SHARD_COUNT: str(count),
+                    _constants.ANNOTATION_RING_EPOCH: str(epoch),
+                },
+            },
+            "spec": {},
+        }
+
+    def _observe_ring(self) -> None:
+        """Reconcile local ring state against the ring record Lease:
+        mint the record on first contact (CLI geometry seeds it), adopt
+        the live geometry when the record disagrees (fresh joiner with
+        a stale ``--shard-count``, or a flip committed elsewhere), and
+        enter/track a migration while a target count is pending."""
+        ring = read_ring(self.lease_store, self.namespace)
+        if ring is None:
+            # mint fence: ONLY the current owner of shard 0 creates the
+            # ring record (shard-0 ownership is unique by Lease CAS) —
+            # an unfenced create here is POSTed by every replica at
+            # once, and the losers' 409s are indistinguishable from
+            # duplicate-create bugs in accounting.  Until someone owns
+            # shard 0, CLI geometry governs and no record exists.
+            if 0 not in self.owned_shards():
+                return
+            try:
+                self.lease_store.create(
+                    self.namespace,
+                    self._ring_lease_obj(self.shard_count, self.ring_epoch))
+            except ApiError:
+                pass  # lost a race with a prior minter / transient: re-read
+            ring = read_ring(self.lease_store, self.namespace)
+            if ring is None:
+                return
+        count, epoch, target = ring
+        if epoch > self.ring_epoch or (epoch == self.ring_epoch
+                                       and count != self.shard_count):
+            self._adopt_ring(count, epoch)
+        if target is not None and target != self.shard_count:
+            self._begin_reshard(target, self.ring_epoch + 1)
+        elif target is None and self.next_shard_count is not None:
+            # target cleared without an epoch bump: migration cancelled
+            self._retire_next()
+
+    def _begin_reshard(self, target: int, next_epoch: int) -> None:
+        if (self.next_shard_count == target
+                and self.next_ring_epoch == next_epoch):
+            return  # already migrating toward it
+        from ..api.v1 import constants as _constants
+
+        self._retire_next()  # a re-target supersedes the previous one
+        self.next_shard_count = max(1, int(target))
+        self.next_ring_epoch = next_epoch
+        self._next_electors = self._make_electors(
+            self.next_shard_count, next_epoch)
+        self._scan_offset_next = shard_of(
+            "", self.identity, self.next_shard_count)
+        self._migration = LeaderElector(
+            self.lease_store, self.identity,
+            name=_constants.MIGRATION_LEASE_NAME,
+            namespace=self.namespace, lease_duration=self.lease_duration,
+            renew_interval=self.renew_interval, clock=self.clock,
+            labels={_constants.LABEL_LEASE_COMPONENT:
+                    _constants.LEASE_COMPONENT_MIGRATION},
+            # same mint fence as the ring record: all migrating
+            # replicas race try_acquire_or_renew on this Lease every
+            # tick — only the shard-0 owner creates it on 404, everyone
+            # else CASes the existing record
+            create_gate=lambda: 0 in self.owned_shards())
+
+    def _retire_next(self) -> None:
+        with self._lock:
+            owned_next = sorted(self._owned_next, reverse=True)
+        for shard in owned_next:
+            self._next_electors[shard].release()
+            self._mark(self._owned_next, shard, False)
+            self._fire(self.on_released_next, shard)
+        if self._migration is not None and self._migration.is_leader:
+            self._migration.release()
+        self._next_electors = {}
+        with self._lock:
+            self._owned_next = set()
+        self.next_shard_count = None
+        self.next_ring_epoch = None
+        self._migration = None
+
+    def _drive_migration(self) -> None:
+        """Run the label re-stamp sweep while (and only while) this
+        replica holds the migration Lease; commit the ring flip once
+        the sweep reports nothing left."""
+        mig = self._migration
+        if mig is None:
+            return
+        if not mig.try_acquire_or_renew():
+            mig.is_leader = False
+            return
+        mig.is_leader = True
+        if self.migration_sweep is None:
+            return  # fence-only manager (bare tests): never flips
+        try:
+            done = self.migration_sweep(
+                self.shard_count, self.next_shard_count,
+                self.next_ring_epoch)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "migration sweep failed; will retry", exc_info=True)
+            return
+        if not done:
+            return
+        # re-assert the fence before committing: a sweep that stalled
+        # past lease expiry may have lost it to a resuming peer
+        if not mig.try_acquire_or_renew():
+            mig.is_leader = False
+            return
+        if self._commit_flip():
+            mig.release()
+
+    def _commit_flip(self) -> bool:
+        """CAS the ring record to the target geometry (epoch += 1,
+        target cleared) and promote the next ring locally.  Returns
+        False — and changes nothing — when the record moved under us
+        (an admin re-target raced the commit)."""
+        from ..api.v1 import constants as _constants
+
+        try:
+            lease = self.lease_store.get(
+                self.namespace, _constants.RING_LEASE_NAME)
+        except ApiError:
+            return False
+        meta = lease.setdefault("metadata", {})
+        ann = dict(meta.get("annotations") or {})
+        if (str(ann.get(_constants.ANNOTATION_RING_TARGET) or "")
+                != str(self.next_shard_count)):
+            return False
+        ann[_constants.ANNOTATION_RING_SHARD_COUNT] = str(
+            self.next_shard_count)
+        ann[_constants.ANNOTATION_RING_EPOCH] = str(self.next_ring_epoch)
+        ann.pop(_constants.ANNOTATION_RING_TARGET, None)
+        meta["annotations"] = ann
+        try:
+            self.lease_store.update(lease)
+        except ApiError:
+            return False
+        self._flip_to_next()
+        return True
+
+    def _flip_to_next(self) -> None:
+        """The local commit point: the old ring is dead — release every
+        owned old shard (the controller tears each runtime down in
+        on_released), promote next -> current, then announce the flip
+        (the controller promotes its next-ring runtimes there).  Old
+        shards are released FIRST so the controller never sees two
+        runtimes claim one shard index."""
+        new_epoch = int(self.next_ring_epoch or 0)
+        new_count = int(self.next_shard_count or 1)
+        with self._lock:
+            old_owned = sorted(self._owned, reverse=True)
+        for shard in old_owned:
+            self._electors[shard].release()
+            self._mark(self._owned, shard, False)
+            self._fire(self.on_released, shard)
+        with self._lock:
+            self._electors = self._next_electors
+            self._owned = self._owned_next
+            self._next_electors = {}
+            self._owned_next = set()
+        self.shard_count = new_count
+        self.ring_epoch = new_epoch
+        self.next_shard_count = None
+        self.next_ring_epoch = None
+        self._migration = None
+        self._scan_offset = shard_of("", self.identity, new_count)
+        self._fire_flipped(new_epoch, new_count)
+
+    def _adopt_ring(self, count: int, epoch: int) -> None:
+        """The record names a geometry this replica is not on.  If it
+        is exactly the migration we were tracking, that's the flip
+        committed by a peer — promote.  Otherwise adopt cold: drop
+        everything and re-enter at the record's geometry (per-shard
+        Lease CAS makes the drop safe; typically this is a fresh
+        joiner that owns nothing yet)."""
+        if (self.next_ring_epoch == epoch
+                and self.next_shard_count == count):
+            self._flip_to_next()
+            return
+        self._retire_next()
+        with self._lock:
+            old_owned = sorted(self._owned, reverse=True)
+        for shard in old_owned:
+            self._electors[shard].release()
+            self._mark(self._owned, shard, False)
+            self._fire(self.on_released, shard)
+        self.shard_count = max(1, int(count))
+        self.ring_epoch = int(epoch)
+        self._electors = self._make_electors(self.shard_count,
+                                             self.ring_epoch)
+        self._scan_offset = shard_of("", self.identity, self.shard_count)
+        self._fire_flipped(self.ring_epoch, self.shard_count)
 
     # -- lifecycle ---------------------------------------------------------
     def run(self, stop_event: Optional[threading.Event] = None) -> None:
@@ -392,6 +876,16 @@ class ShardManager:
                 self._electors[shard].is_leader = False
             self._mark_owned(shard, False)
             self._fire(self.on_released, shard)
+        for shard in sorted(self.owned_next_shards(), reverse=True):
+            if self._release_on_stop:
+                self._next_electors[shard].release()
+            else:
+                self._next_electors[shard].is_leader = False
+            self._mark(self._owned_next, shard, False)
+            self._fire(self.on_released_next, shard)
+        if (self._release_on_stop and self._migration is not None
+                and self._migration.is_leader):
+            self._migration.release()
         if self._release_on_stop:
             try:
                 self.lease_store.delete(self.namespace,
@@ -428,10 +922,16 @@ class ShardManager:
 
 
 __all__ = [
+    "EpochFencedSource",
     "LabelFilteredSource",
     "REPLICA_LEASE_PREFIX",
     "SHARD_LEASE_PREFIX",
     "ShardManager",
+    "read_ring",
+    "request_reshard",
+    "ring_epoch_of",
+    "ring_lease_name",
+    "ring_selector",
     "sanitize_identity",
     "shard_of",
     "shard_selector",

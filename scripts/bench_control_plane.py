@@ -1125,6 +1125,443 @@ def render_shards_md(res: dict, jobs: int, workers: int,
     ])
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 12 tentpole: process-per-replica multicore tier.  The --shards
+# tier above runs replicas as THREADS — one GIL, so N replicas measure
+# coordination overhead, not throughput (the 0.76-0.86x wall).  This
+# tier launches each replica as a real `cmd/operator.py` SUBPROCESS
+# against the same stub apiserver and scrapes each replica's own
+# /metrics endpoint over HTTP, so the replica-count -> wall /
+# reconcile-rate curve finally measures true multi-core scaling.
+
+MULTICORE_BEGIN = "<!-- multicore:begin -->"
+MULTICORE_END = "<!-- multicore:end -->"
+
+#: lease knobs for the subprocess fleet.  Leases are failure DETECTORS,
+#: not fences: a replica whose renew thread is starved past expiry is
+#: declared dead while still acting as owner, and that split-brain
+#: window double-creates (the 409s are absorbed as adoptions, but the
+#: strict counter records them).  Expiry must therefore exceed the
+#: worst-case scheduling stall of an oversubscribed box — the same
+#: ratio a production 15s lease keeps against seconds-long GC/API
+#: stalls — while staying short enough that the SIGKILL round's
+#: handover fits a bench run.
+MULTICORE_LEASE_S = 5.0
+MULTICORE_RENEW_S = 0.5
+
+
+def _free_port() -> int:
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_replica(url: str, replica_id: str, shard_count: int,
+                   threadiness: int) -> dict:
+    """Launch one operator replica as a true subprocess with its own
+    /metrics port; stderr is drained to a bounded buffer so the child
+    never blocks on a full pipe."""
+    import collections
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pytorch_operator_tpu.cmd.operator",
+         "--master", url, "--namespace", "default",
+         "--shard-count", str(shard_count),
+         "--replica-id", replica_id,
+         "--shard-lease-duration", f"{MULTICORE_LEASE_S}s",
+         "--shard-renew-interval", f"{MULTICORE_RENEW_S}s",
+         "--threadiness", str(threadiness),
+         "--monitoring-port", str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    log = collections.deque(maxlen=200)
+
+    def _drain():
+        for line in proc.stderr:
+            log.append(line.rstrip())
+
+    threading.Thread(target=_drain, daemon=True,
+                     name=f"{replica_id}-stderr").start()
+    return {"id": replica_id, "proc": proc, "port": port, "log": log,
+            "alive": True}
+
+
+def _scrape_metrics(port: int, timeout: float = 2.0) -> str:
+    """Per-replica /metrics over HTTP through RestClient.request_text —
+    the exact scrape path the closed-client breaker guard protects."""
+    from pytorch_operator_tpu.k8s.rest import KubeConfig, RestClient
+
+    client = RestClient(KubeConfig.from_url(f"http://127.0.0.1:{port}"),
+                        timeout=timeout)
+    try:
+        return client.request_text("GET", "/metrics")
+    finally:
+        client.close()
+
+
+def _metric_value(text: str, name: str) -> float:
+    """Sum every sample of ``name`` (labeled or not) in exposition text."""
+    import re as _re
+
+    total, found = 0.0, False
+    for m in _re.finditer(
+            rf'^{_re.escape(name)}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$',
+            text, _re.MULTILINE):
+        total += float(m.group(1))
+        found = True
+    return total if found else float("nan")
+
+
+def _shard_lease_holders(cluster) -> dict:
+    """{holder identity: [lease names]} for live shard-component Leases
+    on the stub server — ownership as the fleet itself proves it."""
+    from pytorch_operator_tpu.api.v1 import constants as _constants
+
+    holders: dict = {}
+    leases = cluster.resource("leases").list(
+        namespace="default",
+        label_selector={_constants.LABEL_LEASE_COMPONENT:
+                        _constants.LEASE_COMPONENT_SHARD})
+    for lease in leases:
+        holder = ((lease.get("spec") or {}).get("holderIdentity")) or ""
+        if holder:
+            name = (lease.get("metadata") or {}).get("name", "")
+            holders.setdefault(holder, []).append(name)
+    return holders
+
+
+def run_multicore(jobs: int, workers: int, shard_count: int,
+                  replicas: int, kill: bool = False,
+                  timeout: float = 240.0, threadiness: int = 2) -> dict:
+    """One process-per-replica round: ``replicas`` operator SUBPROCESSES
+    (true cores, no shared GIL) against one stub apiserver; jobs hash
+    over ``shard_count`` shards.  ``kill=True`` SIGKILLs replica 0 once
+    a third of the jobs succeeded — its shards must be re-acquired by
+    survivors after Lease expiry, full convergence, POST 409 == 0."""
+    srv = StubApiServer().start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    fleet = [_spawn_replica(url, f"mc-r{r}", shard_count, threadiness)
+             for r in range(replicas)]
+    out: dict = {"variant": ("multicore_kill" if kill else "multicore"),
+                 "jobs": jobs, "workers": workers,
+                 "shard_count": shard_count, "replicas": replicas,
+                 "threadiness": threadiness,
+                 "expected_pods": jobs * (workers + 1),
+                 "cpu_count": os.cpu_count()}
+
+    def total_owned() -> int:
+        return sum(len(v) for v in _shard_lease_holders(srv.cluster)
+                   .values())
+
+    def succeeded() -> int:
+        n = 0
+        for j in range(jobs):
+            try:
+                job = srv.cluster.jobs.get("default", f"mc-job-{j}")
+            except NotFoundError:
+                continue
+            if _condition_true(job, "Succeeded"):
+                n += 1
+        return n
+
+    def stop_replica(entry, sig) -> None:
+        entry["alive"] = False
+        if entry["proc"].poll() is None:
+            entry["proc"].send_signal(sig)
+
+    try:
+        import signal as _signal
+
+        # subprocess boot (interpreter + imports) is NOT part of the
+        # measured wall: wait for full shard ownership first
+        deadline = time.perf_counter() + 90.0
+        while total_owned() < shard_count:
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = (
+                    f"only {total_owned()}/{shard_count} shards owned "
+                    f"before the workload; last logs: "
+                    f"{[list(f['log'])[-3:] for f in fleet]}")
+                return out
+            if any(f["proc"].poll() is not None for f in fleet):
+                out["converged"] = False
+                out["error"] = "replica died during startup: " + str(
+                    [list(f["log"])[-5:] for f in fleet
+                     if f["proc"].poll() is not None])
+                return out
+            time.sleep(0.05)
+        out["owned_at_start"] = _shard_lease_holders(srv.cluster)
+        # cold boot races contending replicas over the same missing
+        # shard Leases (create-on-404; the loser's AlreadyExists is
+        # client-go's normal acquisition path) — snapshot so the
+        # duplicate-create verdict counts only the workload window,
+        # where every POST 409 would be a real double-create
+        post409_baseline = srv.counters.get("POST 409", 0)
+        out["post_conflicts_startup"] = post409_baseline
+
+        t0 = time.perf_counter()
+        for j in range(jobs):
+            srv.cluster.jobs.create("default",
+                                    new_job(f"mc-job-{j}", workers))
+        killed_at = None
+        deadline = t0 + timeout
+        while succeeded() < jobs:
+            if kill and killed_at is None and succeeded() >= jobs // 3:
+                out["killed_replica_owned"] = sorted(
+                    _shard_lease_holders(srv.cluster).get("mc-r0", []))
+                stop_replica(fleet[0], _signal.SIGKILL)
+                killed_at = time.perf_counter() - t0
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = f"{succeeded()}/{jobs} Succeeded at timeout"
+                return out
+            time.sleep(0.02)
+        out["converged"] = True
+        wall = time.perf_counter() - t0
+        out["convergence_wall_s"] = round(wall, 3)
+        if killed_at is not None:
+            out["killed_at_s"] = round(killed_at, 3)
+            # the workload can drain before the dead replica's Leases
+            # expire; re-acquisition is bounded by the expiry clock
+            reacquire_deadline = (time.perf_counter()
+                                  + 3 * MULTICORE_LEASE_S + 2.0)
+            while time.perf_counter() < reacquire_deadline:
+                holders = _shard_lease_holders(srv.cluster)
+                survivors = {h: v for h, v in holders.items()
+                             if h != "mc-r0"}
+                if sum(len(v) for v in survivors.values()) == shard_count:
+                    break
+                time.sleep(0.05)
+            out["survivors_owned"] = {
+                h: sorted(v) for h, v in
+                _shard_lease_holders(srv.cluster).items()
+                if h != "mc-r0"}
+            out["shards_reacquired"] = (
+                sum(len(v) for v in out["survivors_owned"].values())
+                == shard_count)
+        pods = srv.cluster.pods.list("default")
+        out["pods_final"] = len(pods)
+        out["pods_match_expected"] = len(pods) == out["expected_pods"]
+        out["duplicate_create_conflicts"] = (
+            srv.counters.get("POST 409", 0) - post409_baseline)
+
+        # per-replica reconcile + verb load from each replica's OWN
+        # /metrics endpoint over HTTP — each subprocess carries its own
+        # registry, which is the whole point of the tier
+        per_replica: dict = {}
+        total_reconciles = 0.0
+        for f in fleet:
+            if not f["alive"]:
+                per_replica[f["id"]] = {"killed": True}
+                continue
+            try:
+                text = _scrape_metrics(f["port"])
+            except Exception as e:  # scrape failure is data, not fatal
+                per_replica[f["id"]] = {"scrape_error": str(e)}
+                continue
+            reconciles = _metric_value(
+                text, "pytorch_operator_reconcile_duration_seconds_count")
+            rest_total = _metric_value(
+                text, "pytorch_operator_rest_request_duration_seconds_count")
+            entry = {"reconciles": reconciles, "rest_requests": rest_total}
+            recommended = _metric_value(
+                text, "pytorch_operator_autoscale_recommended_replicas")
+            if recommended == recommended:  # not NaN
+                entry["autoscale_recommended_replicas"] = recommended
+            per_replica[f["id"]] = entry
+            if reconciles == reconciles:
+                total_reconciles += reconciles
+        out["per_replica_metrics"] = per_replica
+        out["reconciles_total"] = total_reconciles
+        out["reconcile_rate_per_s"] = round(total_reconciles / wall, 1)
+        return out
+    finally:
+        import signal as _signal
+
+        for f in fleet:
+            if f["alive"]:
+                stop_replica(f, _signal.SIGTERM)
+        deadline = time.perf_counter() + 10.0
+        for f in fleet:
+            while (f["proc"].poll() is None
+                   and time.perf_counter() < deadline):
+                time.sleep(0.05)
+            if f["proc"].poll() is None:
+                f["proc"].kill()
+                f["proc"].wait(timeout=5.0)
+        kubelet.stop()
+        srv.stop()
+
+
+def run_multicore_curve(jobs: int, workers: int,
+                        replica_counts=(1, 2, 4),
+                        timeout: float = 240.0,
+                        threadiness: int = 2) -> dict:
+    """The replica-count -> convergence-wall / reconcile-rate curve,
+    plus the mid-storm SIGKILL round at the widest point.  Shard
+    geometry is FIXED at the widest replica count so every point does
+    identical hashing/labeling work and only the process count varies
+    (1 replica owns all shards, the widest owns one each).  shard_count
+    >= 2 everywhere: --shard-count 1 is the non-sharded leader-elect
+    path, a different machine entirely."""
+    shards = max(max(replica_counts), 2)
+    res: dict = {}
+    for n in replica_counts:
+        res[f"multicore_{n}"] = run_multicore(
+            jobs, workers, shards, n, timeout=timeout,
+            threadiness=threadiness)
+    widest = max(replica_counts)
+    res["multicore_kill"] = run_multicore(
+        jobs, workers, shards, widest, kill=True,
+        timeout=timeout, threadiness=threadiness)
+    return res
+
+
+def _multicore_reading(res: dict, replica_counts=(1, 2, 4)) -> str:
+    base = res.get(f"multicore_{replica_counts[0]}") or {}
+    killed = res.get("multicore_kill") or {}
+    if not all((res.get(f"multicore_{n}") or {}).get("converged")
+               for n in replica_counts):
+        return ("**Reading:** at least one multicore round failed to "
+                "converge — no scaling verdict from this run.")
+    walls = {n: res[f"multicore_{n}"]["convergence_wall_s"]
+             for n in replica_counts}
+    rates = {n: res[f"multicore_{n}"].get("reconcile_rate_per_s")
+             for n in replica_counts}
+    widest = max(replica_counts)
+    speedup = round(walls[replica_counts[0]] / walls[widest], 2) \
+        if walls[widest] else float("nan")
+    cpus = base.get("cpu_count")
+    dup_total = sum((res.get(k) or {}).get(
+        "duplicate_create_conflicts", 0) for k in res)
+    lines = [
+        f"**Reading:** process-per-replica replicas on a "
+        f"{cpus}-CPU box: convergence wall "
+        + ", ".join(f"{n} replica(s) = {walls[n]}s"
+                    for n in replica_counts)
+        + f" ({speedup}x at {widest} replicas vs 1), fleet reconcile "
+        f"rate " + ", ".join(f"{rates[n]}/s" for n in replica_counts)
+        + ".",
+    ]
+    if speedup >= 1.15:
+        lines.append(
+            f"Subprocess replicas beat the in-process --shards tier's "
+            f"0.76-0.86x thread wall: real cores, separate GILs, one "
+            f"shared apiserver — the control plane now scales with "
+            f"replica count.")
+    elif cpus is not None and cpus <= 1:
+        lines.append(
+            f"Honest per-box reading: this box has {cpus} CPU, so "
+            f"{widest} subprocesses time-slice one core and no speedup "
+            f"is physically possible here ({speedup}x measured).  "
+            f"Unlike the --shards thread tier, the ceiling is now the "
+            f"box, not the architecture: on an N-core box the same "
+            f"command measures real scaling.  What this box DOES "
+            f"prove: the per-replica reconcile split shows the work "
+            f"dividing across process boundaries, and the kill round "
+            f"below proves cross-process handover correctness.")
+    else:
+        lines.append(
+            f"Honest per-box reading: {speedup}x at {widest} replicas "
+            f"on {cpus} CPUs does NOT clear a 1.15x bar — the stub "
+            f"apiserver (one process, every watch stream and list on "
+            f"it) and the fake kubelet are the shared bottleneck here, "
+            f"not the replicas' GILs.  The per-replica reconcile/verb "
+            f"split above still shows the work dividing across "
+            f"processes.")
+    if killed.get("converged"):
+        lines.append(
+            f"Mid-storm SIGKILL of replica mc-r0 (owning "
+            f"{len(killed.get('killed_replica_owned') or [])} shards "
+            f"at kill time): survivors re-acquired "
+            f"{'ALL' if killed.get('shards_reacquired') else 'NOT all'} "
+            f"shards after Lease expiry, every job converged, and the "
+            f"whole run produced {dup_total} duplicate-create 409s "
+            f"across processes (the fresh-ListWatch handoff fence, "
+            f"now crossing process boundaries).")
+        if dup_total:
+            lines.append(
+                f"The {dup_total} conflict(s) are the zombie-write "
+                f"collision Lease fencing cannot exclude (the dead "
+                f"replica's already-queued POST committing around the "
+                f"survivor's takeover LIST); the create was absorbed "
+                f"as an adoption — final pod counts match expected "
+                f"exactly, so no duplicate OBJECT exists.")
+    else:
+        lines.append("Mid-storm SIGKILL round FAILED to converge: "
+                     + str(killed.get("error")))
+    return "\n".join(lines)
+
+
+def render_multicore_md(res: dict, jobs: int, workers: int,
+                        replica_counts=(1, 2, 4)) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+
+    def row(label, d):
+        if not d.get("converged"):
+            return f"| {label} | **NO** | — | — | — | — |"
+        loads = "; ".join(
+            f"{rid}:{int(v['reconciles'])}"
+            for rid, v in sorted(d.get("per_replica_metrics", {}).items())
+            if isinstance(v.get("reconciles"), float)
+            and v["reconciles"] == v["reconciles"])
+        return (f"| {label} | yes | {d['convergence_wall_s']} | "
+                f"{d.get('reconcile_rate_per_s')} | "
+                f"{d['duplicate_create_conflicts']} | {loads} |")
+
+    rows = [row(f"{n} process(es)", res[f"multicore_{n}"])
+            for n in replica_counts]
+    rows.append(row(f"{max(replica_counts)} processes + SIGKILL",
+                    res["multicore_kill"]))
+    return "\n".join([
+        MULTICORE_BEGIN,
+        f"## Process-per-replica control plane ({jobs} jobs x "
+        f"(1+{workers}) over HTTP; 1/2/4 operator SUBPROCESSES + "
+        f"SIGKILL round)",
+        "",
+        f"Generated {now} by `python scripts/bench_control_plane.py "
+        f"--multicore`.  Each replica is a real `cmd/operator.py` "
+        f"process (`--shard-count S --replica-id mc-r<i>`, own "
+        f"interpreter, own GIL, own /metrics port) against ONE stub "
+        f"apiserver; the harness reads convergence from the stub's "
+        f"store, ownership from the shard Leases, and per-replica "
+        f"reconcile counts by scraping each replica's own /metrics "
+        f"over HTTP.  The SIGKILL round hard-kills replica mc-r0 "
+        f"mid-storm: its shards must be re-acquired by survivors "
+        f"after Lease expiry with POST 409 == 0 across processes "
+        f"(counted over the workload window; cold-boot shard-Lease "
+        f"acquisition races — create-on-404, the loser's 409 is "
+        f"client-go's normal contended path — are reported separately "
+        f"as post_conflicts_startup).",
+        "",
+        "| variant | converged | wall s | reconciles/s | "
+        "duplicate-create 409s | per-replica reconciles |",
+        "|---|---|---|---|---|---|",
+        *rows,
+        "",
+        _multicore_reading(res, replica_counts),
+        "",
+        "```json",
+        json.dumps(res, indent=2),
+        "```",
+        MULTICORE_END,
+    ])
+
+
 def chaos_apiserver_plan(seed: int = 11, outage_s: float = 1.5,
                          error_rate: float = 0.10):
     """The committed chaos-apiserver fault shape (shared with the
@@ -2068,6 +2505,21 @@ def main() -> None:
     ap.add_argument("--shards-replicas", type=int, default=2,
                     help="operator replicas for the sharded variants")
     ap.add_argument("--shards-timeout", type=float, default=180.0)
+    ap.add_argument("--multicore", action="store_true",
+                    help="run the PROCESS-per-replica tier standalone "
+                    "(ISSUE 12): 1/2/4 operator subprocesses against one "
+                    "stub apiserver, per-replica /metrics scraped over "
+                    "HTTP, plus a mid-storm SIGKILL handover round; "
+                    "--out rewrites only the delimited multicore section")
+    ap.add_argument("--multicore-jobs", type=int, default=24)
+    ap.add_argument("--multicore-workers", type=int, default=3)
+    ap.add_argument("--multicore-replicas", type=int, nargs="*",
+                    default=[1, 2, 4],
+                    help="replica-count curve points (subprocesses)")
+    ap.add_argument("--multicore-threadiness", type=int, default=2,
+                    help="reconcile workers per replica process (keep low: "
+                    "the tier measures process scaling, not thread count)")
+    ap.add_argument("--multicore-timeout", type=float, default=240.0)
     ap.add_argument("--scale", action="store_true",
                     help="run the cluster-scale simulator tier "
                          "STANDALONE (ISSUE 8): a seeded 10k-job churn "
@@ -2149,6 +2601,27 @@ def main() -> None:
                                  args.shards_workers, args.shards_count,
                                  args.shards_replicas))
             print(f"[bench_cp] updated shards section of {args.out}",
+                  file=sys.stderr)
+        return
+
+    if args.multicore:
+        counts = tuple(args.multicore_replicas)
+        print(f"[bench_cp] multicore ({args.multicore_jobs} jobs x "
+              f"(1+{args.multicore_workers}); "
+              f"{'/'.join(str(c) for c in counts)} operator "
+              f"SUBPROCESSES + SIGKILL round)...", file=sys.stderr)
+        res = run_multicore_curve(
+            args.multicore_jobs, args.multicore_workers,
+            replica_counts=counts, timeout=args.multicore_timeout,
+            threadiness=args.multicore_threadiness)
+        for tier, r in res.items():
+            print(json.dumps({"tier": tier, **r}))
+        if args.out:
+            update_md_section(
+                args.out, MULTICORE_BEGIN, MULTICORE_END,
+                render_multicore_md(res, args.multicore_jobs,
+                                    args.multicore_workers, counts))
+            print(f"[bench_cp] updated multicore section of {args.out}",
                   file=sys.stderr)
         return
 

@@ -20,9 +20,13 @@ cd "$(dirname "$0")/.."
 # --mutation-detector runs the test tier under the cache mutation
 # detector (pytest --cache-mutation-detector): any in-place mutation of
 # a shared informer/watch cache object fails the run.
+# --multicore additionally runs the process-per-replica tier (slow:
+# each round boots N real operator subprocesses against one stub
+# apiserver, including the mid-storm SIGKILL handover round).
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
+RUN_MULTICORE=0
 WITNESS_ARGS=()
 DETECTOR_ARGS=()
 for arg in "$@"; do
@@ -30,9 +34,10 @@ for arg in "$@"; do
     --scale) RUN_SCALE=1 ;;
     --lint) LINT_ONLY=1 ;;
     --tsan) RUN_TSAN=1 ;;
+    --multicore) RUN_MULTICORE=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
     --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --witness --mutation-detector)" >&2; exit 2 ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
@@ -121,6 +126,11 @@ python __graft_entry__.py 8
 if [ "$RUN_SCALE" = 1 ]; then
   echo "=== cluster-scale simulator: slow 10k tier ==="
   python -m pytest tests/test_sim.py -q -m slow
+fi
+
+if [ "$RUN_MULTICORE" = 1 ]; then
+  echo "=== multicore: process-per-replica subprocess tier ==="
+  python -m pytest tests/test_multicore.py -q -m slow
 fi
 
 echo "all checks passed"

@@ -1,0 +1,359 @@
+"""Live shard re-hash + queue-depth autoscaling (ISSUE 12): the fenced
+migration sweep that changes --shard-count without a restart (old and
+new rings coexist while labels are re-stamped), the exactly-one-queue
+fence for jobs PATCHed between rings, degraded-but-200 readiness during
+the window, and the AutoscalePolicy the bench harness consumes."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime.autoscaler import (
+    AutoscalePolicy,
+    fleet_loads,
+)
+from pytorch_operator_tpu.runtime.informer import Informer
+from pytorch_operator_tpu.runtime.sharding import (
+    read_ring,
+    request_reshard,
+    ring_epoch_of,
+    shard_of,
+    sharded_source,
+)
+
+from tests.test_sharding import _condition_true, new_job, wait_for
+
+
+def _controller(cluster, replica_id, shards=2, registry=None):
+    from pytorch_operator_tpu.controller import PyTorchController
+
+    cfg = JobControllerConfig(
+        shard_count=shards, replica_id=replica_id,
+        shard_lease_duration=1.0, shard_renew_interval=0.05)
+    return PyTorchController(cluster, config=cfg,
+                             registry=registry or Registry())
+
+
+# ---------------------------------------------------------------------------
+# the migration fence, unit level
+
+
+class TestMigrationFence:
+    def test_sweep_requires_synced_admission_cache(self):
+        """An unsynced admission cache cannot prove the sweep complete:
+        the fence holder must keep the migration window open."""
+        ctl = _controller(FakeCluster(), "fence", shards=2)
+        assert ctl._run_migration_sweep(2, 3, 1) is False
+        ctl.shutdown()
+
+    def test_aborted_sweep_is_resumable_and_idempotent(self):
+        """The sweep is bounded (batch cap) and stateless over the
+        store: losing the migration Lease mid-stamp costs at most one
+        batch — the next holder's pass re-stamps nothing twice and
+        reports done only when a full pass found nothing to move."""
+        cluster = FakeCluster()
+        ctl = _controller(cluster, "fence", shards=2)
+        ctl._admission_informer.start()
+        jobs = [cluster.jobs.create("default", new_job(f"mig-{j}"))
+                for j in range(3)]
+        # one job has a pre-existing child that must ride the re-stamp
+        cluster.pods.create("default", {
+            "metadata": {"name": "mig-0-master-0",
+                         "labels": ctl.gen_labels("mig-0")},
+            "spec": {}})
+        ctl.MIGRATION_SWEEP_BATCH = 1  # force the abort-per-stamp path
+        # three aborted passes (one stamp each), then the clean pass
+        for expected_done in (False, False, False, True):
+            assert ctl._run_migration_sweep(2, 3, 1) is expected_done
+        for job in jobs:
+            fresh = cluster.jobs.get("default",
+                                     job["metadata"]["name"])
+            labels = fresh["metadata"]["labels"]
+            assert ring_epoch_of(fresh) == 1
+            assert labels[constants.LABEL_SHARD] == str(shard_of(
+                "default", fresh["metadata"]["uid"], 3))
+        pod = cluster.pods.get("default", "mig-0-master-0")
+        assert ring_epoch_of(pod) == 1
+        # idempotent: nothing left to move, labels unchanged
+        before = [cluster.jobs.get("default", j["metadata"]["name"])
+                  ["metadata"]["labels"] for j in jobs]
+        assert ctl._run_migration_sweep(2, 3, 1) is True
+        after = [cluster.jobs.get("default", j["metadata"]["name"])
+                 ["metadata"]["labels"] for j in jobs]
+        assert before == after
+        ctl.shutdown()
+
+    def test_job_patched_between_rings_lands_in_exactly_one_store(self):
+        """The informer-level fence: re-stamping a job from the old
+        ring to the new one must EVICT it from the old shard's informer
+        (synthesized DELETED) and ADD it to the new shard's — one add,
+        one delete, no double-enqueue, no orphan."""
+        cluster = FakeCluster()
+        job = cluster.jobs.create("default", new_job("fenced"))
+        uid = job["metadata"]["uid"]
+        old_shard = shard_of("default", uid, 2)
+        new_shard = shard_of("default", uid, 3)
+        old_src = sharded_source(cluster, "pytorchjobs", old_shard, 0)
+        new_src = sharded_source(cluster, "pytorchjobs", new_shard, 1)
+        old_inf, new_inf = Informer(old_src), Informer(new_src)
+        events = {"old": [], "new": []}
+        old_inf.add_event_handler(
+            on_add=lambda o: events["old"].append("add"),
+            on_delete=lambda o: events["old"].append("delete"))
+        new_inf.add_event_handler(
+            on_add=lambda o: events["new"].append("add"),
+            on_delete=lambda o: events["new"].append("delete"))
+        old_inf.start()
+        new_inf.start()
+        # stamp into the OLD ring: visible to the old informer only
+        cluster.jobs.patch("default", "fenced", {"metadata": {"labels": {
+            constants.LABEL_SHARD: str(old_shard)}}})
+        assert old_inf.store.contains("default/fenced")
+        assert not new_inf.store.contains("default/fenced")
+        # the migration re-stamp: old ring -> new ring in one PATCH
+        cluster.jobs.patch("default", "fenced", {"metadata": {"labels": {
+            constants.LABEL_SHARD: str(new_shard),
+            constants.LABEL_RING_EPOCH: "1"}}})
+        assert not old_inf.store.contains("default/fenced")
+        assert new_inf.store.contains("default/fenced")
+        assert events["old"] == ["add", "delete"]
+        assert events["new"] == ["add"]
+
+
+# ---------------------------------------------------------------------------
+# live reshard, end to end
+
+
+class TestLiveReshard:
+    def test_live_4_to_6_reshard_relabels_and_converges(self):
+        """The tentpole acceptance: a running fleet changes
+        --shard-count 4 -> 6 WITHOUT a restart.  Every job ends with
+        exactly ONE new-ring shard label, sits in exactly one shard
+        runtime's store, all jobs converge Succeeded, and the migration
+        window is visible through the resharding gauge."""
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)
+        kubelet.start()
+        registry = Registry()
+        ctl = _controller(cluster, "live", shards=4, registry=registry)
+        stop = threading.Event()
+        ctl.run(threadiness=2, stop_event=stop)
+        window_seen = []
+        try:
+            assert wait_for(lambda: ctl.owned_shards() == {0, 1, 2, 3})
+            for j in range(6):
+                cluster.jobs.create("default", new_job(f"rh-{j}"))
+            assert wait_for(lambda: all(
+                _condition_true(cluster.jobs.get("default", f"rh-{j}"),
+                                "Succeeded") for j in range(6)),
+                timeout=30)
+            assert "pytorch_operator_ring_epoch 0" in registry.expose()
+
+            request_reshard(cluster.resource("leases"), 6)
+
+            def flipped():
+                if ctl.resharding_in_progress():
+                    window_seen.append(registry.expose())
+                mgr = ctl.shard_manager
+                return (mgr.ring_epoch == 1 and mgr.shard_count == 6
+                        and ctl.owned_shards() == set(range(6)))
+
+            assert wait_for(flipped, timeout=30)
+            # a job created AFTER the flip is admitted on the new ring
+            cluster.jobs.create("default", new_job("rh-post"))
+            names = [f"rh-{j}" for j in range(6)] + ["rh-post"]
+            assert wait_for(lambda: all(
+                _condition_true(cluster.jobs.get("default", n),
+                                "Succeeded") for n in names),
+                timeout=30)
+            assert read_ring(cluster.resource("leases")) == (6, 1, None)
+            for n in names:
+                job = cluster.jobs.get("default", n)
+                labels = job["metadata"]["labels"]
+                assert ring_epoch_of(job) == 1
+                assert labels[constants.LABEL_SHARD] == str(shard_of(
+                    "default", job["metadata"]["uid"], 6))
+                # exactly one runtime store holds the key: no orphan,
+                # no double-ownership across the retired and live rings
+                holders = [s for s, rt in ctl._shard_runtimes.items()
+                           if rt.job_informer.store.contains(
+                               f"default/{n}")]
+                assert holders == [int(labels[constants.LABEL_SHARD])]
+            # children re-stamped onto the new ring with their jobs
+            for pod in cluster.pods.list("default"):
+                assert ring_epoch_of(pod) == 1
+            # the migration window was observable while it was open...
+            assert any("pytorch_operator_resharding_in_progress 1"
+                       in text for text in window_seen)
+            # ...and is closed (epoch advanced) in the final scrape
+            text = registry.expose()
+            assert "pytorch_operator_resharding_in_progress 0" in text
+            assert "pytorch_operator_ring_epoch 1" in text
+        finally:
+            stop.set()
+            ctl.shutdown()
+            kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# readiness during the window (satellite: degraded, not unready)
+
+
+class _FakeSharded:
+    """Just enough controller surface for make_readyz."""
+
+    def __init__(self, synced=True, pending=(), resharding=False):
+        self.shard_manager = object()
+        self._synced = synced
+        self._pending = list(pending)
+        self._resharding = resharding
+
+    def base_informers_synced(self):
+        return self._synced
+
+    def owned_shards(self):
+        return {0, 1}
+
+    def unsynced_shards(self):
+        return self._pending
+
+    def resharding_in_progress(self):
+        return self._resharding
+
+
+class TestReadyzDuringMigration:
+    def _readyz(self, controller):
+        from pytorch_operator_tpu.cmd.operator import make_readyz
+
+        return make_readyz(controller, threading.Event(),
+                           {"leading": False}, object())
+
+    def test_steady_state_is_ready_and_not_degraded(self):
+        ok, detail = self._readyz(_FakeSharded())()
+        assert ok and "degraded" not in detail
+        assert detail["shards"] == [0, 1]
+
+    def test_resharding_reports_degraded_but_stays_ready(self):
+        """Flapping /readyz on a routine ring migration would eject the
+        replica from service exactly while it is moving work: the
+        window must read DEGRADED at 200, never 503."""
+        ok, detail = self._readyz(_FakeSharded(resharding=True))()
+        assert ok is True
+        assert detail["degraded"] is True and detail["resharding"] is True
+
+    def test_freshly_acquired_unsynced_shards_degrade(self):
+        ok, detail = self._readyz(
+            _FakeSharded(pending=["2", "e1:3"]))()
+        assert ok is True
+        assert detail["degraded"] is True
+        assert detail["unsynced_shards"] == ["2", "e1:3"]
+
+    def test_unsynced_base_informers_are_unready(self):
+        """The admission/node caches are the one hard gate: without
+        them the replica cannot stamp or route anything."""
+        ok, _detail = self._readyz(_FakeSharded(synced=False))()
+        assert ok is False
+
+    def test_live_controller_exposes_readyz_surface(self):
+        """The fake above must not drift from the real controller: a
+        live sharded controller answers the same calls."""
+        ctl = _controller(FakeCluster(), "rz", shards=2)
+        readyz = self._readyz(ctl)
+        ok, detail = readyz()
+        assert ok in (True, False) and "shards" in detail
+        ctl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# queue-depth autoscaling (ISSUE 12 part 3)
+
+
+class TestAutoscaler:
+    def test_fleet_loads_parses_heartbeat_annotations(self):
+        cluster = FakeCluster()
+        leases = cluster.resource("leases")
+        leases.create("default", {
+            "metadata": {
+                "name": "pytorch-operator-replica-r0",
+                "labels": {constants.LABEL_LEASE_COMPONENT:
+                           constants.LEASE_COMPONENT_HEARTBEAT},
+                "annotations": {constants.ANNOTATION_SHARD_LOAD:
+                                '{"0": 3, "1": 5.5}'}},
+            "spec": {"holderIdentity": "r0"}})
+        leases.create("default", {
+            "metadata": {
+                "name": "pytorch-operator-replica-r1",
+                "labels": {constants.LABEL_LEASE_COMPONENT:
+                           constants.LEASE_COMPONENT_HEARTBEAT},
+                "annotations": {constants.ANNOTATION_SHARD_LOAD:
+                                "not json"}},
+            "spec": {"holderIdentity": "r1"}})
+        # a non-heartbeat Lease must not be scanned at all
+        leases.create("default", {
+            "metadata": {"name": "pytorch-operator-shard-0"},
+            "spec": {"holderIdentity": "r0"}})
+        loads = fleet_loads(leases)
+        # malformed payload skips the replica, not the scan
+        assert loads == {"r0": {0: 3.0, 1: 5.5}}
+
+    def test_scale_up_follows_total_depth(self):
+        policy = AutoscalePolicy(target_depth_per_replica=10,
+                                 max_replicas=8)
+        rec = policy.recommend({"r0": {0: 25.0}, "r1": {1: 10.0}},
+                               current_shard_count=2)
+        assert rec.replicas == 4  # ceil(35 / 10)
+        # every recommended replica can own at least one shard
+        assert rec.shard_count == 4
+
+    def test_scale_down_is_damped_one_step(self):
+        policy = AutoscalePolicy(target_depth_per_replica=10)
+        loads = {f"r{i}": {i: 0.0} for i in range(4)}
+        rec = policy.recommend(loads)
+        assert rec.replicas == 3  # 4 replicas, drained queue: one step
+        assert "stepping down" in rec.reason
+
+    def test_clamps_and_shard_floor(self):
+        policy = AutoscalePolicy(target_depth_per_replica=1,
+                                 min_replicas=2, max_replicas=3)
+        rec = policy.recommend({"r0": {0: 1000.0}},
+                               current_shard_count=6)
+        assert rec.replicas == 3  # clamped to max
+        assert rec.shard_count == 6  # never shrinks the current ring
+        idle = policy.recommend({}, current_replicas=1)
+        assert idle.replicas == 2  # clamped to min
+
+    def test_rejects_non_positive_budget(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(target_depth_per_replica=0)
+
+    def test_heartbeats_publish_loads_end_to_end(self):
+        """A live sharded controller's heartbeat Lease carries the
+        per-shard depth payload fleet_loads parses — the exact loop the
+        operator's autoscale gauge closes."""
+        cluster = FakeCluster()
+        ctl = _controller(cluster, "load-pub", shards=2)
+        stop = threading.Event()
+        ctl.run(threadiness=1, stop_event=stop)
+        try:
+            assert wait_for(lambda: ctl.owned_shards() == {0, 1})
+            leases = cluster.resource("leases")
+            # the payload rides heartbeat RENEWALS: the entry for a
+            # freshly built runtime appears one renew interval later
+            assert wait_for(lambda: set(
+                fleet_loads(leases).get("load-pub", {}).keys())
+                == {0, 1})
+            loads = fleet_loads(leases)
+            rec = AutoscalePolicy().recommend(
+                loads, current_shard_count=2)
+            assert rec.replicas >= 1 and rec.shard_count >= 2
+        finally:
+            stop.set()
+            ctl.shutdown()

@@ -735,3 +735,33 @@ class TestClosedClientBreakerGuard:
             cluster.pods.list("default")
         # no backoff sleeps were paid (4 attempts x 5s base otherwise)
         assert _time.monotonic() - t0 < 2.0
+
+    def test_closed_client_request_text_spares_shared_breaker(self):
+        """ISSUE 12 satellite (f): the closed-client guard extends to
+        the raw-text path.  The multicore bench scrapes per-replica
+        /metrics through request_text; a replica exiting mid-scrape
+        must not fail the scraper's SHARED breaker open against the
+        still-healthy stub apiserver."""
+        port = self._dead_port()
+        cfg = ResilienceConfig(max_attempts=1, breaker_threshold=2,
+                               breaker_reset=60.0)
+        dying = RestCluster(KubeConfig("127.0.0.1", port),
+                            resilience=cfg)
+        survivor = RestCluster(KubeConfig("127.0.0.1", port),
+                               resilience=cfg)
+        assert dying.breaker is survivor.breaker
+
+        dying.close()
+        for _ in range(5):
+            with pytest.raises(Exception):
+                dying.client.request_text("GET", "/metrics")
+        snap = survivor.breaker.snapshot()
+        assert snap["state"] == "closed"
+        assert snap["consecutive_failures"] == 0
+
+        # sanity: the NOT-closed client's scrape failures DO strike
+        for _ in range(2):
+            with pytest.raises(Exception):
+                survivor.client.request_text("GET", "/metrics")
+        assert survivor.breaker.state == "open"
+        survivor.close()

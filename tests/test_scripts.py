@@ -516,6 +516,62 @@ def test_bench_shards_tier_smoke(monkeypatch, tmp_path):
     assert text.count(bcp.SHARDS_BEGIN) == 1
 
 
+def test_bench_multicore_updater_rewrites_only_its_markers(monkeypatch,
+                                                          tmp_path):
+    """ISSUE 12: the --multicore renderer + section updater must
+    rewrite ONLY the multicore-delimited region — sibling tiers'
+    sections and prose outside the markers stay byte-identical.  (The
+    N-subprocess tier itself runs under @pytest.mark.slow in
+    tests/test_multicore.py; this smoke keeps the updater honest
+    without booting interpreters.)"""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    def fake_round(replicas, kill=False):
+        per = {f"mc-r{r}": {"reconciles": 10.0 + r,
+                            "rest_requests": 50.0,
+                            "autoscale_recommended_replicas": 1.0}
+               for r in range(replicas)}
+        if kill:
+            per["mc-r0"] = {"killed": True}
+        return {"variant": "multicore_kill" if kill else "multicore",
+                "jobs": 4, "workers": 1, "shard_count": 2,
+                "replicas": replicas, "threadiness": 2,
+                "expected_pods": 8, "cpu_count": 1,
+                "post_conflicts_startup": 0, "converged": True,
+                "convergence_wall_s": 5.0 / replicas,
+                "pods_final": 8, "pods_match_expected": True,
+                "duplicate_create_conflicts": 0,
+                "per_replica_metrics": per,
+                "reconciles_total": 20.0,
+                "reconcile_rate_per_s": 4.0 * replicas,
+                "shards_reacquired": kill or None}
+
+    res = {"multicore_1": fake_round(1), "multicore_2": fake_round(2),
+           "multicore_kill": fake_round(2, kill=True)}
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched prose\n"
+                  + bcp.SHARDS_BEGIN + "\nsibling tier\n"
+                  + bcp.SHARDS_END + "\n")
+    section = bcp.render_multicore_md(res, 4, 1, (1, 2))
+    bcp.update_md_section(str(md), bcp.MULTICORE_BEGIN,
+                          bcp.MULTICORE_END, section)
+    text = md.read_text()
+    assert "untouched prose" in text and "sibling tier" in text
+    assert text.count(bcp.MULTICORE_BEGIN) == 1
+    assert text.count(bcp.SHARDS_BEGIN) == 1
+    assert "Process-per-replica control plane" in text
+    # updating again replaces, never duplicates — and leaves siblings
+    bcp.update_md_section(str(md), bcp.MULTICORE_BEGIN,
+                          bcp.MULTICORE_END, section)
+    text = md.read_text()
+    assert text.count(bcp.MULTICORE_BEGIN) == 1
+    assert "sibling tier" in text
+    # the honest verdict rides the section: a 2x wall drop at 2
+    # replicas clears the bar only when the reading says so
+    assert "**Reading:**" in text
+
+
 def test_bench_chaos_tier_smoke(monkeypatch):
     """The --chaos tier (ROADMAP item) must run end to end: proactive
     variant fires gang restarts and populates the restart-latency

@@ -894,3 +894,61 @@ class TestShardLabeledEventsAndLeases:
         finally:
             m1.stop()
             m2.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12 satellite: SIGTERM on a real operator PROCESS releases its
+# shard Leases before exit
+
+
+def test_sigterm_releases_shard_leases_before_exit():
+    """A true `cmd/operator.py` subprocess owning shards must, on
+    SIGTERM, write empty-holder releases (ShardManager.stop()) before
+    exiting — successors acquire instantly instead of waiting out the
+    Lease.  The 30s lease duration makes the distinction observable:
+    empty holders right after exit can only mean release, not expiry."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+
+    srv = StubApiServer().start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "pytorch_operator_tpu.cmd.operator",
+         "--master", f"http://127.0.0.1:{srv.port}",
+         "--namespace", "default", "--shard-count", "2",
+         "--replica-id", "term-r0",
+         "--shard-lease-duration", "30s",
+         "--shard-renew-interval", "0.2s",
+         "--threadiness", "1", "--monitoring-port", "0"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+
+    def shard_leases():
+        return [lease for lease in srv.cluster.resource("leases").list(
+            namespace="default",
+            label_selector={constants.LABEL_LEASE_COMPONENT:
+                            constants.LEASE_COMPONENT_SHARD})]
+
+    try:
+        assert wait_for(lambda: sum(
+            1 for lease in shard_leases()
+            if (lease.get("spec") or {}).get("holderIdentity")
+            == "term-r0") == 2, timeout=60), (
+            "operator subprocess never acquired its shards; stderr: "
+            + (proc.stderr.read() if proc.poll() is not None else "?"))
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+        holders = [(lease.get("spec") or {}).get("holderIdentity")
+                   for lease in shard_leases()]
+        assert holders == ["", ""], holders
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        srv.stop()
