@@ -116,6 +116,22 @@ LABEL_RING_EPOCH = "pytorch.kubeflow.org/ring-epoch"
 # needing a metrics scrape path into every replica.
 ANNOTATION_SHARD_LOAD = "pytorch.kubeflow.org/shard-load"
 
+# --- Fleet observability ----------------------------------------------------
+# Trace-context annotation stamped on a PyTorchJob by the admitting
+# replica (JSON: admission trace id + replica id + ring epoch).  It is
+# the cross-replica join key: after a handoff the new owner's reconcile
+# traces and the admission-time timeline entry still share this
+# context, so the fleet collector (runtime/fleetview.py) can stitch one
+# job's story across replica boundaries.
+ANNOTATION_TRACE_CONTEXT = "pytorch.kubeflow.org/trace-context"
+# Per-job push-identity token injected into every replica pod's env at
+# build time (keyed hash of the job's namespace/name + uid under
+# --push-token-secret).  The PushGateway requires it when a token
+# resolver is wired: a payload claiming a job without that job's token
+# is rejected wholesale (reason="bad_token"), closing the spoofed-"job"
+# hole left by the store-containment check alone.
+ENV_PUSH_TOKEN = "PYTORCH_OPERATOR_PUSH_TOKEN"
+
 # --- Rendezvous environment ------------------------------------------------
 # Reference c10d wiring (pod.go:234-281), kept for backend='xla'
 # MASTER_ADDR compatibility in torch_xla workloads:

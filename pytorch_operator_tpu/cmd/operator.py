@@ -154,6 +154,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accept POST /push/v1/metrics from job pods and "
                         "re-export the samples as job-labeled series "
                         "(=false disables the endpoint)")
+    p.add_argument("--push-token-secret", default="",
+                   help="secret keying the per-job push identity token "
+                        "(injected into pod env at build time, checked "
+                        "on every /push/v1/metrics payload; mismatches "
+                        "count under reason=\"bad_token\").  '' (the "
+                        "default) still derives + checks tokens, just "
+                        "unkeyed — set a real secret in any deployment "
+                        "where pods are not trusted")
+    p.add_argument("--job-timeline-max-jobs", type=int, default=2048,
+                   help="per-replica bound on job lifecycle timelines "
+                        "kept for /debug/jobs and the phase-duration "
+                        "histograms (LRU-evicted beyond this)")
     p.add_argument("--push-series-budget", type=int, default=256,
                    help="max label sets per pushed metric family; "
                         "over-budget sets are counted in "
@@ -451,6 +463,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         replica_id=args.replica_id,
         shard_lease_duration=max(0.1, shard_lease_duration),
         shard_renew_interval=max(0.02, shard_renew_interval),
+        push_token_secret=args.push_token_secret,
+        job_timeline_max_jobs=args.job_timeline_max_jobs,
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)
@@ -483,20 +497,36 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         push_gateway = None
         if args.enable_push_ingestion:
             from pytorch_operator_tpu.telemetry import PushGateway
+            from pytorch_operator_tpu.telemetry.push import derive_push_token
 
             # identity hardening (ROADMAP push item): a pushed sample's
             # job must name a live PyTorchJob in the informer cache —
             # unknown jobs are counted under reason="unknown_job" and
-            # never mint a series
+            # never mint a series.  The token resolver closes the
+            # remaining hole: knowing a live job's NAME is no longer
+            # enough, the payload must carry the per-job token minted
+            # into the pod env at build time (mismatch ->
+            # reason="bad_token").
+            def _push_token_for(job_key: str):
+                ns, _, name = job_key.partition("/")
+                obj = controller._get_job_from_cache(ns, name)
+                if obj is None:
+                    return None
+                uid = (obj.get("metadata") or {}).get("uid") or ""
+                return derive_push_token(job_key, uid,
+                                         args.push_token_secret)
+
             push_gateway = PushGateway(
                 registry, series_budget=args.push_series_budget,
-                job_validator=controller.job_informer.store.contains)
+                job_validator=controller.job_informer.store.contains,
+                token_resolver=_push_token_for)
         metrics_server = start_metrics_server(
             registry, args.monitoring_port, tracer=tracer,
             health_checks={"healthz": healthz, "readyz": readyz},
-            push_gateway=push_gateway)
+            push_gateway=push_gateway, lifecycle=controller.lifecycle)
         port = metrics_server.server_address[1]
-        logger.info("metrics on :%d/metrics (traces on /debug/traces%s)",
+        logger.info("metrics on :%d/metrics (traces on /debug/traces, "
+                    "timelines on /debug/jobs%s)",
                     port,
                     ", push on /push/v1/metrics" if push_gateway else "")
         if kubelet is not None and push_gateway is not None:

@@ -62,6 +62,13 @@ class JobLifecycleMixin:
         except ApiError:
             pass
         self.jobs_created_counter.inc()
+        # timeline anchor: every later phase duration is measured from
+        # the first time this operator observed the job (guarded so the
+        # mixin keeps working on stripped-down test controllers)
+        lifecycle = getattr(self, "lifecycle", None)
+        if lifecycle is not None:
+            lifecycle.record(job.key, "submitted",
+                             uid=job.metadata.uid or "")
         self.enqueue_job(obj)
 
     def mark_job_invalid(self, obj: dict, err: Exception) -> None:

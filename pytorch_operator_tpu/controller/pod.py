@@ -248,6 +248,20 @@ class PodReconcilerMixin:
 
         set_cluster_spec(pod, job, index, rtype)
 
+        # per-job push-identity token: the pod proves its claimed job
+        # to the telemetry PushGateway with this env value (derived,
+        # never stored — the gateway re-derives from the live job's
+        # uid), closing the spoofed-"job"-field hole
+        from ..telemetry.push import derive_push_token
+
+        token = derive_push_token(
+            job.key, job.metadata.uid or "",
+            getattr(self.config, "push_token_secret", "") or "")
+        for container in pod["spec"].get("containers") or []:
+            if container.get("name") == constants.DEFAULT_CONTAINER_NAME:
+                container.setdefault("env", []).append(
+                    {"name": constants.ENV_PUSH_TOKEN, "value": token})
+
         if pod["spec"].get("restartPolicy"):
             msg = (
                 "Restart policy in pod template will be overwritten by"

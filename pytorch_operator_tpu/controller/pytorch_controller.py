@@ -10,6 +10,7 @@ persists status when it changed.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -35,6 +36,7 @@ from ..runtime.expectations import (
 )
 from ..runtime import tracing
 from ..runtime.informer import Informer, split_meta_namespace_key
+from ..runtime.lifecycle import JobLifecycleTracker
 from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
@@ -156,6 +158,7 @@ class PyTorchController(
         self._admission_informer = None
         self._stop_event = None
         self._shard_workers = 1
+        self.replica_id = self.config.replica_id or ""
         if self.config.shard_count > 1:
             import uuid as _uuid
 
@@ -204,6 +207,24 @@ class PyTorchController(
                 "(bumps by one at every completed live reshard)",
             ).set_function(lambda: (self.shard_manager.ring_epoch
                                     if self.shard_manager else 0))
+        # Fleet observability: per-job lifecycle timelines (milestones
+        # plus restart/resize/reshard segments) recorded from the
+        # reconcile path, served from /debug/jobs, exported as the
+        # phase-duration histogram.  Clocked exactly like the tracer so
+        # timelines captured under the simulator are deterministic.
+        self.lifecycle = JobLifecycleTracker(
+            registry=registry,
+            clock=self.mono_clock,
+            wall=self.config.clock,
+            max_jobs=self.config.job_timeline_max_jobs,
+            replica_id=self.replica_id)
+        # trace-loss accounting: ring evictions in the tracer become a
+        # counter, so /debug/traces under-reporting is a scrapeable fact
+        self.tracer.dropped_counter = registry.counter(
+            "pytorch_operator_traces_dropped_total",
+            "Completed reconcile traces evicted from the bounded "
+            "/debug/traces ring before being read (trace loss under "
+            "load)")
         # Handlers are attributes so tier-2 tests can stub the status write
         # (reference controller_test.go:214-217).
         self.update_status_handler = self._update_job_status
@@ -310,12 +331,36 @@ class PyTorchController(
                          meta.get("uid", ""), count)
         if shard not in self._target_owned():
             return
+        body: dict = {"metadata": {"labels": self._ring_labels(shard,
+                                                               epoch)}}
+        # cross-replica join key: the ADMITTING replica's context rides
+        # the job as an annotation, stamped once — re-stamps onto later
+        # rings keep the original admission context intact
+        annotations = meta.get("annotations") or {}
+        if constants.ANNOTATION_TRACE_CONTEXT not in annotations:
+            body["metadata"]["annotations"] = {
+                constants.ANNOTATION_TRACE_CONTEXT: json.dumps(
+                    {"replica": self.replica_id, "shard": shard,
+                     "epoch": epoch}, sort_keys=True)}
+        restamp = constants.LABEL_SHARD in (meta.get("labels") or {})
         try:
             self.cluster.jobs.patch(
                 meta.get("namespace", "default"), meta.get("name", ""),
-                {"metadata": {"labels": self._ring_labels(shard, epoch)}})
+                body)
         except ApiError:
             return  # job gone / apiserver blip: the next event retries
+        key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+        if restamp:
+            # moving rings: the job's key may sit ownerless until the
+            # new ring's owner picks it up — an annotated segment, not
+            # a milestone (the segment closes at the next owned sync)
+            self.lifecycle.begin_segment(
+                key, "reshard", uid=meta.get("uid", ""),
+                attrs={"shard": shard, "epoch": epoch})
+        else:
+            self.lifecycle.record(
+                key, "shard_stamped", uid=meta.get("uid", ""),
+                attrs={"shard": shard, "epoch": epoch})
         self._stamp_existing_children(meta, shard, epoch)
 
     def _stamp_existing_children(self, job_meta: dict, shard: int,
@@ -401,6 +446,11 @@ class PyTorchController(
                 continue  # deleted mid-sweep: nothing to migrate
             except ApiError:
                 return False  # blip: resume next tick (idempotent)
+            self.lifecycle.begin_segment(
+                f"{meta.get('namespace', 'default')}/"
+                f"{meta.get('name', '')}",
+                "reshard", uid=meta.get("uid", ""),
+                attrs={"shard": shard, "epoch": new_epoch})
             self._stamp_existing_children(meta, shard, new_epoch)
             stamped += 1
             if stamped >= self.MIGRATION_SWEEP_BATCH:
@@ -740,7 +790,13 @@ class PyTorchController(
             return True
         try:
             start = self.mono_clock()
-            with self.tracer.trace("reconcile", key=key) as tspan:
+            epoch = (self.shard_manager.ring_epoch
+                     if self.shard_manager is not None else 0)
+            # replica + ring epoch on the ROOT span: the fleet collector
+            # stitches one job's traces across replicas by these attrs
+            with self.tracer.trace("reconcile", key=key,
+                                   replica=self.replica_id,
+                                   ring_epoch=epoch) as tspan:
                 forget, err = self.sync_job(key)
                 result = ("error" if err is not None
                           else "success" if forget else "requeue")
@@ -751,6 +807,14 @@ class PyTorchController(
             self.sync_duration.labels(result=result).observe(
                 self.mono_clock() - start,
                 exemplar={"trace_id": tspan.trace_id})
+            self.lifecycle.record(key, "first_reconcile",
+                                  trace_id=tspan.trace_id)
+            self.lifecycle.note_sync(key, trace_id=tspan.trace_id,
+                                     result=result, ring_epoch=epoch)
+            if result == "success":
+                # a re-stamped job's first owned sync under the new
+                # ring ends its ownerless window
+                self.lifecycle.end_segment(key, "reshard")
             if err is None and forget:
                 queue.forget(key)
             elif isinstance(err, CircuitOpenError):
@@ -869,6 +933,31 @@ class PyTorchController(
             dspan.set_attr("pods", len(pods))
             dspan.set_attr("services", len(services))
 
+        # Lifecycle milestones from this sync's observed pod state (all
+        # idempotent; the tracker also closes restart/resize segments
+        # once the gang is whole again).  An open Resizing condition
+        # opens the resize segment regardless of which subsystem set it.
+        uid = job.metadata.uid or ""
+        self.lifecycle.pods_observed(
+            job_key,
+            created=len(pods),
+            bound=sum(1 for p in pods
+                      if (p.get("spec") or {}).get("nodeName")),
+            # Running-or-beyond: a pod that already Succeeded HAS run,
+            # and a fast pod finishing before the last one starts must
+            # not keep all_running from ever firing
+            running=sum(1 for p in pods
+                        if (p.get("status") or {}).get("phase")
+                        in ("Running", "Succeeded")),
+            total=get_total_replicas(job),
+            uid=uid,
+            trace_id=tracing.current_trace_id())
+        if any(c.type == constants.JOB_RESIZING and c.status == "True"
+               for c in job.status.conditions):
+            self.lifecycle.begin_segment(job_key, "resize", uid=uid)
+        else:
+            self.lifecycle.end_segment(job_key, "resize")
+
         # Terminal: clean up and freeze status.
         if status_machine.is_succeeded(job.status) or status_machine.is_failed(job.status):
             self.delete_pods_and_services(job, job_dict, pods, services)
@@ -970,6 +1059,9 @@ class PyTorchController(
                 failure_message,
             )
             self.jobs_failed_counter.inc()
+            self.lifecycle.record(job_key, "failed", uid=uid,
+                                  trace_id=tracing.current_trace_id(),
+                                  attrs={"reason": "limit"})
         else:
             if gang:
                 # gang minMember tracks the ELASTIC target: a shrunken
@@ -1030,6 +1122,9 @@ class PyTorchController(
                     job.status, constants.JOB_SUCCEEDED, status_machine.JOB_SUCCEEDED_REASON, msg
                 )
                 self.jobs_successful_counter.inc()
+                self.lifecycle.record(
+                    job.key, "succeeded", uid=job.metadata.uid or "",
+                    trace_id=tracing.current_trace_id())
 
         if failed > 0:
             if restart:
@@ -1045,6 +1140,9 @@ class PyTorchController(
                 )
                 self.jobs_failed_counter.inc()
                 self.jobs_restarted_counter.inc()
+                self.lifecycle.begin_segment(
+                    job.key, "restart", uid=job.metadata.uid or "",
+                    attrs={"replica_type": rtype, "failed": failed})
             else:
                 msg = (
                     f"PyTorchJob {job.metadata.name} is failed because"
@@ -1059,6 +1157,10 @@ class PyTorchController(
                     job.status, constants.JOB_FAILED, status_machine.JOB_FAILED_REASON, msg
                 )
                 self.jobs_failed_counter.inc()
+                self.lifecycle.record(
+                    job.key, "failed", uid=job.metadata.uid or "",
+                    trace_id=tracing.current_trace_id(),
+                    attrs={"replica_type": rtype, "failed": failed})
 
     # -- limits (controller.go:518-569) ------------------------------------
     def past_backoff_limit(self, job: PyTorchJob, pods: List[dict]) -> bool:

@@ -510,6 +510,15 @@ class FakeKubelet:
         if not job_name:
             return
         job = f"{meta.get('namespace', 'default')}/{job_name}"
+        # the trainer reads its push-identity token from the env the
+        # operator injected at pod build time — the fake kubelet plays
+        # that side by reading the rendered pod spec
+        token = None
+        for container in (pod.get("spec") or {}).get("containers") or []:
+            for env in container.get("env") or []:
+                if env.get("name") == _api_constants.ENV_PUSH_TOKEN:
+                    token = env.get("value")
+                    break
         try:
             from pytorch_operator_tpu.telemetry.push import push_job_steps
 
@@ -518,7 +527,7 @@ class FakeKubelet:
             step = max(self.complete_delay / max(1, self.push_steps), 1e-4)
             push_job_steps(url, job, [step] * self.push_steps,
                            tokens_per_sec=round(4096.0 / step, 1),
-                           mfu=0.5, timeout=2.0)
+                           mfu=0.5, timeout=2.0, token=token)
         except Exception:
             pass
 

@@ -16,8 +16,15 @@ re-exports them as ``job``-labeled families under the series budget.
 The rest is the observability layer's debug/ops surface:
 
   * ``/debug/traces`` — the tracer's ring of completed reconcile traces
-    as JSON, newest first (``?limit=N`` truncates); 404 when the process
-    was started without a tracer.
+    as JSON, newest first (``?limit=N`` truncates); the response carries
+    ``dropped`` (roots the ring evicted) so trace loss under load is
+    visible, not silent; 404 when the process was started without a
+    tracer.
+  * ``/debug/jobs`` — the lifecycle tracker's per-job timelines
+    (milestones, restart/resize/reshard segments, recent syncs) as
+    JSON, newest-touched first (``?limit=N`` truncates, ``?job=ns/name``
+    selects one); milestone entries carry trace ids that cross-link
+    into ``/debug/traces``; 404 without a tracker.
   * ``/healthz`` — liveness; 200 while the process serves, 503 once the
     registered check fails (e.g. shutdown began).
   * ``/readyz`` — readiness; reflects informer sync and leader state
@@ -54,6 +61,7 @@ def start_metrics_server(
     tracer=None,
     health_checks: Optional[Dict[str, HealthCheck]] = None,
     push_gateway=None,
+    lifecycle=None,
 ) -> ThreadingHTTPServer:
     """Serve the operator HTTP surface in a daemon thread.
 
@@ -61,7 +69,9 @@ def start_metrics_server(
     ``port`` is 0 (server.server_address[1] tells which).  ``tracer``
     enables /debug/traces; ``health_checks`` maps ``"healthz"`` /
     ``"readyz"`` to ``() -> (ok, detail)`` callables; ``push_gateway``
-    (telemetry.PushGateway) enables ``POST /push/v1/metrics``.
+    (telemetry.PushGateway) enables ``POST /push/v1/metrics``;
+    ``lifecycle`` (runtime.lifecycle.JobLifecycleTracker) enables
+    /debug/jobs.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -103,7 +113,27 @@ def start_metrics_server(
                 except ValueError:
                     self._send_json(400, {"error": "limit must be an int"})
                     return
-                self._send_json(200, {"traces": tracer.snapshot(limit)})
+                self._send_json(200, {"traces": tracer.snapshot(limit),
+                                      "dropped": tracer.dropped})
+            elif path == "/debug/jobs":
+                if lifecycle is None:
+                    self._send_json(404,
+                                    {"error": "lifecycle tracking "
+                                              "not enabled"})
+                    return
+                limit = None
+                job = None
+                try:
+                    q = urllib.parse.parse_qs(url.query)
+                    if "limit" in q:
+                        limit = max(0, int(q["limit"][0]))
+                    if "job" in q:
+                        job = q["job"][0]
+                except ValueError:
+                    self._send_json(400, {"error": "limit must be an int"})
+                    return
+                self._send_json(200, lifecycle.snapshot(limit=limit,
+                                                        job=job))
             elif path in ("/healthz", "/readyz"):
                 check = (health_checks or {}).get(path.lstrip("/"))
                 if check is None:

@@ -1,0 +1,307 @@
+"""Fleet-wide observability collector: scrape every replica's
+``/metrics``, ``/debug/jobs`` and ``/debug/traces`` and merge them into
+ONE view of the fleet.
+
+Since the control plane went multi-process (process-per-replica
+sharding), every observability surface became replica-local: a job that
+migrates replicas during a SIGKILL or a live reshard has its timeline
+split across processes, and no single endpoint can answer "how long did
+that job sit ownerless?".  This module is the merge:
+
+  * :func:`scrape_replica` — one replica's three surfaces over plain
+    HTTP (stdlib urllib; the collector must work against a half-dead
+    fleet, so per-replica failures surface as ``error`` entries, not
+    exceptions);
+  * :func:`merge_jobs` — per-job timeline union across replicas:
+    milestones dedup earliest-wall-first (an idempotent milestone
+    re-recorded by a second owner loses to the original), segments and
+    sync records concatenate in wall order with their recording replica
+    attached;
+  * :func:`phase_stats` — per-phase p50/p99 over the MERGED timelines
+    (milestone deltas in wall order, closed segments by span);
+  * :func:`handoff_gaps` — the ownerless window: consecutive sync
+    records for one job coming from DIFFERENT replicas bound the wall
+    time nobody reconciled the key — the fleet-level number the
+    ``--multicore`` SIGKILL and live-reshard rounds commit;
+  * :func:`parse_histograms` / :func:`merge_cost_profile` — the
+    text-0.0.4 histogram scrape and its cross-replica sum, serialized
+    as the sim-consumable reconcile-cost artifact
+    (``sim/costmodel.py`` loads it back).
+
+Everything here is read-only and stdlib-only, so the bench harness, a
+debug notebook, and the operator CLI can all drive it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import urllib.request
+from typing import Dict, List, Optional
+
+#: Histogram families the committed reconcile-cost profile carries —
+#: the sim v2 cost-model inputs (ROADMAP direction 3): per-reconcile
+#: duration by result, and per-verb apiserver latency by resource.
+COST_FAMILIES = (
+    "pytorch_operator_reconcile_duration_seconds",
+    "pytorch_operator_rest_request_duration_seconds",
+)
+
+COST_PROFILE_VERSION = 1
+
+
+# -- scraping ---------------------------------------------------------------
+
+def _get_text(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def scrape_replica(base_url: str, timeout: float = 5.0) -> dict:
+    """One replica's observability surfaces.  Returns
+    ``{"url", "metrics_text", "jobs", "traces"}``; a dead or partial
+    replica yields an ``"error"`` field instead of raising — the fleet
+    view must survive exactly the failure modes it exists to measure."""
+    base = base_url.rstrip("/")
+    out: dict = {"url": base}
+    try:
+        out["metrics_text"] = _get_text(base + "/metrics", timeout)
+        out["jobs"] = json.loads(_get_text(base + "/debug/jobs", timeout))
+        out["traces"] = json.loads(
+            _get_text(base + "/debug/traces", timeout))
+    except Exception as e:  # noqa: BLE001 — any scrape failure is data
+        out["error"] = repr(e)
+    return out
+
+
+# -- prometheus text parsing ------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(raw: Optional[str]) -> Dict[str, str]:
+    if not raw:
+        return {}
+    return {k: v.replace(r"\"", '"').replace(r"\\", "\\")
+            for k, v in _LABEL_RE.findall(raw)}
+
+
+def parse_histograms(text: str, families=COST_FAMILIES) -> dict:
+    """Extract histogram families from a text-0.0.4 exposition.
+
+    Returns ``{family: {labels_key: {"labels", "buckets", "sum",
+    "count"}}}`` where ``labels_key`` is the sorted JSON of the non-le
+    labels and ``buckets`` is ``[[le, cumulative_count], ...]`` with
+    ``le`` the string from the wire ("+Inf" included), in wire order."""
+    wanted = set(families)
+    out: dict = {f: {} for f in wanted}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        for family in wanted:
+            if not name.startswith(family):
+                continue
+            suffix = name[len(family):]
+            if suffix not in ("_bucket", "_sum", "_count"):
+                continue
+            labels = _parse_labels(raw_labels)
+            le = labels.pop("le", None)
+            key = json.dumps(labels, sort_keys=True)
+            series = out[family].setdefault(
+                key, {"labels": labels, "buckets": [],
+                      "sum": 0.0, "count": 0.0})
+            try:
+                value = float(raw_value)
+            except ValueError:
+                continue
+            if suffix == "_bucket" and le is not None:
+                series["buckets"].append([le, value])
+            elif suffix == "_sum":
+                series["sum"] = value
+            elif suffix == "_count":
+                series["count"] = value
+    return out
+
+
+def merge_cost_profile(metrics_texts: List[str],
+                       families=COST_FAMILIES) -> dict:
+    """Sum each family's per-labelset histograms across replicas into
+    the committed reconcile-cost artifact (text buckets are cumulative;
+    cumulative counts of identical bucket layouts sum bucket-wise)."""
+    merged: dict = {f: {} for f in families}
+    for text in metrics_texts:
+        for family, series_map in parse_histograms(text, families).items():
+            for key, series in series_map.items():
+                if not series["buckets"]:
+                    continue
+                tgt = merged[family].get(key)
+                if tgt is None:
+                    merged[family][key] = {
+                        "labels": dict(series["labels"]),
+                        "buckets": [list(b) for b in series["buckets"]],
+                        "sum": series["sum"],
+                        "count": series["count"]}
+                    continue
+                tgt["sum"] += series["sum"]
+                tgt["count"] += series["count"]
+                if len(tgt["buckets"]) == len(series["buckets"]):
+                    for slot, (_, value) in zip(tgt["buckets"],
+                                                series["buckets"]):
+                        slot[1] += value
+    return {
+        "version": COST_PROFILE_VERSION,
+        "families": {
+            family: {"series": [series_map[k]
+                                for k in sorted(series_map)]}
+            for family, series_map in merged.items()
+        },
+    }
+
+
+# -- timeline merge ---------------------------------------------------------
+
+def merge_jobs(replica_payloads: List[dict]) -> dict:
+    """Union the per-replica ``/debug/jobs`` payloads into one
+    fleet-wide timeline per job.
+
+    ``replica_payloads`` are ``scrape_replica`` results (entries with
+    ``"error"`` are skipped).  Milestones dedup by name with the
+    EARLIEST wall timestamp winning — an idempotent milestone recorded
+    again by a later owner is the duplicate, the first observation is
+    the fact.  Segments and sync records concatenate in wall order,
+    each carrying the replica that recorded it."""
+    jobs: dict = {}
+    for payload in replica_payloads:
+        if "error" in payload:
+            continue
+        snap = payload.get("jobs") or {}
+        replica = snap.get("replica", "")
+        for rec in snap.get("jobs") or []:
+            key = rec.get("job", "")
+            merged = jobs.setdefault(
+                key, {"job": key, "milestones": {}, "segments": [],
+                      "syncs": [], "replicas": set()})
+            merged["replicas"].add(replica)
+            for entry in rec.get("milestones") or []:
+                name = entry.get("milestone", "")
+                cur = merged["milestones"].get(name)
+                if cur is None or entry.get("wall", 0.0) < cur.get(
+                        "wall", 0.0):
+                    merged["milestones"][name] = dict(entry)
+            for seg in rec.get("segments") or []:
+                merged["segments"].append(dict(seg))
+            for sync in rec.get("syncs") or []:
+                merged["syncs"].append(dict(sync))
+    for merged in jobs.values():
+        merged["milestones"] = sorted(
+            merged["milestones"].values(),
+            key=lambda e: e.get("wall", 0.0))
+        merged["segments"].sort(key=lambda s: s.get("start_wall", 0.0))
+        merged["syncs"].sort(key=lambda s: s.get("wall", 0.0))
+        merged["replicas"] = sorted(merged["replicas"])
+    return jobs
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile, ceil(q*n)-1 — the bench convention
+    (int(n*q) selects the maximum for small n, overstating the tail)."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx]
+
+
+def phase_stats(merged_jobs: dict) -> dict:
+    """Per-phase duration percentiles over the merged fleet timelines:
+    a milestone's phase duration is its wall delta from the previous
+    milestone in the merged order; a CLOSED segment contributes its
+    start->end span under its own name."""
+    durations: Dict[str, List[float]] = {}
+    for rec in merged_jobs.values():
+        prev_wall = None
+        for entry in rec["milestones"]:
+            wall = entry.get("wall")
+            if wall is None:
+                continue
+            if prev_wall is not None:
+                durations.setdefault(entry["milestone"], []).append(
+                    max(0.0, wall - prev_wall))
+            prev_wall = wall
+        for seg in rec["segments"]:
+            if "end_wall" in seg:
+                durations.setdefault(seg["segment"], []).append(
+                    max(0.0, seg["end_wall"] - seg["start_wall"]))
+    return {
+        phase: {
+            "n": len(vals),
+            "p50_ms": round(percentile(vals, 0.50) * 1e3, 2),
+            "p99_ms": round(percentile(vals, 0.99) * 1e3, 2),
+        }
+        for phase, vals in sorted(durations.items())
+    }
+
+
+def handoff_gaps(merged_jobs: dict, min_gap_s: float = 0.0) -> List[dict]:
+    """The ownerless windows: for each job, every pair of consecutive
+    sync records that came from DIFFERENT replicas bounds a wall-time
+    span in which the job's key had no reconciling owner (the previous
+    owner's last touch to the new owner's first).  Returns one entry
+    per handoff, widest first."""
+    gaps: List[dict] = []
+    for key, rec in merged_jobs.items():
+        syncs = rec["syncs"]
+        for prev, cur in zip(syncs, syncs[1:]):
+            if prev.get("replica") == cur.get("replica"):
+                continue
+            gap = cur.get("wall", 0.0) - prev.get("wall", 0.0)
+            if gap < min_gap_s:
+                continue
+            gaps.append({
+                "job": key,
+                "gap_s": round(gap, 6),
+                "from_replica": prev.get("replica", ""),
+                "to_replica": cur.get("replica", ""),
+                "from_epoch": prev.get("ring_epoch", 0),
+                "to_epoch": cur.get("ring_epoch", 0),
+            })
+    gaps.sort(key=lambda g: -g["gap_s"])
+    return gaps
+
+
+def fleet_view(replica_payloads: List[dict]) -> dict:
+    """The whole pipeline: merge scraped payloads, derive per-phase
+    percentiles and handoff gaps, and carry per-replica trace-drop
+    accounting.  JSON-ready."""
+    merged = merge_jobs(replica_payloads)
+    replicas = []
+    for payload in replica_payloads:
+        entry = {"url": payload.get("url", "")}
+        if "error" in payload:
+            entry["error"] = payload["error"]
+        else:
+            snap = payload.get("jobs") or {}
+            entry["replica"] = snap.get("replica", "")
+            entry["tracked_jobs"] = snap.get("tracked", 0)
+            entry["timeline_evicted"] = snap.get("evicted", 0)
+            entry["traces_dropped"] = (payload.get("traces")
+                                       or {}).get("dropped", 0)
+        replicas.append(entry)
+    gaps = handoff_gaps(merged)
+    stitched = sum(1 for rec in merged.values()
+                   if len(rec["replicas"]) > 1)
+    return {
+        "replicas": replicas,
+        "jobs": {key: {**rec} for key, rec in merged.items()},
+        "phases": phase_stats(merged),
+        "handoffs": gaps,
+        "stitched_jobs": stitched,
+        "max_handoff_gap_s": gaps[0]["gap_s"] if gaps else None,
+    }

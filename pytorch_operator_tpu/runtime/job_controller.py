@@ -68,6 +68,8 @@ class JobControllerConfig:
         shard_renew_interval: float = 5.0,
         create_fanout_width: Optional[int] = None,
         clock: Optional[Callable[[], float]] = None,
+        push_token_secret: str = "",
+        job_timeline_max_jobs: int = 2048,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -117,6 +119,15 @@ class JobControllerConfig:
         # one deterministic virtual timeline through this.  None (the
         # default) is wall time everywhere, byte-identical to before.
         self.clock = clock
+        # Push-identity secret (--push-token-secret): folded into every
+        # per-job push token derived at pod build time and at the
+        # gateway's ingestion check.  Empty (the default) still binds
+        # tokens to the job incarnation's uid.
+        self.push_token_secret = push_token_secret
+        # Lifecycle-timeline store bound (--job-timeline-max-jobs):
+        # per-job milestone/segment records kept for /debug/jobs before
+        # LRU eviction.
+        self.job_timeline_max_jobs = max(1, int(job_timeline_max_jobs))
 
 
 def _make_runtime_core(clock=None):

@@ -1,0 +1,295 @@
+"""Per-job lifecycle timelines: milestones, annotated segments, and
+phase-duration histograms.
+
+The reference operator's whole value is the job state machine (Created
+-> Running -> Succeeded/Failed/Restarting), yet nothing in it can say
+how long a job spent between any two states.  This module is the
+recording side of the fleet observability plane:
+
+  * the controller calls :meth:`JobLifecycleTracker.record` at each
+    lifecycle milestone (submitted, shard-stamped, first reconcile,
+    first pod created, all pods bound, all running, succeeded/failed);
+    recording is idempotent per (job uid, milestone), so the many
+    reconcile passes that re-observe the same state cost one dict
+    lookup and record nothing;
+  * disruption windows (restart, resize, reshard) are annotated
+    *segments* — opened when the controller enters the window, closed
+    when the gang is whole again — so a timeline shows not just "when
+    did it run" but "when was it degraded, and why";
+  * every milestone delta and closed segment is observed into the
+    ``pytorch_operator_job_phase_duration_seconds{phase=...}``
+    histogram (the milestone/segment name is the phase label), giving
+    fleet-level p50/p99 per transition;
+  * :meth:`note_sync` keeps a bounded per-job log of reconcile passes
+    (wall time, trace id, owning replica, ring epoch) — the raw
+    material the fleet collector (runtime/fleetview.py) uses to stitch
+    one job's timeline across a replica handoff and measure the gap;
+  * :meth:`snapshot` serves the whole store as JSON-ready dicts for the
+    metrics server's ``/debug/jobs`` endpoint, trace ids included so a
+    timeline entry cross-links into ``/debug/traces``.
+
+Timestamps go through the injected ``clock``/``wall`` pair exactly like
+:mod:`runtime.tracing`: both default to the real clocks and accept a
+VirtualClock's ``now``, so timelines captured under the simulator are
+deterministic (milestone deltas are a pure function of the seed).
+
+The store is bounded (``max_jobs`` records, ``syncs_per_job`` sync
+entries per record); evictions are counted, never silent.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..analysis.witness import make_lock
+
+#: Canonical milestone order for a clean run; ``failed`` replaces
+#: ``succeeded`` on the unhappy path.  The tracker does not enforce the
+#: order (hooks are idempotent and may fire from several call sites) —
+#: tests assert it on the recorded output instead.
+MILESTONES = (
+    "submitted",
+    "shard_stamped",
+    "first_reconcile",
+    "first_pod_created",
+    "all_pods_bound",
+    "all_running",
+    "succeeded",
+    "failed",
+)
+
+#: Segment names double as ``phase`` label values; they share the
+#: histogram with milestones, so they must never collide with
+#: MILESTONES entries.
+SEGMENTS = ("restart", "resize", "reshard")
+
+#: Phase durations span sub-ms simulated transitions up to multi-minute
+#: scheduling waits on a real cluster.
+PHASE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+DEFAULT_MAX_JOBS = 2048
+DEFAULT_SYNCS_PER_JOB = 64
+
+
+class _JobRecord:
+    __slots__ = ("key", "uid", "milestones", "segments", "syncs",
+                 "last_mono")
+
+    def __init__(self, key: str, uid: str, syncs_per_job: int):
+        self.key = key
+        self.uid = uid
+        # milestone name -> entry dict; insertion order IS timeline order
+        self.milestones: "OrderedDict[str, dict]" = OrderedDict()
+        self.segments: List[dict] = []
+        self.syncs: deque = deque(maxlen=max(1, int(syncs_per_job)))
+        # mono timestamp of the latest milestone: the phase-duration base
+        self.last_mono: Optional[float] = None
+
+    def open_segment(self, name: str) -> Optional[dict]:
+        for seg in reversed(self.segments):
+            if seg["segment"] == name and "end_wall" not in seg:
+                return seg
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "job": self.key,
+            "uid": self.uid,
+            "milestones": [dict(e) for e in self.milestones.values()],
+            "segments": [dict(s) for s in self.segments],
+            "syncs": [dict(s) for s in self.syncs],
+        }
+
+
+class JobLifecycleTracker:
+    """Bounded per-job milestone/segment store + phase histograms.
+
+    ``registry`` None (tests, ad-hoc tooling) records timelines without
+    exporting histograms.  ``replica_id`` stamps every snapshot and
+    sync entry so the fleet collector can attribute merged timelines.
+    """
+
+    def __init__(self, registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Optional[Callable[[], float]] = None,
+                 max_jobs: int = DEFAULT_MAX_JOBS,
+                 syncs_per_job: int = DEFAULT_SYNCS_PER_JOB,
+                 replica_id: str = ""):
+        self._clock = clock
+        self._wall = wall if wall is not None \
+            else (time.time if clock is time.monotonic else clock)
+        self.max_jobs = max(1, int(max_jobs))
+        self.syncs_per_job = max(1, int(syncs_per_job))
+        self.replica_id = replica_id
+        self.evicted = 0
+        self._jobs: "OrderedDict[str, _JobRecord]" = OrderedDict()
+        self._lock = make_lock("runtime.lifecycle")
+        self.phase_hist = None
+        if registry is not None:
+            self.phase_hist = registry.histogram_vec(
+                "pytorch_operator_job_phase_duration_seconds",
+                "Wall time a job spent in each lifecycle phase: for a "
+                "milestone label the delta from the previous milestone, "
+                "for a segment label (restart/resize/reshard) the "
+                "open->close span of the disruption window",
+                ("phase",), buckets=PHASE_BUCKETS)
+
+    # -- store bookkeeping -------------------------------------------------
+
+    def _get(self, key: str, uid: str) -> _JobRecord:
+        """Fetch-or-create under self._lock; a uid mismatch means the
+        job was deleted and recreated under the same name — the old
+        timeline is evicted so the new incarnation starts clean."""
+        rec = self._jobs.get(key)
+        if rec is not None:
+            if uid and rec.uid and rec.uid != uid:
+                del self._jobs[key]
+                self.evicted += 1
+                rec = None
+            elif uid and not rec.uid:
+                rec.uid = uid
+        if rec is None:
+            rec = _JobRecord(key, uid, self.syncs_per_job)
+            self._jobs[key] = rec
+            while len(self._jobs) > self.max_jobs:
+                self._jobs.popitem(last=False)
+                self.evicted += 1
+        else:
+            self._jobs.move_to_end(key)
+        return rec
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, key: str, milestone: str, uid: str = "",
+               trace_id: Optional[str] = None,
+               attrs: Optional[Dict[str, Any]] = None) -> bool:
+        """Record ``milestone`` for job ``key`` once; repeat calls are
+        no-ops (False).  Observes the delta from the previous milestone
+        into the phase histogram under ``phase=milestone``."""
+        now_m = self._clock()
+        now_w = self._wall()
+        delta = None
+        with self._lock:
+            rec = self._get(key, uid)
+            if milestone in rec.milestones:
+                return False
+            entry: dict = {"milestone": milestone,
+                           "wall": now_w, "mono": now_m,
+                           "replica": self.replica_id}
+            if trace_id:
+                entry["trace_id"] = trace_id
+            if attrs:
+                entry["attrs"] = dict(attrs)
+            rec.milestones[milestone] = entry
+            if rec.last_mono is not None:
+                delta = max(0.0, now_m - rec.last_mono)
+            rec.last_mono = now_m
+        if delta is not None and self.phase_hist is not None:
+            self.phase_hist.labels(phase=milestone).observe(
+                delta, exemplar={"trace_id": trace_id} if trace_id else None)
+        return True
+
+    def begin_segment(self, key: str, name: str, uid: str = "",
+                      attrs: Optional[Dict[str, Any]] = None) -> bool:
+        """Open a ``name`` segment on the job's timeline; idempotent
+        while a segment of that name is already open."""
+        now_m = self._clock()
+        now_w = self._wall()
+        with self._lock:
+            rec = self._get(key, uid)
+            if rec.open_segment(name) is not None:
+                return False
+            seg: dict = {"segment": name,
+                         "start_wall": now_w, "start_mono": now_m,
+                         "replica": self.replica_id}
+            if attrs:
+                seg["attrs"] = dict(attrs)
+            rec.segments.append(seg)
+        return True
+
+    def end_segment(self, key: str, name: str) -> bool:
+        """Close the open ``name`` segment (if any) and observe its
+        duration under ``phase=name``."""
+        now_m = self._clock()
+        now_w = self._wall()
+        duration = None
+        with self._lock:
+            rec = self._jobs.get(key)
+            if rec is None:
+                return False
+            seg = rec.open_segment(name)
+            if seg is None:
+                return False
+            seg["end_wall"] = now_w
+            seg["end_mono"] = now_m
+            duration = max(0.0, now_m - seg["start_mono"])
+        if duration is not None and self.phase_hist is not None:
+            self.phase_hist.labels(phase=name).observe(duration)
+        return True
+
+    def pods_observed(self, key: str, created: int, bound: int,
+                      running: int, total: int, uid: str = "",
+                      trace_id: Optional[str] = None) -> None:
+        """One reconcile pass's pod-state summary: derives the pod
+        milestones and closes restart/resize segments once the gang is
+        whole again."""
+        if total <= 0:
+            return
+        if created > 0:
+            self.record(key, "first_pod_created", uid=uid,
+                        trace_id=trace_id,
+                        attrs={"created": created, "total": total})
+        if bound >= total:
+            self.record(key, "all_pods_bound", uid=uid, trace_id=trace_id,
+                        attrs={"total": total})
+        if running >= total:
+            self.record(key, "all_running", uid=uid, trace_id=trace_id,
+                        attrs={"total": total})
+            self.end_segment(key, "restart")
+            self.end_segment(key, "resize")
+
+    def note_sync(self, key: str, trace_id: Optional[str] = None,
+                  result: str = "ok", ring_epoch: int = 0) -> None:
+        """Append one reconcile pass to the job's bounded sync log —
+        the fleet collector reads these to find ownership handoffs."""
+        now_m = self._clock()
+        now_w = self._wall()
+        with self._lock:
+            rec = self._get(key, "")
+            entry: dict = {"wall": now_w, "mono": now_m,
+                           "replica": self.replica_id,
+                           "result": result, "ring_epoch": int(ring_epoch)}
+            if trace_id:
+                entry["trace_id"] = trace_id
+            rec.syncs.append(entry)
+
+    def forget(self, key: str) -> bool:
+        """Drop a job's timeline (counted as an eviction)."""
+        with self._lock:
+            if key in self._jobs:
+                del self._jobs[key]
+                self.evicted += 1
+                return True
+        return False
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self, limit: Optional[int] = None,
+                 job: Optional[str] = None) -> dict:
+        """JSON-ready view for ``/debug/jobs``: newest-touched first,
+        ``limit`` truncates, ``job`` selects one key."""
+        with self._lock:
+            if job is not None:
+                recs = [self._jobs[job]] if job in self._jobs else []
+            else:
+                recs = list(self._jobs.values())
+                recs.reverse()
+                if limit is not None and limit >= 0:
+                    recs = recs[:limit]
+            payload = [rec.to_dict() for rec in recs]
+            tracked = len(self._jobs)
+        return {"replica": self.replica_id, "tracked": tracked,
+                "evicted": self.evicted, "jobs": payload}

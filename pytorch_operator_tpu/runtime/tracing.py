@@ -164,6 +164,13 @@ class Tracer:
             else (time.time if clock is time.monotonic else clock)
         self.slow_threshold = slow_threshold
         self.logger = logger or logging.getLogger("pytorch-operator.trace")
+        #: completed roots the ring evicted (or never kept, buffer 0) —
+        #: the loss accounting behind pytorch_operator_traces_dropped_total
+        self.dropped = 0
+        #: assignable Counter; the owning controller wires the registry's
+        #: pytorch_operator_traces_dropped_total here so eviction is
+        #: visible on /metrics, not only on /debug/traces
+        self.dropped_counter = None
 
     @contextmanager
     def trace(self, name: str, **attrs):
@@ -200,8 +207,19 @@ class Tracer:
         return None
 
     def _finish_root(self, root: Span) -> None:
+        dropped = False
         with self._lock:
+            maxlen = self._buf.maxlen
+            if maxlen == 0 or (maxlen is not None
+                               and len(self._buf) >= maxlen):
+                # appending will evict the oldest root (or, with a
+                # zero-size ring, drop this one): count it — silent
+                # trace loss under load was the observability hole
+                dropped = True
+                self.dropped += 1
             self._buf.append(root)
+        if dropped and self.dropped_counter is not None:
+            self.dropped_counter.inc()
         threshold = self.slow_threshold
         if (threshold is not None and threshold > 0
                 and root.duration is not None

@@ -1,0 +1,150 @@
+"""Sim-consumable reconcile-cost model: load the committed cost-profile
+artifact and draw latencies from it.
+
+ROADMAP direction 3 wants the simulator's cost model "sampled from the
+real per-reconcile histograms" instead of hand-tuned constants.  The
+real histograms are exactly what the fleet collector
+(runtime/fleetview.py) serializes into the committed JSON artifact
+(BENCH_RECONCILE_COST.json, written by the ``--fleetview`` bench tier);
+this module is the consuming side:
+
+  * :func:`load_cost_profile` — parse + validate the artifact (schema
+    version, family layout, cumulative-bucket sanity) into a
+    :class:`CostModel`;
+  * :meth:`CostModel.sample` — one latency draw via inverse-CDF over
+    the histogram buckets (uniform within the landed bucket), driven
+    by a CALLER-SEEDED ``random.Random`` so sim runs stay
+    deterministic;
+  * :meth:`CostModel.mean` — the closed-form expectation (sum/count),
+    for calibration printouts and tests.
+
+The artifact's buckets are Prometheus-cumulative with string ``le``
+bounds ("+Inf" included), exactly as scraped — this loader, not the
+exporter, owns the conversion to per-bucket mass.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+EXPECTED_VERSION = 1
+
+
+class CostModel:
+    """Histogram-backed latency distributions, keyed by
+    (family, labelset)."""
+
+    def __init__(self, profile: dict):
+        self.version = profile.get("version")
+        self._series: Dict[str, List[dict]] = {}
+        for family, body in (profile.get("families") or {}).items():
+            self._series[family] = list(body.get("series") or [])
+
+    @property
+    def families(self) -> List[str]:
+        return sorted(self._series)
+
+    def series(self, family: str, **labels) -> Optional[dict]:
+        """The series whose labels are a superset match of ``labels``
+        (empty ``labels`` returns the first series of the family)."""
+        for series in self._series.get(family, ()):
+            if all(series.get("labels", {}).get(k) == v
+                   for k, v in labels.items()):
+                return series
+        return None
+
+    def mean(self, family: str, **labels) -> Optional[float]:
+        series = self.series(family, **labels)
+        if series is None or not series.get("count"):
+            return None
+        return series["sum"] / series["count"]
+
+    def sample(self, family: str, rng, **labels) -> Optional[float]:
+        """One inverse-CDF draw from the family's histogram: pick the
+        bucket a uniform quantile lands in, then interpolate uniformly
+        within its bounds.  The +Inf bucket falls back to the series
+        mean clamped at the last finite bound (a tail draw must not
+        invent an unbounded latency).  ``rng`` is the caller's seeded
+        ``random.Random`` — determinism stays with the caller."""
+        series = self.series(family, **labels)
+        if series is None:
+            return None
+        masses = _bucket_masses(series)
+        total = sum(m for _, _, m in masses)
+        if total <= 0:
+            return None
+        target = rng.random() * total
+        acc = 0.0
+        last_finite = 0.0
+        for lo, hi, mass in masses:
+            if hi is not None:
+                last_finite = hi
+            acc += mass
+            if target <= acc and mass > 0:
+                if hi is None:  # +Inf bucket
+                    mean = self.mean(family, **labels) or last_finite
+                    return max(last_finite, mean)
+                return lo + rng.random() * (hi - lo)
+        return last_finite
+
+    def to_dict(self) -> dict:
+        return {"version": self.version,
+                "families": {f: {"series": s}
+                             for f, s in self._series.items()}}
+
+
+def _bucket_masses(series: dict):
+    """Cumulative wire buckets -> [(lo, hi_or_None, mass)]; hi None is
+    the +Inf bucket."""
+    out = []
+    prev_cum = 0.0
+    prev_bound = 0.0
+    for le, cum in series.get("buckets") or []:
+        bound = None if le in ("+Inf", "inf", "Inf") else float(le)
+        mass = max(0.0, float(cum) - prev_cum)
+        out.append((prev_bound, bound, mass))
+        prev_cum = float(cum)
+        if bound is not None:
+            prev_bound = bound
+    return out
+
+
+def load_cost_profile(path: str) -> CostModel:
+    """Read + validate the committed artifact.  Raises ValueError on a
+    schema the sim can't safely consume (wrong version, non-cumulative
+    buckets, malformed series) — a silently-misread cost model would
+    skew every sim result downstream."""
+    with open(path) as f:
+        profile = json.load(f)
+    if not isinstance(profile, dict):
+        raise ValueError("cost profile must be a JSON object")
+    if profile.get("version") != EXPECTED_VERSION:
+        raise ValueError(
+            f"cost profile version {profile.get('version')!r} != "
+            f"expected {EXPECTED_VERSION}")
+    families = profile.get("families")
+    if not isinstance(families, dict) or not families:
+        raise ValueError("cost profile needs a non-empty 'families' map")
+    for family, body in families.items():
+        series_list = (body or {}).get("series")
+        if not isinstance(series_list, list):
+            raise ValueError(f"family {family!r} needs a 'series' list")
+        for series in series_list:
+            if not isinstance(series.get("labels"), dict):
+                raise ValueError(f"series in {family!r} needs 'labels'")
+            buckets = series.get("buckets")
+            if not isinstance(buckets, list) or not buckets:
+                raise ValueError(f"series in {family!r} needs buckets")
+            prev = 0.0
+            for item in buckets:
+                if (not isinstance(item, (list, tuple))
+                        or len(item) != 2):
+                    raise ValueError(
+                        f"bucket in {family!r} must be [le, count]")
+                cum = float(item[1])
+                if cum < prev:
+                    raise ValueError(
+                        f"buckets in {family!r} must be cumulative")
+                prev = cum
+    return CostModel(profile)

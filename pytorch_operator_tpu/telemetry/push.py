@@ -29,6 +29,8 @@ Wire format (one POST, any number of samples)::
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import urllib.request
 from typing import Dict, List, Optional
@@ -80,6 +82,26 @@ _STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                  5.0, 10.0, 30.0, 60.0, 120.0)
 
 
+def derive_push_token(job: str, uid: str, secret: str = "") -> str:
+    """Per-job push-identity token: a keyed blake2b of the job's
+    ``namespace/name`` + uid.
+
+    The operator derives it twice from the same inputs — once at pod
+    build time (injected as ``PYTORCH_OPERATOR_PUSH_TOKEN`` env) and
+    once at ingestion (the gateway's token resolver reads the live job
+    from the informer store) — so no token state is ever persisted.
+    ``secret`` (``--push-token-secret``) folds operator-private entropy
+    in; with the default empty secret the token still binds a payload
+    to the job *incarnation* (uid), which is what closes the
+    spoofed-``job``-field hole in a single-tenant deployment."""
+    h = hashlib.blake2b(digest_size=16,
+                        key=secret.encode()[:64] if secret else b"")
+    h.update(job.encode())
+    h.update(b"\x00")
+    h.update(uid.encode())
+    return h.hexdigest()
+
+
 class PushGateway:
     """Validates pushed samples and applies them to budget-guarded
     ``job``-labeled families on ``registry``.
@@ -89,21 +111,30 @@ class PushGateway:
     a live PyTorchJob — the operator passes the job informer store's
     ``namespace/name`` containment check — is rejected wholesale and
     counted under ``reason="unknown_job"``, so a stray or hostile pod
-    cannot mint series for jobs that don't exist."""
+    cannot mint series for jobs that don't exist.
+
+    ``token_resolver`` closes the remaining half of that hole (a pod
+    claiming a job that DOES exist, just not its own): a callable
+    mapping a job key to the expected per-job token
+    (:func:`derive_push_token` of the live job's uid) or None when the
+    job is unknown.  When set, a payload whose ``token`` field doesn't
+    match is rejected wholesale under ``reason="bad_token"``."""
 
     def __init__(self, registry: Registry,
                  series_budget: int = DEFAULT_SERIES_BUDGET,
-                 job_validator=None):
+                 job_validator=None, token_resolver=None):
         self.registry = registry
         self.series_budget = series_budget
         self.job_validator = job_validator
+        self.token_resolver = token_resolver
         dropped = registry.dropped_series_counter()
         self.rejected = registry.counter_vec(
             "pytorch_operator_push_rejected_total",
             "Pushed samples refused at ingestion, by reason: "
-            "unknown_job (no live PyTorchJob matches), unknown_family, "
-            "op_mismatch, bad_value (non-numeric / negative counter / "
-            "malformed sample)",
+            "unknown_job (no live PyTorchJob matches), bad_token "
+            "(payload token does not match the claimed job's derived "
+            "push token), unknown_family, op_mismatch, bad_value "
+            "(non-numeric / negative counter / malformed sample)",
             ("reason",))
         self.accepted = registry.counter(
             "pytorch_operator_push_samples_total",
@@ -138,10 +169,14 @@ class PushGateway:
         rejected: Dict[str, int] = {}
         with self._lock:
             dropped_before = self._dropped.value
-            # identity check once per payload, BEFORE any sample can
-            # mint a series: an unknown job rejects the whole batch
+            # identity checks once per payload, BEFORE any sample can
+            # mint a series: an unknown job or a token that doesn't
+            # prove the claimed identity rejects the whole batch
             if self.job_validator is not None and not self.job_validator(job):
                 rejected["unknown_job"] = len(samples)
+            elif self.token_resolver is not None and not self._token_ok(
+                    job, payload.get("token")):
+                rejected["bad_token"] = len(samples)
             else:
                 for sample in samples:
                     reason = self._apply(job, sample)
@@ -156,6 +191,15 @@ class PushGateway:
             self.rejected.labels(reason=reason).inc(count)
         return {"accepted": accepted, "rejected": sum(rejected.values()),
                 "dropped": int(dropped)}
+
+    def _token_ok(self, job: str, token) -> bool:
+        expected = self.token_resolver(job)
+        if expected is None:
+            # resolver can't vouch for this job (e.g. informer lag):
+            # fail closed — the identity check exists to stop spoofing
+            return False
+        return isinstance(token, str) and \
+            hmac.compare_digest(token, expected)
 
     def _apply(self, job: str, sample):
         """Apply one sample; returns None on success, else the
@@ -222,15 +266,20 @@ class PushClient:
     network failures increment ``errors`` and are otherwise swallowed —
     a dead operator must not fail a training step."""
 
-    def __init__(self, base_url: str, job: str, timeout: float = 2.0):
+    def __init__(self, base_url: str, job: str, timeout: float = 2.0,
+                 token: Optional[str] = None):
         self.url = base_url.rstrip("/") + "/push/v1/metrics"
         self.job = job
         self.timeout = timeout
+        self.token = token
         self.errors = 0
         self.pushed = 0
 
     def push_samples(self, samples: List[dict]) -> Optional[dict]:
-        body = json.dumps({"job": self.job, "samples": samples}).encode()
+        payload = {"job": self.job, "samples": samples}
+        if self.token:
+            payload["token"] = self.token
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
             self.url, data=body, method="POST",
             headers={"Content-Type": "application/json"})
@@ -251,7 +300,8 @@ def push_job_steps(base_url: str, job: str,
                    step_times: List[float],
                    tokens_per_sec: Optional[float] = None,
                    mfu: Optional[float] = None,
-                   timeout: float = 2.0) -> Optional[dict]:
+                   timeout: float = 2.0,
+                   token: Optional[str] = None) -> Optional[dict]:
     """One-shot convenience used by the fake kubelet: push a batch of
     step durations (plus optional throughput gauges) for ``job``."""
     samples: List[Dict] = []
@@ -263,4 +313,5 @@ def push_job_steps(base_url: str, job: str,
                         "value": tokens_per_sec})
     if mfu is not None:
         samples.append({"name": MFU, "op": "set", "value": mfu})
-    return PushClient(base_url, job, timeout=timeout).push_samples(samples)
+    return PushClient(base_url, job, timeout=timeout,
+                      token=token).push_samples(samples)

@@ -1562,6 +1562,332 @@ def render_multicore_md(res: dict, jobs: int, workers: int,
     ])
 
 
+FLEETVIEW_BEGIN = "<!-- fleetview:begin -->"
+FLEETVIEW_END = "<!-- fleetview:end -->"
+HOTPATHS_BEGIN = "<!-- hotpaths:begin -->"
+HOTPATHS_END = "<!-- hotpaths:end -->"
+
+
+def run_fleetview_round(jobs: int, workers: int, shard_count: int,
+                        replicas: int, mode: str = "sigkill",
+                        timeout: float = 240.0,
+                        threadiness: int = 2) -> dict:
+    """One stitched-observability round over the ``--multicore``
+    subprocess harness: ``replicas`` operator processes, a fleet
+    collector (runtime/fleetview.py) scraping every replica's
+    /metrics + /debug/jobs + /debug/traces on a cadence, and ONE
+    ownership disruption mid-workload —
+
+      * ``mode="sigkill"``: SIGKILL replica 0 once a third of the jobs
+        succeeded; its unfinished jobs cannot reach Succeeded until a
+        survivor re-acquires the shard Leases after expiry, so the
+        merged timelines carry cross-replica sync records and the
+        handoff gap measures the ownerless window (bounded by the
+        Lease expiry clock);
+      * ``mode="reshard"``: a LIVE ``request_reshard`` to
+        ``2 x shard_count`` — every process survives, jobs re-hash and
+        migrate owners under the migration Lease, so the gap measures
+        the live-migration stall instead.
+
+    The collector keeps the LAST GOOD payload per replica (scraped
+    right before the kill too), exactly what lets a dead process still
+    contribute its half of a stitched timeline."""
+    from pytorch_operator_tpu.api.v1 import constants as _constants
+    from pytorch_operator_tpu.runtime import fleetview
+    from pytorch_operator_tpu.runtime.sharding import request_reshard
+
+    srv = StubApiServer().start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    fleet = [_spawn_replica(url, f"fv-r{r}", shard_count, threadiness)
+             for r in range(replicas)]
+    out: dict = {"variant": f"fleetview_{mode}", "jobs": jobs,
+                 "workers": workers, "shard_count": shard_count,
+                 "replicas": replicas, "threadiness": threadiness}
+    last_payload: dict = {}
+
+    def scrape_all() -> None:
+        for f in fleet:
+            if not f["alive"] or f["proc"].poll() is not None:
+                continue
+            payload = fleetview.scrape_replica(
+                f"http://127.0.0.1:{f['port']}")
+            if "error" not in payload:
+                last_payload[f["id"]] = payload
+
+    def succeeded() -> int:
+        n = 0
+        for j in range(jobs):
+            try:
+                job = srv.cluster.jobs.get("default", f"fv-job-{j}")
+            except NotFoundError:
+                continue
+            if _condition_true(job, "Succeeded"):
+                n += 1
+        return n
+
+    def total_owned() -> int:
+        return sum(len(v)
+                   for v in _shard_lease_holders(srv.cluster).values())
+
+    try:
+        import signal as _signal
+
+        deadline = time.perf_counter() + 90.0
+        while total_owned() < shard_count:
+            if time.perf_counter() > deadline or any(
+                    f["proc"].poll() is not None for f in fleet):
+                out["converged"] = False
+                out["error"] = ("fleet never owned the ring: " + str(
+                    [list(f["log"])[-3:] for f in fleet]))
+                return out
+            time.sleep(0.05)
+
+        t0 = time.perf_counter()
+        for j in range(jobs):
+            srv.cluster.jobs.create("default",
+                                    new_job(f"fv-job-{j}", workers))
+        acted_at = None
+        next_scrape = 0.0
+        deadline = t0 + timeout
+        while succeeded() < jobs:
+            now = time.perf_counter()
+            if now >= next_scrape:
+                scrape_all()
+                next_scrape = now + 0.25
+            if acted_at is None and succeeded() >= jobs // 3:
+                scrape_all()  # the doomed replica's half of the story
+                if mode == "sigkill":
+                    fleet[0]["alive"] = False
+                    if fleet[0]["proc"].poll() is None:
+                        fleet[0]["proc"].send_signal(_signal.SIGKILL)
+                else:
+                    request_reshard(srv.cluster.resource("leases"),
+                                    2 * shard_count,
+                                    namespace="default")
+                acted_at = now - t0
+            if now > deadline:
+                out["converged"] = False
+                out["error"] = f"{succeeded()}/{jobs} Succeeded at timeout"
+                return out
+            time.sleep(0.02)
+        out["converged"] = True
+        out["convergence_wall_s"] = round(time.perf_counter() - t0, 3)
+        out["acted_at_s"] = round(acted_at, 3) if acted_at else None
+        if mode == "reshard":
+            # the sweep may still be flipping the epoch; give the ring
+            # a moment to settle before the final scrape
+            settle = time.perf_counter() + 3 * MULTICORE_LEASE_S
+            leases = srv.cluster.resource("leases")
+            while time.perf_counter() < settle:
+                ring = leases.get("default", _constants.RING_LEASE_NAME)
+                ann = ((ring.get("metadata") or {})
+                       .get("annotations") or {})
+                if (ann.get(_constants.ANNOTATION_RING_SHARD_COUNT)
+                        == str(2 * shard_count)):
+                    break
+                time.sleep(0.1)
+        time.sleep(2 * MULTICORE_RENEW_S)  # let final syncs land
+        scrape_all()
+
+        payloads = list(last_payload.values())
+        view = fleetview.fleet_view(payloads)
+        out["replicas_scraped"] = len(payloads)
+        out["stitched_jobs"] = view["stitched_jobs"]
+        out["max_handoff_gap_s"] = view["max_handoff_gap_s"]
+        out["handoffs"] = view["handoffs"][:5]
+        out["phases"] = view["phases"]
+        out["trace_drops"] = {
+            r.get("replica", r.get("url", "")): r.get("traces_dropped", 0)
+            for r in view["replicas"] if "error" not in r}
+        out["cost_profile"] = fleetview.merge_cost_profile(
+            [p["metrics_text"] for p in payloads])
+        return out
+    finally:
+        import signal as _signal
+
+        for f in fleet:
+            if f["alive"] and f["proc"].poll() is None:
+                f["proc"].send_signal(_signal.SIGTERM)
+        deadline = time.perf_counter() + 10.0
+        for f in fleet:
+            while (f["proc"].poll() is None
+                   and time.perf_counter() < deadline):
+                time.sleep(0.05)
+            if f["proc"].poll() is None:
+                f["proc"].kill()
+                f["proc"].wait(timeout=5.0)
+        kubelet.stop()
+        srv.stop()
+
+
+def run_fleetview(jobs: int, workers: int, replicas: int = 2,
+                  timeout: float = 240.0, threadiness: int = 2) -> dict:
+    """Both disruption rounds on identical geometry (shard_count =
+    replicas, one shard per process before the disruption)."""
+    shards = max(replicas, 2)
+    return {
+        "fleetview_sigkill": run_fleetview_round(
+            jobs, workers, shards, replicas, mode="sigkill",
+            timeout=timeout, threadiness=threadiness),
+        "fleetview_reshard": run_fleetview_round(
+            jobs, workers, shards, replicas, mode="reshard",
+            timeout=timeout, threadiness=threadiness),
+    }
+
+
+def _fleetview_reading(res: dict) -> str:
+    kill = res.get("fleetview_sigkill") or {}
+    resh = res.get("fleetview_reshard") or {}
+    if not (kill.get("converged") and resh.get("converged")):
+        return ("**Reading.** A fleetview round FAILED to converge — "
+                "the numbers below are partial; fix before trusting.")
+    kill_gap = kill.get("max_handoff_gap_s")
+    resh_gap = resh.get("max_handoff_gap_s")
+    return (
+        "**Reading.** The collector stitched per-job timelines across "
+        f"{kill.get('replicas')} operator PROCESSES: "
+        f"{kill.get('stitched_jobs')} jobs in the SIGKILL round and "
+        f"{resh.get('stitched_jobs')} in the live-reshard round carry "
+        "milestones/syncs from more than one replica — the merge is "
+        "doing real work, no single process ever saw those timelines "
+        "whole.  The **handoff gap** — wall time between a job's last "
+        "sync record on the old owner and its first on the new — is an "
+        "UPPER bound on the ownerless window (syncs are event-driven, "
+        "so the gap also counts however long the job sat quietly "
+        "before the disruption).  It peaks at "
+        f"**{kill_gap}s** under SIGKILL (the old owner's last touch, "
+        "plus the Lease expiry clock at "
+        f"{MULTICORE_LEASE_S:.0f}s, plus survivor requeue) vs "
+        f"**{resh_gap}s** for the LIVE reshard, where no process died "
+        "and the re-stamp patch itself wakes the new owner.  That "
+        "asymmetry is the tier's point: planned ownership moves cost "
+        "a migration sweep, unplanned ones additionally pay the "
+        "failure-detection TTL.")
+
+
+def render_fleetview_md(res: dict, jobs: int, workers: int,
+                        replicas: int) -> str:
+    stamp = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%d %H:%M UTC")
+
+    def phase_rows(r):
+        rows = []
+        for phase, st in (r.get("phases") or {}).items():
+            rows.append(f"| `{phase}` | {st['n']} | {st['p50_ms']} "
+                        f"| {st['p99_ms']} |")
+        return rows or ["| (none) | | | |"]
+
+    lines = [
+        FLEETVIEW_BEGIN,
+        f"## Fleet-wide job-lifecycle observability ({stamp})",
+        "",
+        f"`scripts/bench_control_plane.py --fleetview` — {jobs} jobs x "
+        f"(1 Master + {workers} Workers) over {replicas} operator "
+        "subprocesses; the collector (`runtime/fleetview.py`) scrapes "
+        "every replica's `/metrics`, `/debug/jobs` and `/debug/traces` "
+        "on a 250 ms cadence and merges them into one fleet view.  "
+        "Cross-replica histogram sums are committed as the sim cost "
+        "model input: `BENCH_RECONCILE_COST.json` "
+        "(`sim/costmodel.py` loads it).",
+        "",
+    ]
+    for key, title in (("fleetview_sigkill", "SIGKILL handover"),
+                       ("fleetview_reshard", "live reshard")):
+        r = res.get(key) or {}
+        lines += [
+            f"### Round: {title}",
+            "",
+            f"- converged: {r.get('converged')} in "
+            f"{r.get('convergence_wall_s')}s "
+            f"(disruption at {r.get('acted_at_s')}s)",
+            f"- stitched jobs (timeline spans >1 replica): "
+            f"{r.get('stitched_jobs')}",
+            f"- max handoff gap: **{r.get('max_handoff_gap_s')}s**",
+            f"- trace drops per replica: "
+            f"{json.dumps(r.get('trace_drops', {}))}",
+            "",
+            "| phase | n | p50 ms | p99 ms |",
+            "|---|---|---|---|",
+            *phase_rows(r),
+            "",
+        ]
+    lines += [_fleetview_reading(res), FLEETVIEW_END]
+    return "\n".join(lines)
+
+
+def run_profile_hotpaths(jobs: int, workers: int, nodes: int,
+                         seed: int = 7, arrival_s: float = 600.0,
+                         max_virtual: float = 7200.0,
+                         top: int = 15) -> dict:
+    """The ROADMAP direction-5 prerequisite: the cluster-scale sim
+    under cProfile, hot paths ranked by cumulative time.  Optimization
+    work starts from this committed table, not from guesses."""
+    import cProfile
+    import pstats
+
+    from pytorch_operator_tpu.sim import ScaleConfig
+    from pytorch_operator_tpu.sim.scale import run_scenario
+
+    cfg = ScaleConfig(jobs=jobs, workers=workers, nodes=nodes,
+                      seed=seed, arrival_seconds=arrival_s,
+                      max_virtual_seconds=max_virtual)
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    result = run_scenario(cfg)
+    prof.disable()
+    wall = time.perf_counter() - t0
+
+    def shorten(path: str) -> str:
+        for marker in ("pytorch_operator_tpu/", "lib/python"):
+            idx = path.find(marker)
+            if idx >= 0:
+                return path[idx:]
+        return path
+
+    rows = []
+    for (path, lineno, func), (cc, nc, tt, ct, _callers) in (
+            pstats.Stats(prof).stats.items()):
+        if func.startswith("<") and path == "~":
+            continue  # builtins aggregate — noise at the top
+        rows.append({"cum_s": round(ct, 3), "tot_s": round(tt, 3),
+                     "calls": nc,
+                     "function": f"{shorten(path)}:{lineno}:{func}"})
+    rows.sort(key=lambda r: -r["cum_s"])
+    return {"variant": "profile_hotpaths", "jobs": jobs,
+            "workers": workers, "nodes": nodes, "seed": seed,
+            "wall_s": round(wall, 2),
+            "converged": result.get("converged"),
+            "virtual_s": result.get("virtual_wall_s"),
+            "rows": rows[:top]}
+
+
+def render_hotpaths_md(res: dict) -> str:
+    stamp = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%d %H:%M UTC")
+    lines = [
+        HOTPATHS_BEGIN,
+        f"## Reconcile hot paths under cProfile ({stamp})",
+        "",
+        f"`scripts/bench_control_plane.py --profile-hotpaths` — the "
+        f"{res['jobs']}-job cluster-scale sim (seed {res['seed']}, "
+        f"{res['nodes']} nodes) run once under cProfile: "
+        f"{res['wall_s']}s wall, converged={res['converged']}.  "
+        "Ranked by cumulative time; this table is the optimization "
+        "work-list for ROADMAP direction 5.",
+        "",
+        "| rank | cum s | tot s | calls | function |",
+        "|---|---|---|---|---|",
+    ]
+    for i, row in enumerate(res["rows"], 1):
+        lines.append(f"| {i} | {row['cum_s']} | {row['tot_s']} "
+                     f"| {row['calls']} | `{row['function']}` |")
+    lines += ["", HOTPATHS_END]
+    return "\n".join(lines)
+
+
 def chaos_apiserver_plan(seed: int = 11, outage_s: float = 1.5,
                          error_rate: float = 0.10):
     """The committed chaos-apiserver fault shape (shared with the
@@ -2520,6 +2846,34 @@ def main() -> None:
                     help="reconcile workers per replica process (keep low: "
                     "the tier measures process scaling, not thread count)")
     ap.add_argument("--multicore-timeout", type=float, default=240.0)
+    ap.add_argument("--fleetview", action="store_true",
+                    help="run the fleet-observability tier standalone "
+                    "(ISSUE 15): N operator subprocesses, the "
+                    "runtime/fleetview.py collector stitching per-job "
+                    "timelines across a SIGKILL round and a live-"
+                    "reshard round (per-phase p50/p99 + handoff gap); "
+                    "--out rewrites only the delimited fleetview "
+                    "section and the merged reconcile-cost profile is "
+                    "written to --fleetview-cost-out")
+    ap.add_argument("--fleetview-jobs", type=int, default=16)
+    ap.add_argument("--fleetview-workers", type=int, default=3)
+    ap.add_argument("--fleetview-replicas", type=int, default=2)
+    ap.add_argument("--fleetview-timeout", type=float, default=240.0)
+    ap.add_argument("--fleetview-cost-out",
+                    default="BENCH_RECONCILE_COST.json",
+                    help="path for the sim-consumable reconcile-cost "
+                    "artifact ('' skips writing it)")
+    ap.add_argument("--profile-hotpaths", action="store_true",
+                    help="run the cluster-scale sim ONCE under cProfile "
+                    "and print the ranked hot-path table (ROADMAP "
+                    "direction-5 work-list); --out rewrites only the "
+                    "delimited hotpaths section")
+    ap.add_argument("--profile-jobs", type=int, default=10000)
+    ap.add_argument("--profile-workers", type=int, default=4)
+    ap.add_argument("--profile-nodes", type=int, default=2000)
+    ap.add_argument("--profile-seed", type=int, default=7)
+    ap.add_argument("--profile-top", type=int, default=15,
+                    help="rows in the committed hot-path table")
     ap.add_argument("--scale", action="store_true",
                     help="run the cluster-scale simulator tier "
                          "STANDALONE (ISSUE 8): a seeded 10k-job churn "
@@ -2546,6 +2900,57 @@ def main() -> None:
     ap.add_argument("--churn-pods-bursts", type=int, default=20)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.fleetview:
+        print(f"[bench_cp] fleetview ({args.fleetview_jobs} jobs x "
+              f"(1+{args.fleetview_workers}); "
+              f"{args.fleetview_replicas} subprocesses, SIGKILL + "
+              f"live-reshard rounds)...", file=sys.stderr)
+        res = run_fleetview(args.fleetview_jobs, args.fleetview_workers,
+                            replicas=args.fleetview_replicas,
+                            timeout=args.fleetview_timeout)
+        for tier, r in res.items():
+            line = {k: v for k, v in r.items() if k != "cost_profile"}
+            print(json.dumps({"tier": tier, **line}))
+        if args.fleetview_cost_out:
+            # the SIGKILL round's scrape covers the full workload on
+            # every replica (the doomed one snapshotted pre-kill)
+            profile = (res.get("fleetview_sigkill") or {}).get(
+                "cost_profile")
+            if profile and any((f or {}).get("series") for f in
+                               profile.get("families", {}).values()):
+                with open(args.fleetview_cost_out, "w") as f:
+                    json.dump(profile, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"[bench_cp] wrote {args.fleetview_cost_out}",
+                      file=sys.stderr)
+        if args.out:
+            update_md_section(
+                args.out, FLEETVIEW_BEGIN, FLEETVIEW_END,
+                render_fleetview_md(res, args.fleetview_jobs,
+                                    args.fleetview_workers,
+                                    args.fleetview_replicas))
+            print(f"[bench_cp] updated fleetview section of {args.out}",
+                  file=sys.stderr)
+        return
+
+    if args.profile_hotpaths:
+        total = args.profile_jobs * (args.profile_workers + 1)
+        print(f"[bench_cp] profile-hotpaths ({args.profile_jobs} jobs "
+              f"= {total} pods over {args.profile_nodes} virtual "
+              f"nodes, under cProfile)...", file=sys.stderr)
+        res = run_profile_hotpaths(args.profile_jobs,
+                                   args.profile_workers,
+                                   args.profile_nodes,
+                                   seed=args.profile_seed,
+                                   top=args.profile_top)
+        print(json.dumps({"tier": "profile_hotpaths", **res}))
+        if args.out:
+            update_md_section(args.out, HOTPATHS_BEGIN, HOTPATHS_END,
+                              render_hotpaths_md(res))
+            print(f"[bench_cp] updated hotpaths section of {args.out}",
+                  file=sys.stderr)
+        return
 
     if args.scale:
         total = args.scale_jobs * (args.scale_workers + 1)

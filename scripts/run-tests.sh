@@ -23,10 +23,14 @@ cd "$(dirname "$0")/.."
 # --multicore additionally runs the process-per-replica tier (slow:
 # each round boots N real operator subprocesses against one stub
 # apiserver, including the mid-storm SIGKILL handover round).
+# --fleetview additionally runs the fleet-observability stitching tier
+# (slow: a real subprocess fleet with a SIGKILL handoff, the collector
+# asserting one contiguous per-job timeline across replicas).
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
 RUN_MULTICORE=0
+RUN_FLEETVIEW=0
 WITNESS_ARGS=()
 DETECTOR_ARGS=()
 for arg in "$@"; do
@@ -35,9 +39,10 @@ for arg in "$@"; do
     --lint) LINT_ONLY=1 ;;
     --tsan) RUN_TSAN=1 ;;
     --multicore) RUN_MULTICORE=1 ;;
+    --fleetview) RUN_FLEETVIEW=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
     --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --witness --mutation-detector)" >&2; exit 2 ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
@@ -131,6 +136,11 @@ fi
 if [ "$RUN_MULTICORE" = 1 ]; then
   echo "=== multicore: process-per-replica subprocess tier ==="
   python -m pytest tests/test_multicore.py -q -m slow
+fi
+
+if [ "$RUN_FLEETVIEW" = 1 ]; then
+  echo "=== fleetview: cross-replica timeline stitching tier ==="
+  python -m pytest tests/test_fleetview.py -q -m slow
 fi
 
 echo "all checks passed"

@@ -1,0 +1,275 @@
+"""Job-lifecycle observability (ISSUE 15 tentpole): a job run to
+Succeeded on the fake cluster leaves ONE complete phase timeline —
+every expected milestone exactly once, timestamps monotone — served
+from /debug/jobs with trace ids that cross-link into /debug/traces,
+and exported as pytorch_operator_job_phase_duration_seconds.  Plus the
+tracker's unit contract (idempotency, bounds, uid-mismatch eviction,
+virtual-clock determinism) and the trace-loss accounting satellite."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.k8s.fake_kubelet import FakeKubelet
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime import JobControllerConfig
+from pytorch_operator_tpu.runtime.lifecycle import (
+    MILESTONES, JobLifecycleTracker)
+from pytorch_operator_tpu.runtime.tracing import Tracer
+from testutil import new_job, wait_for
+
+#: The clean-run milestone sequence for a NON-sharded controller (no
+#: admission stamping) driven by the fake kubelet.
+EXPECTED_CLEAN_RUN = ("submitted", "first_reconcile",
+                      "first_pod_created", "all_pods_bound",
+                      "all_running", "succeeded")
+
+
+def _get(port: int, path: str):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                  timeout=5)
+
+
+@pytest.fixture
+def world(e2e_artifacts):
+    cluster = FakeCluster()
+    registry = Registry()
+    tracer = Tracer(buffer_size=64)
+    ctl = PyTorchController(cluster, config=JobControllerConfig(),
+                            registry=registry, tracer=tracer)
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    stop = threading.Event()
+    ctl.run(threadiness=2, stop_event=stop)
+    server = start_metrics_server(
+        registry, 0, host="127.0.0.1", tracer=tracer,
+        lifecycle=ctl.lifecycle)
+    e2e_artifacts["port"] = server.server_address[1]
+    yield cluster, ctl, registry, kubelet, server.server_address[1]
+    stop.set()
+    ctl.work_queue.shutdown()
+    kubelet.stop()
+    server.shutdown()
+
+
+def _job_succeeded(cluster, name: str) -> bool:
+    job = cluster.jobs.get("default", name)
+    return any(c.get("type") == "Succeeded" and c.get("status") == "True"
+               for c in (job.get("status") or {}).get("conditions") or [])
+
+
+def test_sim_e2e_succeeded_timeline_complete_and_monotone(world):
+    cluster, ctl, registry, kubelet, port = world
+    cluster.jobs.create("default",
+                        new_job(workers=2, name="lc-job").to_dict())
+    assert wait_for(lambda: _job_succeeded(cluster, "lc-job"), timeout=30)
+    # succeeded is recorded during the status update; give the closing
+    # sync a beat to finish before snapshotting
+    assert wait_for(lambda: any(
+        m["milestone"] == "succeeded"
+        for rec in ctl.lifecycle.snapshot()["jobs"]
+        if rec["job"] == "default/lc-job"
+        for m in rec["milestones"]), timeout=10)
+
+    snap = json.loads(_get(port, "/debug/jobs").read().decode())
+    assert snap["replica"] == ""
+    assert snap["tracked"] >= 1
+    recs = [r for r in snap["jobs"] if r["job"] == "default/lc-job"]
+    assert len(recs) == 1
+    rec = recs[0]
+
+    # every expected phase exactly once, nothing unexpected, and the
+    # recorded order is the canonical clean-run order
+    names = [m["milestone"] for m in rec["milestones"]]
+    assert sorted(names) == sorted(EXPECTED_CLEAN_RUN), names
+    assert len(set(names)) == len(names)
+    canon = [m for m in MILESTONES if m in names]
+    assert names == canon, (names, canon)
+
+    # timestamps monotone on both clocks
+    monos = [m["mono"] for m in rec["milestones"]]
+    walls = [m["wall"] for m in rec["milestones"]]
+    assert monos == sorted(monos)
+    assert walls == sorted(walls)
+
+    # milestone trace ids cross-link into /debug/traces
+    traced = [m for m in rec["milestones"] if m.get("trace_id")]
+    assert traced, rec["milestones"]
+    traces = json.loads(_get(port, "/debug/traces").read().decode())
+    assert "dropped" in traces
+    # a root span's trace id IS its span id
+    known = {t["span_id"] for t in traces["traces"]}
+    assert any(m["trace_id"] in known for m in traced), (
+        "no milestone trace id resolves into /debug/traces")
+
+    # the sync log carries the same trace ids and the replica id
+    assert rec["syncs"], rec
+    assert all("wall" in s and "replica" in s for s in rec["syncs"])
+
+    # phase histogram exported with per-milestone labels
+    text = _get(port, "/metrics").read().decode()
+    for phase in ("first_reconcile", "succeeded"):
+        m = re.search(
+            r'pytorch_operator_job_phase_duration_seconds_count'
+            rf'\{{phase="{phase}"\}} (\d+)', text)
+        assert m and int(m.group(1)) >= 1, phase
+
+
+def test_debug_jobs_endpoint_limit_select_and_errors(world):
+    cluster, ctl, registry, kubelet, port = world
+    for i in range(3):
+        cluster.jobs.create(
+            "default", new_job(workers=1, name=f"lim-{i}").to_dict())
+    assert wait_for(
+        lambda: all(_job_succeeded(cluster, f"lim-{i}")
+                    for i in range(3)), timeout=30)
+
+    snap = json.loads(_get(port, "/debug/jobs?limit=1").read().decode())
+    assert len(snap["jobs"]) == 1
+    assert snap["tracked"] >= 3  # the bound is on the payload, not lost
+
+    one = json.loads(
+        _get(port, "/debug/jobs?job=default/lim-1").read().decode())
+    assert [r["job"] for r in one["jobs"]] == ["default/lim-1"]
+
+    missing = json.loads(
+        _get(port, "/debug/jobs?job=default/nope").read().decode())
+    assert missing["jobs"] == []
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(port, "/debug/jobs?limit=bogus")
+    assert err.value.code == 400
+
+
+def test_debug_jobs_404_without_tracker():
+    registry = Registry()
+    server = start_metrics_server(registry, 0, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/debug/jobs")
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
+
+
+# -- tracker unit contract --------------------------------------------------
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+
+def test_tracker_idempotent_and_phase_histogram():
+    clk = _FakeClock()
+    registry = Registry()
+    lt = JobLifecycleTracker(registry=registry, clock=clk.now,
+                             wall=clk.now, replica_id="r1")
+    assert lt.record("ns/j", "submitted", uid="u1")
+    clk.t += 2.0
+    assert lt.record("ns/j", "first_reconcile", uid="u1",
+                     trace_id="t123")
+    assert not lt.record("ns/j", "first_reconcile", uid="u1")
+    rec = lt.snapshot(job="ns/j")["jobs"][0]
+    assert [m["milestone"] for m in rec["milestones"]] == [
+        "submitted", "first_reconcile"]
+    assert rec["milestones"][1]["trace_id"] == "t123"
+    # the 2.0s delta landed under phase=first_reconcile
+    text = registry.expose()
+    assert re.search(
+        r'pytorch_operator_job_phase_duration_seconds_sum'
+        r'\{phase="first_reconcile"\} 2(\.0)?$', text, re.M), text
+
+
+def test_tracker_segments_close_via_pods_observed():
+    clk = _FakeClock()
+    lt = JobLifecycleTracker(clock=clk.now, wall=clk.now)
+    assert lt.begin_segment("ns/j", "restart", uid="u",
+                            attrs={"replica_type": "Worker"})
+    assert not lt.begin_segment("ns/j", "restart")  # already open
+    clk.t += 3.0
+    # gang whole again: restart (and any resize) segments close
+    lt.pods_observed("ns/j", created=3, bound=3, running=3, total=3,
+                     uid="u")
+    rec = lt.snapshot(job="ns/j")["jobs"][0]
+    seg = [s for s in rec["segments"] if s["segment"] == "restart"][0]
+    assert seg["end_mono"] - seg["start_mono"] == pytest.approx(3.0)
+    # a fresh segment of the same name can open again afterwards
+    assert lt.begin_segment("ns/j", "restart")
+
+
+def test_tracker_uid_mismatch_evicts_old_incarnation():
+    lt = JobLifecycleTracker()
+    lt.record("ns/j", "submitted", uid="old")
+    lt.record("ns/j", "succeeded", uid="old")
+    lt.record("ns/j", "submitted", uid="new")
+    rec = lt.snapshot(job="ns/j")["jobs"][0]
+    assert rec["uid"] == "new"
+    assert [m["milestone"] for m in rec["milestones"]] == ["submitted"]
+    assert lt.evicted == 1
+
+
+def test_tracker_lru_bound_and_forget():
+    lt = JobLifecycleTracker(max_jobs=2)
+    for i in range(4):
+        lt.record(f"ns/j{i}", "submitted", uid=f"u{i}")
+    snap = lt.snapshot()
+    assert snap["tracked"] == 2
+    assert snap["evicted"] == 2
+    assert [r["job"] for r in snap["jobs"]] == ["ns/j3", "ns/j2"]
+    assert lt.forget("ns/j3")
+    assert not lt.forget("ns/j3")
+    assert lt.snapshot()["tracked"] == 1
+
+
+def test_tracker_virtual_clock_determinism():
+    """Identical event sequences on identical injected clocks yield
+    byte-identical timelines — the property that lets the virtual-time
+    simulator capture deterministic timelines."""
+
+    def run():
+        clk = _FakeClock(1000.0)
+        lt = JobLifecycleTracker(clock=clk.now, wall=clk.now,
+                                 replica_id="sim")
+        for step, milestone in enumerate(EXPECTED_CLEAN_RUN):
+            clk.t = 1000.0 + step * 1.5
+            lt.record("ns/sim-job", milestone, uid="u",
+                      trace_id=f"t{step}")
+        return json.dumps(lt.snapshot(), sort_keys=True)
+
+    assert run() == run()
+
+
+# -- trace-loss accounting satellite ---------------------------------------
+
+def test_tracer_counts_ring_evictions():
+    registry = Registry()
+    tracer = Tracer(buffer_size=2)
+    tracer.dropped_counter = registry.counter(
+        "test_traces_dropped_total", "test")
+    for i in range(5):
+        with tracer.trace(f"span-{i}"):
+            pass
+    assert tracer.dropped == 3
+    assert len(tracer.snapshot()) == 2
+    assert "test_traces_dropped_total 3" in registry.expose()
+
+
+def test_tracer_zero_buffer_drops_everything():
+    tracer = Tracer(buffer_size=0)
+    with tracer.trace("gone"):
+        pass
+    assert tracer.dropped == 1
+    assert tracer.snapshot() == []

@@ -621,3 +621,84 @@ def test_bench_scale_tier_smoke(monkeypatch, tmp_path):
     bcp.update_md_section(str(md), bcp.SCALE_BEGIN, bcp.SCALE_END,
                           bcp.render_scale_md(res, 20, 2, 6, 7, 8))
     assert md.read_text().count(bcp.SCALE_BEGIN) == 1
+
+
+def test_bench_fleetview_updater_rewrites_only_its_markers(monkeypatch,
+                                                           tmp_path):
+    """ISSUE 15: the --fleetview renderer + section updater must
+    rewrite ONLY the fleetview-delimited region — sibling sections and
+    prose outside the markers stay byte-identical.  (The subprocess
+    stitching round itself runs under @pytest.mark.slow in
+    tests/test_fleetview.py.)"""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    def fake_round(mode):
+        return {"variant": f"fleetview_{mode}", "jobs": 8, "workers": 3,
+                "shard_count": 2, "replicas": 2, "threadiness": 2,
+                "converged": True, "convergence_wall_s": 30.0,
+                "acted_at_s": 12.0, "replicas_scraped": 2,
+                "stitched_jobs": 4,
+                "max_handoff_gap_s": 9.5 if mode == "sigkill" else 2.0,
+                "handoffs": [{"job": "default/fv-job-0", "gap_s": 9.5,
+                              "from_replica": "fv-r0",
+                              "to_replica": "fv-r1",
+                              "from_epoch": 0, "to_epoch": 1}],
+                "phases": {"first_reconcile":
+                           {"n": 8, "p50_ms": 120.0, "p99_ms": 900.0}},
+                "trace_drops": {"fv-r0": 0, "fv-r1": 0}}
+
+    res = {"fleetview_sigkill": fake_round("sigkill"),
+           "fleetview_reshard": fake_round("reshard")}
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched prose\n"
+                  + bcp.MULTICORE_BEGIN + "\nsibling tier\n"
+                  + bcp.MULTICORE_END + "\n")
+    section = bcp.render_fleetview_md(res, 8, 3, 2)
+    bcp.update_md_section(str(md), bcp.FLEETVIEW_BEGIN,
+                          bcp.FLEETVIEW_END, section)
+    text = md.read_text()
+    assert "untouched prose" in text and "sibling tier" in text
+    assert text.count(bcp.FLEETVIEW_BEGIN) == 1
+    assert text.count(bcp.MULTICORE_BEGIN) == 1
+    assert "handoff gap" in text
+    # re-running replaces, never duplicates — siblings stay intact
+    bcp.update_md_section(str(md), bcp.FLEETVIEW_BEGIN,
+                          bcp.FLEETVIEW_END, section)
+    text = md.read_text()
+    assert text.count(bcp.FLEETVIEW_BEGIN) == 1
+    assert "sibling tier" in text
+    assert "**Reading.**" in text
+
+
+def test_bench_profile_hotpaths_emits_parseable_ranked_table(
+        monkeypatch, tmp_path):
+    """ISSUE 15: --profile-hotpaths (a small sim under cProfile here)
+    must emit a ranked table whose rows parse back into (rank, cum s,
+    tot s, calls, function) with cumulative time non-increasing."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    res = bcp.run_profile_hotpaths(jobs=15, workers=2, nodes=6, seed=7,
+                                   arrival_s=40.0, max_virtual=3600.0,
+                                   top=10)
+    assert res["converged"], res
+    assert len(res["rows"]) == 10
+
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nkeep me\n")
+    bcp.update_md_section(str(md), bcp.HOTPATHS_BEGIN, bcp.HOTPATHS_END,
+                          bcp.render_hotpaths_md(res))
+    text = md.read_text()
+    assert "keep me" in text
+    rows = re.findall(
+        r"^\| (\d+) \| ([0-9.]+) \| ([0-9.]+) \| (\d+) \| `(.+)` \|$",
+        text, re.M)
+    assert len(rows) == 10, text
+    assert [int(r[0]) for r in rows] == list(range(1, 11))
+    cums = [float(r[1]) for r in rows]
+    assert cums == sorted(cums, reverse=True)
+    # the hot paths are real code locations (file:line:function)
+    assert all(re.search(r":\d+:", r[4]) for r in rows), rows
+    # the profiled run covers the operator package itself
+    assert any("pytorch_operator_tpu/" in r[4] for r in rows), rows

@@ -472,3 +472,100 @@ def test_new_scale_job_shape():
     specs = job["spec"]["pytorchReplicaSpecs"]
     assert specs["Master"]["replicas"] == 1
     assert specs["Worker"]["replicas"] == 4
+
+
+# ---------------------------------------------------------------------------
+# reconcile-cost model (ISSUE 15): the committed artifact is the sim's
+# cost-model input — the loader must validate it and draw from it
+# deterministically.
+
+
+class TestCostModel:
+    def _minimal_profile(self):
+        return {"version": 1, "families": {
+            "pytorch_operator_reconcile_duration_seconds": {"series": [
+                {"labels": {"result": "success"},
+                 "buckets": [["0.1", 2], ["1", 5], ["+Inf", 6]],
+                 "sum": 4.5, "count": 6}]}}}
+
+    def test_committed_artifact_round_trips(self):
+        """The artifact the --fleetview bench tier commits at the repo
+        root loads through the validator and yields usable reconcile
+        latency distributions (ROADMAP direction 3's input)."""
+        import os
+        import random
+
+        from pytorch_operator_tpu.sim.costmodel import load_cost_profile
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_RECONCILE_COST.json")
+        assert os.path.exists(path), (
+            "BENCH_RECONCILE_COST.json missing — regenerate with "
+            "scripts/bench_control_plane.py --fleetview")
+        model = load_cost_profile(path)
+        assert "pytorch_operator_reconcile_duration_seconds" in (
+            model.families)
+        mean = model.mean("pytorch_operator_reconcile_duration_seconds")
+        assert mean is not None and mean > 0
+        rng = random.Random(11)
+        draws = [model.sample(
+            "pytorch_operator_reconcile_duration_seconds",
+            rng) for _ in range(20)]
+        assert all(d is not None and d >= 0 for d in draws)
+        rng2 = random.Random(11)
+        assert draws == [model.sample(
+            "pytorch_operator_reconcile_duration_seconds",
+            rng2) for _ in range(20)]
+        # the loader round-trips what it loaded
+        assert model.to_dict()["families"].keys() == {
+            f: None for f in model.families}.keys()
+
+    def test_loader_rejects_unsafe_schemas(self, tmp_path):
+        import json
+
+        from pytorch_operator_tpu.sim.costmodel import load_cost_profile
+
+        def write(profile):
+            p = tmp_path / "p.json"
+            p.write_text(json.dumps(profile))
+            return str(p)
+
+        good = self._minimal_profile()
+        load_cost_profile(write(good))  # sanity: the base is valid
+
+        bad_version = dict(good, version=99)
+        with pytest.raises(ValueError, match="version"):
+            load_cost_profile(write(bad_version))
+        with pytest.raises(ValueError, match="families"):
+            load_cost_profile(write({"version": 1, "families": {}}))
+        non_cumulative = self._minimal_profile()
+        non_cumulative["families"][
+            "pytorch_operator_reconcile_duration_seconds"]["series"][0][
+            "buckets"] = [["0.1", 5], ["1", 2]]
+        with pytest.raises(ValueError, match="cumulative"):
+            load_cost_profile(write(non_cumulative))
+        no_labels = self._minimal_profile()
+        del no_labels["families"][
+            "pytorch_operator_reconcile_duration_seconds"]["series"][0][
+            "labels"]
+        with pytest.raises(ValueError, match="labels"):
+            load_cost_profile(write(no_labels))
+
+    def test_sample_inverse_cdf_respects_bucket_bounds(self):
+        import random
+
+        from pytorch_operator_tpu.sim.costmodel import CostModel
+
+        model = CostModel(self._minimal_profile())
+        rng = random.Random(3)
+        for _ in range(200):
+            d = model.sample(
+                "pytorch_operator_reconcile_duration_seconds", rng,
+                result="success")
+            # finite buckets cap at 1.0; the +Inf tail falls back to
+            # max(last finite bound, mean) = 1.0 here (mean 0.75)
+            assert 0.0 <= d <= 1.0
+        assert model.mean("pytorch_operator_reconcile_duration_seconds",
+                          result="success") == pytest.approx(0.75)
+        assert model.series("pytorch_operator_reconcile_duration_seconds",
+                            result="failure") is None

@@ -31,6 +31,7 @@ from pytorch_operator_tpu.telemetry.push import (
     STEP_DURATION,
     STEPS_TOTAL,
     TOKENS_PER_SEC,
+    derive_push_token,
     step_record_samples,
 )
 
@@ -253,6 +254,101 @@ class TestPushGateway:
         assert out["accepted"] == 1
         assert ('pytorch_operator_job_tokens_per_second'
                 '{job="default/real-job"} 99') in registry.expose()
+
+    def test_push_token_checked_when_resolver_set(self):
+        """ISSUE 15 identity satellite: with a token resolver wired,
+        knowing a live job's NAME is no longer enough — the payload
+        must carry the per-job token the operator injected into the
+        pod env at build time.  Mismatches are rejected wholesale
+        under reason="bad_token" and mint nothing."""
+        registry = Registry()
+        secret = "bench-secret"
+        uids = {"default/j": "uid-1"}
+
+        def resolver(job):
+            uid = uids.get(job)
+            return None if uid is None else derive_push_token(
+                job, uid, secret)
+
+        gw = PushGateway(registry, token_resolver=resolver)
+        good = derive_push_token("default/j", "uid-1", secret)
+
+        out = gw.ingest({"job": "default/j", "token": good, "samples": [
+            {"name": TOKENS_PER_SEC, "op": "set", "value": 10.0}]})
+        assert out["accepted"] == 1 and out["rejected"] == 0
+
+        for bad in ("wrong", derive_push_token("default/j", "uid-2",
+                                               secret), None):
+            payload = {"job": "default/j", "samples": [
+                {"name": TOKENS_PER_SEC, "op": "set", "value": 11.0},
+                {"name": MFU, "op": "set", "value": 0.5}]}
+            if bad is not None:
+                payload["token"] = bad
+            out = gw.ingest(payload)
+            assert out["accepted"] == 0 and out["rejected"] == 2, bad
+        text = registry.expose()
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="bad_token"} 6') in text
+        # the accepted push minted the series; the rejected ones kept
+        # their values out
+        assert ('pytorch_operator_job_tokens_per_second'
+                '{job="default/j"} 10') in text
+
+    def test_push_token_fails_closed_when_job_unresolvable(self):
+        """A resolver that cannot vouch for the job (informer lag, job
+        gone) rejects rather than letting an attacker race deletion."""
+        gw = PushGateway(registry := Registry(),
+                         token_resolver=lambda job: None)
+        out = gw.ingest({"job": "default/ghost", "token": "anything",
+                         "samples": [{"name": MFU, "op": "set",
+                                      "value": 0.5}]})
+        assert out["accepted"] == 0 and out["rejected"] == 1
+        assert ('pytorch_operator_push_rejected_total'
+                '{reason="bad_token"} 1') in registry.expose()
+
+    def test_derive_push_token_keyed_and_job_bound(self):
+        t = derive_push_token("default/j", "u1", "s")
+        assert t == derive_push_token("default/j", "u1", "s")
+        assert t != derive_push_token("default/j", "u2", "s")
+        assert t != derive_push_token("default/k", "u1", "s")
+        assert t != derive_push_token("default/j", "u1", "other")
+        # the job/uid boundary is unambiguous (no concat collision)
+        assert (derive_push_token("a/bc", "d", "s")
+                != derive_push_token("a/b", "cd", "s"))
+
+    def test_build_new_pod_injects_matching_push_token(self):
+        """The build-time half of the identity loop: the pod env the
+        operator renders carries exactly the token the gateway's
+        resolver derives for that job."""
+        from pytorch_operator_tpu.api.v1.constants import ENV_PUSH_TOKEN
+        from pytorch_operator_tpu.controller import PyTorchController
+        from pytorch_operator_tpu.k8s.fake import FakeCluster
+        from pytorch_operator_tpu.runtime import JobControllerConfig
+        from testutil import new_job, wait_for
+
+        cluster = FakeCluster()
+        ctl = PyTorchController(
+            cluster, config=JobControllerConfig(
+                push_token_secret="e2e-secret"),
+            registry=Registry())
+        stop = threading.Event()
+        ctl.run(threadiness=1, stop_event=stop)
+        try:
+            job = new_job(workers=1, name="tok-job").to_dict()
+            cluster.jobs.create("default", job)
+            assert wait_for(
+                lambda: len(cluster.pods.list("default")) == 2,
+                timeout=10)
+            uid = cluster.jobs.get("default", "tok-job")["metadata"]["uid"]
+            want = derive_push_token("default/tok-job", uid, "e2e-secret")
+            for pod in cluster.pods.list("default"):
+                env = {e.get("name"): e.get("value")
+                       for c in pod["spec"]["containers"]
+                       for e in c.get("env") or []}
+                assert env.get(ENV_PUSH_TOKEN) == want, pod["metadata"]
+        finally:
+            stop.set()
+            ctl.work_queue.shutdown()
 
     def test_malformed_payload_raises_for_http_400(self):
         gw = PushGateway(Registry())
