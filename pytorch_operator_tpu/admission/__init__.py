@@ -1,0 +1,36 @@
+"""Multi-tenant admission control: quotas, priorities, fair-share queue.
+
+The subsystem sits between the job informer and the reconciler: the
+controller offers every non-terminal job to an :class:`AdmissionController`
+before creating pods/services; unreleased jobs park in ``Pending`` with
+a ``Queued`` condition and are released by weighted deficit-round-robin
+over namespaces (see :mod:`.queue` for the full design notes).
+"""
+
+from .queue import (
+    KIND_ADMIT,
+    KIND_GROW,
+    KIND_RESTART,
+    AdmissionController,
+    parse_condition_time,
+)
+from .quota import (
+    QuotaPolicy,
+    job_chips,
+    job_min_chips,
+    job_priority,
+    parse_quota_overrides,
+)
+
+__all__ = [
+    "AdmissionController",
+    "QuotaPolicy",
+    "KIND_ADMIT",
+    "KIND_GROW",
+    "KIND_RESTART",
+    "job_chips",
+    "job_min_chips",
+    "job_priority",
+    "parse_condition_time",
+    "parse_quota_overrides",
+]

@@ -1,0 +1,556 @@
+"""Fair-share admission queue between the job informer and the reconciler.
+
+The controller offers every non-terminal job to this queue before
+creating anything (the admission gate in ``reconcile``).  Jobs the
+queue has not yet released sit in ``Pending`` with a ``Queued``
+condition; the reconcile skips pod/service creation for them entirely.
+Release order is weighted deficit-round-robin (DRR) over namespaces:
+
+  * every namespace with waiters is visited once per round in sorted
+    order (determinism — the sim fingerprints release order);
+  * a visit tops up the namespace's deficit by ``quantum x weight``
+    (weight = its job quota, floor 1) and releases queue heads while
+    the deficit covers the unit cost of 1 job each;
+  * a head that does not fit (namespace quota or cluster ceiling)
+    blocks its namespace for the round — FIFO within a namespace is
+    head-of-line by design, so a tenant cannot jump its own big job by
+    submitting small ones behind it;
+  * within a namespace, higher ``spec.priority`` sorts first (stable
+    by enqueue time).  Across namespaces priority carries no weight —
+    fair share between tenants dominates — but it arms preemption: a
+    waiter blocked by quota may shrink (elastic) or restart
+    (non-elastic) a strictly lower-priority admitted sibling in the
+    same namespace.
+
+Durability: the queue keeps NO state of record.  Every decision is
+mirrored into the job's ``Queued`` condition by the controller, and
+``offer`` lazily rebuilds a ledger entry from that condition the first
+time a (new) shard owner syncs the job after a handover — so a SIGKILL
+of the owning replica loses no queued job and admits none twice (the
+admitted/queued verdict rides the job object, not this process).
+
+Thread-safety: all ledger state is guarded by one lock; the
+``preempt`` and ``on_release`` callbacks are always invoked with the
+lock released, so they may re-enter the controller (enqueue keys, note
+disruptions) freely.
+"""
+
+from __future__ import annotations
+
+import calendar
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis.witness import make_lock
+from ..api.v1 import constants
+from ..api.v1.types import PyTorchJob
+from .quota import QuotaPolicy, job_chips, job_min_chips, job_priority
+
+LOG = logging.getLogger("admission")
+
+# Entry kinds: why the key is (or was) in the waiting queue.
+KIND_ADMIT = "admit"      # new job waiting for its first release
+KIND_GROW = "grow"        # elastic preemption victim waiting to grow back
+KIND_RESTART = "restart"  # non-elastic victim waiting to be recreated
+
+ADMISSION_WAIT_BUCKETS = (
+    0.5, 1, 5, 15, 60, 300, 900, 3600, 14400, float("inf"))
+
+
+def parse_condition_time(stamp: Optional[str]) -> Optional[float]:
+    """RFC3339 condition timestamp -> epoch seconds (now_iso inverse)."""
+    if not stamp:
+        return None
+    try:
+        return float(calendar.timegm(
+            time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")))
+    except (ValueError, TypeError):
+        return None
+
+
+@dataclass
+class _Entry:
+    """One job's admission ledger row."""
+
+    key: str
+    namespace: str
+    priority: int = 0
+    want_chips: int = 0
+    floor_chips: int = 0
+    granted_chips: int = 0
+    admitted: bool = False   # counted against the namespace's job quota
+    waiting: bool = False    # present in its namespace's DRR queue
+    kind: str = KIND_ADMIT
+    enqueued_at: float = 0.0
+    seq: int = 0
+
+
+@dataclass
+class _Usage:
+    jobs: int = 0
+    chips: int = 0
+
+
+class AdmissionController:
+    """Quota ledger + weighted-DRR release pump.
+
+    ``preempt(victim_key, waiter_key) -> Optional[str]`` decides whether
+    (and how) a victim drains: ``"elastic"`` (shrink-to-min via the
+    checkpoint path), ``"restart"`` (legacy gang restart), or ``None``
+    (refuse; the next candidate is tried).  ``on_release(key, kind)``
+    fires for every released entry so the controller can requeue the
+    job (and nudge the elastic grow machinery for ``"grow"`` entries).
+    ``wait_observer(namespace, wait_seconds, kind)`` feeds the sim's
+    per-tenant percentile collection without scraping metrics.
+    """
+
+    def __init__(
+        self,
+        policy: Optional[QuotaPolicy] = None,
+        *,
+        cluster_max_jobs: int = 0,
+        cluster_max_chips: int = 0,
+        quantum: float = 1.0,
+        clock: Callable[[], float] = time.time,
+        registry=None,
+        preempt: Optional[Callable[[str, str], Optional[str]]] = None,
+        on_release: Optional[Callable[[str, str], None]] = None,
+        wait_observer: Optional[Callable[[str, float, str], None]] = None,
+    ):
+        self.policy = policy or QuotaPolicy()
+        self.cluster_max_jobs = max(0, int(cluster_max_jobs))
+        self.cluster_max_chips = max(0, int(cluster_max_chips))
+        self.quantum = float(quantum)
+        self.clock = clock
+        self.preempt = preempt
+        self.on_release = on_release
+        self.wait_observer = wait_observer
+
+        self._lock = make_lock("admission.queue")
+        self._entries: Dict[str, _Entry] = {}
+        self._queues: Dict[str, List[str]] = {}
+        # namespaces whose queue order may be stale (new entry or a
+        # priority edit since the last sort) — a released head never
+        # unsorts a queue, so the pump re-sorts only dirty ones
+        self._dirty: set = set()
+        self._deficit: Dict[str, float] = {}
+        # namespace -> keys of admitted entries: the preemption
+        # candidate scan is per-namespace (at most ~quota entries), not
+        # a walk of every ledger row per blocked head per round
+        self._admitted_by_ns: Dict[str, set] = {}
+        self._ns_usage: Dict[str, _Usage] = {}
+        self._cluster = _Usage()
+        self._seq = 0
+
+        self._wait_hist = None
+        self._denied = None
+        self._depth = None
+        self._preemptions = None
+        if registry is not None:
+            self._wait_hist = registry.histogram_vec(
+                "pytorch_operator_admission_wait_seconds",
+                "Seconds a job spent in the fair-share admission queue "
+                "before release, labeled by namespace",
+                label_names=("namespace",),
+                buckets=ADMISSION_WAIT_BUCKETS)
+            self._denied = registry.counter_vec(
+                "pytorch_operator_quota_denied_total",
+                "Jobs that could not be admitted immediately and entered "
+                "the queue, labeled by namespace",
+                label_names=("namespace",))
+            self._depth = registry.gauge_vec(
+                "pytorch_operator_admission_queue_depth",
+                "Jobs currently waiting in the admission queue, labeled "
+                "by namespace",
+                label_names=("namespace",))
+            self._preemptions = registry.counter(
+                "pytorch_operator_admission_preemptions_total",
+                "Lower-priority running jobs drained (elastic shrink or "
+                "legacy restart) to make quota room for a higher-priority "
+                "waiter")
+
+    # -- gate ---------------------------------------------------------------
+
+    def offer(self, job: PyTorchJob, has_pods: bool) -> bool:
+        """Ensure a ledger entry for ``job`` and return the admit verdict.
+
+        Idempotent per sync; the first call after a shard handover
+        rebuilds the entry from the job's ``Queued`` condition (lazy
+        rebuild — a fresh shard informer LIST replays every job through
+        here).  Returns True when the job may run: either fully
+        admitted or an elastic preemption victim allowed to keep its
+        shrunken gang while its grow-back entry waits.
+        """
+        denied_ns = None
+        created = False
+        with self._lock:
+            entry = self._entries.get(job.key)
+            if entry is None:
+                created = True
+                entry = self._rebuild(job, has_pods)
+                if entry.waiting and entry.kind == KIND_ADMIT \
+                        and not entry.admitted:
+                    denied_ns = entry.namespace
+            else:
+                # Spec edits may retarget priority mid-wait.
+                priority = job_priority(job)
+                if priority != entry.priority:
+                    entry.priority = priority
+                    if entry.waiting:
+                        self._dirty.add(entry.namespace)
+        if denied_ns is not None and self._denied is not None:
+            self._denied.labels(namespace=denied_ns).inc()
+        if created:
+            # Re-offers (every later sync of the same job) change no
+            # capacity, so they never pump: releases only become
+            # possible when quota frees (note_terminal/note_deleted/a
+            # preemption drain), and all of those pump themselves.
+            # Without this, every sync of every admitted job pays a
+            # full DRR round — quadratic at 10k queued jobs.
+            self.pump()
+        with self._lock:
+            entry = self._entries.get(job.key)
+            return entry is not None and entry.admitted
+
+    def grow_allowed(self, key: str) -> bool:
+        """False while the job's grow-back entry still waits in queue."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return True
+            return not (entry.waiting and entry.kind == KIND_GROW)
+
+    def is_waiting(self, key: str) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            return entry is not None and entry.waiting
+
+    def waiting_kind(self, key: str) -> Optional[str]:
+        """The queue-entry kind while ``key`` waits, else None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or not entry.waiting:
+                return None
+            return entry.kind
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def note_terminal(self, key: str) -> None:
+        """Job reached Succeeded/Failed: free its quota and pump."""
+        self._forget(key)
+        self.pump()
+
+    def note_deleted(self, key: str) -> None:
+        """Job deleted from the apiserver: free its quota and pump."""
+        self._forget(key)
+        self.pump()
+
+    def forget_keys(self, keys) -> None:
+        """Drop ledger entries wholesale (shard released: the new owner
+        rebuilds them from job conditions; keeping ours would double-count
+        quota if this replica later reacquires the shard).  Pumps once
+        at the end: the forgotten grants may free quota for waiters of
+        still-owned shards in the same namespaces, and re-offers alone
+        never pump."""
+        for key in list(keys):
+            self._forget(key, pump_after=False)
+        self.pump()
+
+    def _forget(self, key: str, pump_after: bool = True) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return
+            if entry.waiting:
+                queue = self._queues.get(entry.namespace)
+                if queue is not None and key in queue:
+                    queue.remove(key)
+                self._set_depth(entry.namespace)
+            if entry.admitted:
+                self._charge(entry.namespace, jobs=-1,
+                             chips=-entry.granted_chips)
+                self._admitted_by_ns.get(entry.namespace, set()).discard(
+                    key)
+
+    # -- accounting ---------------------------------------------------------
+
+    def _charge(self, namespace: str, jobs: int = 0, chips: int = 0) -> None:
+        usage = self._ns_usage.setdefault(namespace, _Usage())
+        usage.jobs += jobs
+        usage.chips += chips
+        self._cluster.jobs += jobs
+        self._cluster.chips += chips
+
+    def _fits(self, entry: _Entry) -> bool:
+        """Would releasing ``entry`` stay inside all limits?  (0 = no limit)"""
+        usage = self._ns_usage.setdefault(entry.namespace, _Usage())
+        new_jobs = 0 if entry.admitted else 1
+        chips_delta = entry.want_chips - entry.granted_chips
+        quota_jobs = self.policy.quota_jobs(entry.namespace)
+        quota_chips = self.policy.quota_chips(entry.namespace)
+        if quota_jobs and usage.jobs + new_jobs > quota_jobs:
+            return False
+        if quota_chips and usage.chips + chips_delta > quota_chips:
+            return False
+        if self.cluster_max_jobs and \
+                self._cluster.jobs + new_jobs > self.cluster_max_jobs:
+            return False
+        if self.cluster_max_chips and \
+                self._cluster.chips + chips_delta > self.cluster_max_chips:
+            return False
+        return True
+
+    def _rebuild(self, job: PyTorchJob, has_pods: bool) -> _Entry:
+        """Install the ledger entry implied by the job's Queued condition.
+
+        The condition IS the durable queue state: Queued=True + pods ->
+        elastic victim running shrunken with a grow-back claim;
+        Queued=True + no pods -> waiting (admit, or restart if it was
+        preempted); anything else with pods or an Admitted stamp ->
+        already admitted.  ``enqueued_at`` is recovered from the
+        condition's transition time so waits survive the handover.
+        """
+        entry = _Entry(
+            key=job.key,
+            namespace=job.metadata.namespace or "",
+            priority=job_priority(job),
+            want_chips=job_chips(job),
+            floor_chips=job_min_chips(job),
+        )
+        self._entries[job.key] = entry
+        # lazy: controller.status lives below the controller package,
+        # which imports this subsystem (the gate) at module load
+        from ..controller import status as status_machine
+
+        cond = status_machine.get_condition(job.status, constants.JOB_QUEUED)
+        queued = cond is not None and cond.status == "True"
+        now = self.clock()
+        stamp = parse_condition_time(
+            cond.last_transition_time if cond else None)
+        enqueued = min(stamp, now) if stamp is not None else now
+        if queued and has_pods:
+            entry.admitted = True
+            entry.granted_chips = entry.floor_chips
+            self._charge(entry.namespace, jobs=1, chips=entry.granted_chips)
+            self._admitted_by_ns.setdefault(
+                entry.namespace, set()).add(entry.key)
+            self._enqueue(entry, KIND_GROW, enqueued)
+        elif queued:
+            kind = KIND_RESTART if (
+                cond and cond.reason == constants.ADMISSION_PREEMPTED_REASON
+            ) else KIND_ADMIT
+            self._enqueue(entry, kind, enqueued)
+        elif has_pods or (
+            cond is not None
+            and cond.reason == constants.ADMISSION_ADMITTED_REASON
+        ):
+            # Already admitted (possibly by a previous shard owner, or a
+            # job predating admission control): never admit twice.
+            entry.admitted = True
+            entry.granted_chips = entry.want_chips
+            self._charge(entry.namespace, jobs=1, chips=entry.granted_chips)
+            self._admitted_by_ns.setdefault(
+                entry.namespace, set()).add(entry.key)
+        else:
+            self._enqueue(entry, KIND_ADMIT, now)
+        return entry
+
+    def _enqueue(self, entry: _Entry, kind: str, enqueued_at: float) -> None:
+        self._seq += 1
+        entry.seq = self._seq
+        entry.kind = kind
+        entry.waiting = True
+        entry.enqueued_at = enqueued_at
+        self._queues.setdefault(entry.namespace, []).append(entry.key)
+        self._dirty.add(entry.namespace)
+        self._set_depth(entry.namespace)
+
+    def _set_depth(self, namespace: str) -> None:
+        if self._depth is not None:
+            self._depth.labels(namespace=namespace).set(
+                float(len(self._queues.get(namespace, []))))
+
+    # -- the pump -----------------------------------------------------------
+
+    def pump(self) -> List[str]:
+        """Run DRR rounds until no further release or preemption is
+        possible.  Returns the keys released this call (callbacks fire
+        for each, with the lock released)."""
+        all_released: List[Tuple[str, str, str, float]] = []
+        while True:
+            with self._lock:
+                released, blocked = self._drr_round()
+            all_released.extend(released)
+            if released:
+                continue
+            if blocked is None or self.preempt is None:
+                break
+            if not self._try_preempt_for(blocked):
+                break
+        for key, kind, namespace, wait in all_released:
+            if self._wait_hist is not None:
+                self._wait_hist.labels(namespace=namespace).observe(wait)
+            if self.wait_observer is not None:
+                self.wait_observer(namespace, wait, kind)
+            if self.on_release is not None:
+                self.on_release(key, kind)
+        return [key for key, _, _, _ in all_released]
+
+    def _drr_round(self):
+        """One DRR round under the lock.  Returns (released, blocked_key):
+        ``released`` is [(key, kind, namespace, wait)] and ``blocked_key``
+        names the highest-priority head that failed ``_fits`` and has
+        same-namespace preemption candidates (or None)."""
+        released = []
+        blocked_key = None
+        blocked_rank = None
+        now = self.clock()
+        for namespace in sorted(self._queues):
+            queue = self._queues[namespace]
+            if not queue:
+                # Standard DRR: an idle flow accumulates no deficit.
+                self._deficit[namespace] = 0.0
+                continue
+            weight = self.policy.weight(namespace)
+            share = self.quantum * weight
+            # Cap keeps a long-blocked namespace from bursting the whole
+            # ceiling when capacity finally frees (cost per job is 1).
+            self._deficit[namespace] = min(
+                self._deficit.get(namespace, 0.0) + share, 2.0 * share)
+            if namespace in self._dirty:
+                # total order (seq is unique), so sorting lazily on
+                # enqueue/priority-edit is byte-identical to sorting
+                # every round — releases pop heads and never unsort
+                queue.sort(key=lambda k: (
+                    -self._entries[k].priority,
+                    self._entries[k].enqueued_at,
+                    self._entries[k].seq,
+                ))
+                self._dirty.discard(namespace)
+            progressed = False
+            while queue and self._deficit[namespace] >= 1.0:
+                head = self._entries[queue[0]]
+                if not self._fits(head):
+                    # Head-of-line within the namespace: later (smaller)
+                    # jobs may not jump it.  Remember the best blocked
+                    # waiter that has someone to preempt.
+                    rank = (-head.priority, head.enqueued_at, head.seq)
+                    if self._candidates(head) and (
+                            blocked_rank is None or rank < blocked_rank):
+                        blocked_rank = rank
+                        blocked_key = head.key
+                    break
+                queue.pop(0)
+                self._deficit[namespace] -= 1.0
+                released.append(self._release(head, now))
+                progressed = True
+            if not queue:
+                self._deficit[namespace] = 0.0
+            if progressed:
+                self._set_depth(namespace)
+        return released, blocked_key
+
+    def _release(self, entry: _Entry, now: float):
+        entry.waiting = False
+        new_jobs = 0 if entry.admitted else 1
+        chips_delta = entry.want_chips - entry.granted_chips
+        entry.admitted = True
+        entry.granted_chips = entry.want_chips
+        self._charge(entry.namespace, jobs=new_jobs, chips=chips_delta)
+        self._admitted_by_ns.setdefault(
+            entry.namespace, set()).add(entry.key)
+        wait = max(0.0, now - entry.enqueued_at)
+        return (entry.key, entry.kind, entry.namespace, wait)
+
+    # -- preemption ---------------------------------------------------------
+
+    def _candidates(self, waiter: _Entry) -> List[str]:
+        """Admitted same-namespace entries with strictly lower priority,
+        cheapest disruption first (lowest priority, then youngest)."""
+        out = []
+        for key in self._admitted_by_ns.get(waiter.namespace, ()):
+            entry = self._entries.get(key)
+            if entry is None or entry.key == waiter.key:
+                continue
+            if entry.waiting:
+                continue  # already draining/shrunken — don't pile on
+            if entry.priority < waiter.priority:
+                out.append(entry)
+        out.sort(key=lambda e: (e.priority, -e.seq, e.key))
+        return [e.key for e in out]
+
+    def _try_preempt_for(self, waiter_key: str) -> bool:
+        """Drain lower-priority siblings until ``waiter_key`` fits.
+
+        The ``preempt`` callback (controller) picks the drain mode per
+        victim; the ledger releases the victim's quota optimistically at
+        decision time — the actual pod drain is asynchronous, so there
+        is a transient oversubscription window bounded by the drain.
+        Returns True when any victim was preempted (progress)."""
+        progressed = False
+        while True:
+            with self._lock:
+                waiter = self._entries.get(waiter_key)
+                if waiter is None or not waiter.waiting \
+                        or self._fits(waiter):
+                    return progressed
+                candidates = self._candidates(waiter)
+            if not candidates:
+                return progressed
+            any_drained = False
+            for victim_key in candidates:
+                mode = self.preempt(victim_key, waiter_key)
+                if mode is None:
+                    continue
+                with self._lock:
+                    victim = self._entries.get(victim_key)
+                    if victim is None or not victim.admitted \
+                            or victim.waiting:
+                        continue
+                    now = self.clock()
+                    if mode == "elastic":
+                        freed = victim.granted_chips - victim.floor_chips
+                        victim.granted_chips = victim.floor_chips
+                        self._charge(victim.namespace, chips=-freed)
+                        self._enqueue(victim, KIND_GROW, now)
+                    else:
+                        self._charge(victim.namespace, jobs=-1,
+                                     chips=-victim.granted_chips)
+                        victim.admitted = False
+                        victim.granted_chips = 0
+                        self._admitted_by_ns.get(
+                            victim.namespace, set()).discard(victim.key)
+                        self._enqueue(victim, KIND_RESTART, now)
+                    fits = self._fits(waiter)
+                if self._preemptions is not None:
+                    self._preemptions.inc()
+                any_drained = True
+                progressed = True
+                if fits:
+                    return True
+            if not any_drained:
+                return progressed
+
+    # -- introspection --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-namespace view for tests, /debug and the sim verdict."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for namespace in sorted(
+                    set(self._ns_usage) | set(self._queues)):
+                usage = self._ns_usage.get(namespace, _Usage())
+                out[namespace] = {
+                    "admitted_jobs": usage.jobs,
+                    "chips": usage.chips,
+                    "waiting": len(self._queues.get(namespace, [])),
+                }
+            out["_cluster"] = {
+                "admitted_jobs": self._cluster.jobs,
+                "chips": self._cluster.chips,
+                "waiting": sum(
+                    len(q) for q in self._queues.values()),
+            }
+            return out

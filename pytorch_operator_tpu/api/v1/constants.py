@@ -53,6 +53,13 @@ JOB_FAILED = "Failed"
 # worker counts (drain-shrink on preemption, grow on returned capacity),
 # cleared (status False) once actual matches desired again.
 JOB_RESIZING = "Resizing"
+# Multi-tenant admission extension: set True while the job waits in the
+# fair-share admission queue (quota exhausted, or a priority preemption
+# took its grant back), cleared (status False, reason Admitted) when the
+# DRR scheduler releases it.  The condition IS the queue's durable
+# state: a shard owner rebuilds its admission ledger from it after a
+# handover, so no Lease or other side-channel state exists to lose.
+JOB_QUEUED = "Queued"
 
 # --- Labels (reference: controller.go:55-58, jobcontroller.go:138-147) -----
 LABEL_GROUP_NAME = "group-name"
@@ -229,3 +236,24 @@ ANNOTATION_ELASTIC_RANK = "pytorch.kubeflow.org/elastic-rank"
 ANNOTATION_ELASTIC_HOSTNAMES = "pytorch.kubeflow.org/elastic-hostnames"
 # Per-job override of the operator-wide --max-elastic-resizes budget.
 ANNOTATION_MAX_ELASTIC_RESIZES = "pytorch.kubeflow.org/max-elastic-resizes"
+
+# --- Multi-tenant admission ---------------------------------------------------
+# Integer job priority.  The spec field (spec.priority) wins; this
+# annotation is the fallback for clients that cannot touch the spec
+# (kubectl annotate on a submitted job).  Higher value = more important;
+# unset = 0.  Priorities order release WITHIN a namespace's queue and
+# arm preemption: a queued job may evict chips from a lower-priority
+# running job of the same namespace.
+ANNOTATION_PRIORITY = "pytorch.kubeflow.org/priority"
+# Queued-condition reasons: why the job is (or stopped) waiting.
+ADMISSION_QUEUED_REASON = "AwaitingQuota"
+ADMISSION_ADMITTED_REASON = "Admitted"
+# A running job preempted by a higher-priority sibling: elastic jobs
+# keep this with status True while shrunken-by-preemption (queued for
+# their grow-back grant), non-elastic jobs while waiting for re-release
+# after the legacy gang restart tore them down.
+ADMISSION_PREEMPTED_REASON = "PreemptedByPriority"
+# Disruption-note reason for the preemption drain (rides the same
+# checkpoint-drain machinery as node preemptions; the note's source
+# names the admission waiter that triggered it).
+PRIORITY_PREEMPTION_REASON = "PriorityPreemption"

@@ -106,6 +106,12 @@ class PyTorchJobSpec:
     ttl_seconds_after_finished: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
     elastic_policy: Optional[ElasticPolicy] = None
+    # Integer admission priority (higher = released sooner within the
+    # namespace's fair-share queue; arms preemption of lower-priority
+    # running siblings).  None = 0.  The
+    # ``pytorch.kubeflow.org/priority`` annotation is the fallback for
+    # clients that cannot touch the spec; the spec field wins.
+    priority: Optional[int] = None
     # Map keyed "Master" / "Worker" (reference types.go:74-98).
     pytorch_replica_specs: Dict[str, ReplicaSpec] = field(
         default_factory=dict, metadata={"k8s": "pytorchReplicaSpecs"}

@@ -66,6 +66,19 @@ def validate_spec(spec: PyTorchJobSpec) -> None:
         )
 
     _validate_elastic_policy(spec)
+    _validate_priority(spec)
+
+
+def _validate_priority(spec: PyTorchJobSpec) -> None:
+    value = spec.priority
+    # bool before int: a YAML `priority: true` must not silently become
+    # priority 1 (same trap _validate_elastic_policy guards against)
+    if value is not None and (isinstance(value, bool)
+                              or not isinstance(value, int)):
+        raise ValidationError(
+            f"PyTorchJobSpec is not valid: priority must be an integer, "
+            f"got {value!r}"
+        )
 
 
 def _validate_elastic_policy(spec: PyTorchJobSpec) -> None:

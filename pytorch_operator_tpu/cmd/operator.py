@@ -144,6 +144,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "restart (per-job override: the "
                         "pytorch.kubeflow.org/max-elastic-resizes "
                         "annotation)")
+    p.add_argument("--enable-admission", action="store_true",
+                   help="run the fair-share admission queue between the "
+                        "job informer and the reconciler: jobs enter "
+                        "Queued (condition on the job, the queue's only "
+                        "durable state) and are released by weighted "
+                        "deficit-round-robin over namespaces, so one "
+                        "tenant flooding 10x its quota cannot starve "
+                        "the others; integer spec.priority orders jobs "
+                        "within a namespace and arms preemption of "
+                        "lower-priority running jobs (elastic victims "
+                        "shrink through the checkpoint drain, gang "
+                        "non-elastic victims take the legacy restart)")
+    p.add_argument("--quota-jobs", type=int, default=0,
+                   help="with --enable-admission: default per-namespace "
+                        "ceiling on concurrently admitted PyTorchJobs "
+                        "(0 = unlimited; per-namespace override via "
+                        "--quota-overrides)")
+    p.add_argument("--quota-chips", type=int, default=0,
+                   help="with --enable-admission: default per-namespace "
+                        "ceiling on aggregate google.com/tpu chips "
+                        "across admitted jobs (0 = unlimited)")
+    p.add_argument("--quota-overrides", default="",
+                   help="per-namespace quota overrides, "
+                        "'ns=jobs:chips,ns2=jobs:chips' (0 = unlimited "
+                        "for that dimension); malformed entries are a "
+                        "startup error — quota config is security "
+                        "config, never silently dropped")
+    p.add_argument("--cluster-max-jobs", type=int, default=0,
+                   help="with --enable-admission: cluster-wide ceiling "
+                        "on concurrently admitted jobs across all "
+                        "namespaces, per shard owner (0 = unlimited)")
+    p.add_argument("--cluster-max-chips", type=int, default=0,
+                   help="with --enable-admission: cluster-wide ceiling "
+                        "on aggregate admitted TPU chips, per shard "
+                        "owner (0 = unlimited)")
+    p.add_argument("--tenant-qps", type=float, default=0.0,
+                   help="per-namespace QPS toward the API server: each "
+                        "tenant's namespaced requests pace through "
+                        "their own token bucket in front of the shared "
+                        "--kube-api-qps limiter, so one tenant's create "
+                        "storm queues behind its own bucket (0 = "
+                        "disabled, the default)")
+    p.add_argument("--tenant-burst", type=int, default=10,
+                   help="token-bucket burst size for --tenant-qps")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for the /metrics, /push/v1/metrics, "
                         "/debug/traces, /healthz and /readyz endpoints "
@@ -425,7 +469,9 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
             qps=args.qps, burst=args.burst,
             max_attempts=max(1, args.kube_api_retries),
             breaker_threshold=max(0, args.circuit_breaker_threshold),
-            breaker_reset=breaker_reset)
+            breaker_reset=breaker_reset,
+            tenant_qps=max(0.0, args.tenant_qps),
+            tenant_burst=max(1, args.tenant_burst))
         cluster = RestCluster(kube_config, namespace=args.namespace or None,
                               registry=registry, resilience=resilience)
         # checkCRDExists (reference server.go:106-109): fail fast when the
@@ -449,6 +495,13 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
     except ValueError as e:
         logger.error("invalid shard lease duration flag: %s", e)
         return 1
+    try:
+        from pytorch_operator_tpu.admission import parse_quota_overrides
+
+        quota_overrides = parse_quota_overrides(args.quota_overrides)
+    except ValueError as e:
+        logger.error("invalid --quota-overrides: %s", e)
+        return 1
     config = JobControllerConfig(
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
@@ -465,6 +518,12 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         shard_renew_interval=max(0.02, shard_renew_interval),
         push_token_secret=args.push_token_secret,
         job_timeline_max_jobs=args.job_timeline_max_jobs,
+        enable_admission=args.enable_admission,
+        quota_jobs=args.quota_jobs,
+        quota_chips=args.quota_chips,
+        quota_overrides=quota_overrides,
+        cluster_max_jobs=args.cluster_max_jobs,
+        cluster_max_chips=args.cluster_max_chips,
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)

@@ -16,6 +16,12 @@ import threading
 import time
 from typing import List, Optional
 
+from ..admission import (
+    KIND_GROW,
+    KIND_RESTART,
+    AdmissionController,
+    QuotaPolicy,
+)
 from ..api.v1 import constants
 from ..api.v1.defaults import set_defaults
 from ..api.v1.types import PyTorchJob
@@ -218,6 +224,26 @@ class PyTorchController(
             wall=self.config.clock,
             max_jobs=self.config.job_timeline_max_jobs,
             replica_id=self.replica_id)
+        # Multi-tenant admission (--enable-admission): per-namespace
+        # quota ledger + fair-share DRR release queue, offered every
+        # non-terminal job by the gate in reconcile before any
+        # pod/service work.  None (the default) keeps the gate
+        # pass-through and never writes a Queued condition.  In sharded
+        # mode each shard owner runs its own ledger over the jobs it
+        # owns, rebuilt lazily from Queued conditions after a handover
+        # (_on_shard_released forgets; the new owner's LIST re-offers).
+        self.admission = None
+        if self.config.enable_admission:
+            self.admission = AdmissionController(
+                QuotaPolicy(default_jobs=self.config.quota_jobs,
+                            default_chips=self.config.quota_chips,
+                            overrides=self.config.quota_overrides),
+                cluster_max_jobs=self.config.cluster_max_jobs,
+                cluster_max_chips=self.config.cluster_max_chips,
+                clock=self.config.clock or time.time,
+                registry=registry,
+                preempt=self._admission_preempt,
+                on_release=self._admission_released)
         # trace-loss accounting: ring evictions in the tracer become a
         # counter, so /debug/traces under-reporting is a scrapeable fact
         self.tracer.dropped_counter = registry.counter(
@@ -493,6 +519,12 @@ class PyTorchController(
         if self._pod_index_union is not None:
             self._pod_index_union.remove_index(shard)
         if runtime is not None:
+            if self.admission is not None:
+                # the shard's jobs move to another owner whose ledger
+                # rebuilds from their Queued conditions — keeping ours
+                # would double-count their quota on a later reacquire
+                self.admission.forget_keys(
+                    runtime.job_informer.store.keys())
             runtime.stop()
             self.logger.info("replica %s released shard %d",
                              self.replica_id, shard)
@@ -860,6 +892,9 @@ class PyTorchController(
             with self._disruption_lock:
                 self._pending_disruptions.pop(key, None)
             self.clear_elastic_state(key)
+            if self.admission is not None:
+                # quota freed by the deletion may unblock queued tenants
+                self.admission.note_deleted(key)
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
@@ -904,6 +939,150 @@ class PyTorchController(
         if err is not None:
             return False, err
         return True, None
+
+    # -- multi-tenant admission ---------------------------------------------
+    def _disruption_machinery_enabled(self) -> bool:
+        """The disruption/elastic state machines also run when admission
+        is on: priority preemption drains victims through them (and the
+        elastic target must bind for shrunken victims) even without
+        --enable-disruption-handling's node watchers."""
+        return (self.config.enable_disruption_handling
+                or self.admission is not None)
+
+    def _admission_gate(self, job: PyTorchJob, pods: List[dict]) -> bool:
+        """Offer the job to the admission queue and mirror the verdict
+        into its Queued condition — the queue's ONLY durable state, so
+        a new shard owner (or a restarted operator) rebuilds exactly
+        this from the job object.  Returns True when this sync may
+        proceed to create/reconcile."""
+        job_key = job.key
+        uid = job.metadata.uid or ""
+        name = job.metadata.name
+        admitted = self.admission.offer(job, has_pods=bool(pods))
+        waiting = self.admission.waiting_kind(job_key)
+        if admitted and waiting is None:
+            cond = status_machine.get_condition(job.status,
+                                                constants.JOB_QUEUED)
+            if cond is not None and cond.status == "True":
+                status_machine.clear_condition(
+                    job.status, constants.JOB_QUEUED,
+                    constants.ADMISSION_ADMITTED_REASON,
+                    f"PyTorchJob {name} admitted by the fair-share queue")
+            self.lifecycle.record(job_key, "admitted", uid=uid,
+                                  trace_id=tracing.current_trace_id())
+            return True
+        if admitted and waiting == KIND_GROW:
+            # elastic preemption victim: keeps running at its shrunken
+            # floor while the grow-back entry waits in the queue — the
+            # condition stays True so a handover rebuild restores the
+            # grow claim (Queued=True + pods == shrunken victim)
+            status_machine.update_job_conditions(
+                job.status, constants.JOB_QUEUED,
+                constants.ADMISSION_PREEMPTED_REASON,
+                f"PyTorchJob {name} shrank for a higher-priority job; "
+                f"its grow-back waits in the admission queue")
+            return True
+        reason = (constants.ADMISSION_PREEMPTED_REASON
+                  if waiting == KIND_RESTART
+                  else constants.ADMISSION_QUEUED_REASON)
+        status_machine.update_job_conditions(
+            job.status, constants.JOB_QUEUED, reason,
+            f"PyTorchJob {name} is queued by the fair-share admission "
+            f"queue (namespace quota / cluster headroom)")
+        self.lifecycle.record(job_key, "queued", uid=uid,
+                              trace_id=tracing.current_trace_id())
+        return False
+
+    def _admission_preempt(self, victim_key: str,
+                           waiter_key: str) -> Optional[str]:
+        """Admission-queue callback: drain ``victim_key`` to free quota
+        for the higher-priority ``waiter_key``.  Elastic victims shrink
+        to minReplicas through the checkpoint-drain path; gang
+        non-elastic victims take the legacy full restart, with their
+        recreation gated until the queue re-releases them.  Returns the
+        drain mode applied, or None to refuse (the queue tries the next
+        candidate)."""
+        try:
+            namespace, name = split_meta_namespace_key(victim_key)
+        except ValueError:
+            return None
+        obj = self._get_job_from_cache(namespace, name)
+        if obj is None:
+            return None
+        try:
+            victim = self._job_from_unstructured(obj)
+        except ValidationError:
+            return None
+        set_defaults(victim)
+        if status_machine.is_succeeded(victim.status) or \
+                status_machine.is_failed(victim.status):
+            return None
+        if not self.gang_scheduling_enabled(victim):
+            # a non-gang job loses only single pods to a restart;
+            # preempting it frees no coherent slice
+            return None
+        annotations = victim.metadata.annotations or {}
+        if annotations.get(constants.ANNOTATION_DISRUPTION_HANDLING) == \
+                constants.DISRUPTION_HANDLING_DISABLED:
+            return None
+        uid = victim.metadata.uid or ""
+        source = f"admission:{waiter_key}"
+        # Elastic shrink when the drain would actually begin (mirrors
+        # _begin_elastic_drain's refusals): room above the floor and
+        # resize budget left.  Doom the highest-named workers — stable
+        # and index-dense, so the survivors keep contiguous ranks.
+        doomed: List[str] = []
+        policy = victim.spec.elastic_policy
+        if policy is not None:
+            target = self.elastic_worker_target(victim) or 0
+            floor = policy.min_replicas or 1
+            if target > floor and (victim.status.elastic_resizes or 0) \
+                    < self._elastic_budget(victim):
+                workers = sorted(
+                    (p.get("metadata") or {}).get("name", "")
+                    for p in self.get_pods_for_job(obj)
+                    if ((p.get("metadata") or {}).get("labels") or {}).get(
+                        constants.LABEL_REPLICA_TYPE)
+                    == constants.REPLICA_TYPE_WORKER.lower())
+                doomed = workers[floor:]
+        if doomed:
+            for pod_name in doomed:
+                self._note_disruption(
+                    victim_key, constants.PRIORITY_PREEMPTION_REASON,
+                    source, uid=uid, pod=pod_name)
+            return "elastic"
+        if (victim.status.preemption_restarts or 0) >= \
+                self._preemption_budget(victim):
+            # out of proactive-restart budget: killing the gang now
+            # would strand it (maybe_handle_disruption would refuse and
+            # the gate would still block its pods) — refuse instead
+            return None
+        self._note_disruption(
+            victim_key, constants.PRIORITY_PREEMPTION_REASON,
+            source, uid=uid)
+        return "restart"
+
+    def _admission_released(self, key: str, kind: str) -> None:
+        """Admission-queue callback (queue lock released): wake the
+        job's sync.  A grow-back release also re-arms the elastic grow
+        note — the CapacityWatcher only fires on node edges, and an
+        admission grant is not one, so without the nudge the victim
+        would stay shrunken until an unrelated node event."""
+        if kind == KIND_GROW:
+            with self._disruption_lock:
+                uid = self._shrunken_jobs.get(key, "")
+                self._pending_grows.setdefault(
+                    key, {"node": "admission-grant", "uid": uid})
+        self._queue_for_key(key).add(key)
+
+    def _admission_grow_allowed(self, job: PyTorchJob) -> bool:
+        """DisruptionHandlingMixin hook: an admission-preempted elastic
+        victim holds at its floor while its grow-back entry waits in
+        the fair-share queue — the chips it shed belong to the waiter,
+        and a capacity-edge grow would silently claw them back."""
+        if self.admission is None:
+            return True
+        return self.admission.grow_allowed(job.key)
 
     def satisfied_expectations(self, job: PyTorchJob) -> bool:
         """controller.go:497-516."""
@@ -967,6 +1146,9 @@ class PyTorchController(
             # claim) or its claim starves other shrunken jobs' grows
             # and every capacity event keeps waking it pointlessly
             self.clear_elastic_state(job_key)
+            if self.admission is not None:
+                # freed quota may unblock queued tenants immediately
+                self.admission.note_terminal(job_key)
             if gang:
                 self.delete_pod_group(job_dict)
             if status_machine.is_succeeded(job.status):
@@ -986,7 +1168,7 @@ class PyTorchController(
         # gate re-syncs until the informer has observed every delete, and
         # the following sync recreates the full gang (or reconciles the
         # surviving slice).
-        if self.disruption_handling_enabled() and \
+        if self._disruption_machinery_enabled() and \
                 self.maybe_handle_disruption(job, job_dict, pods):
             if job.status != old_status:
                 self.update_status_handler(job)
@@ -996,8 +1178,22 @@ class PyTorchController(
         # (waiting for checkpoint acks or issuing the shrink deletes); a
         # pending grow / resize completion updates status and falls
         # through so this very sync reconciles toward the new target.
-        if self.disruption_handling_enabled() and \
+        if self._disruption_machinery_enabled() and \
                 self.maybe_continue_elastic(job, job_dict, pods):
+            if job.status != old_status:
+                self.update_status_handler(job)
+            return
+
+        # Multi-tenant admission gate: every non-terminal job is offered
+        # to the fair-share queue before any pod/service work.  A job
+        # the queue has not released parks here with a Queued condition
+        # — its backoff and active-deadline clocks deliberately never
+        # start ticking — until a release callback re-enqueues its key.
+        # Placed AFTER the disruption/elastic blocks so a preemption
+        # victim's drain note is consumed first, and the ledger is
+        # rebuilt lazily from the condition after a shard handover.
+        if self.admission is not None and \
+                not self._admission_gate(job, pods):
             if job.status != old_status:
                 self.update_status_handler(job)
             return
@@ -1018,7 +1214,7 @@ class PyTorchController(
         # and the active-vs-total compare at the stale shrunken size
         # while the full gang is recreated
         total = (get_total_effective_replicas(job)
-                 if self.disruption_handling_enabled()
+                 if self._disruption_machinery_enabled()
                  else get_total_replicas(job))
         prev_failed = get_total_failed_replicas(job)
 
@@ -1070,7 +1266,7 @@ class PyTorchController(
             for rtype, spec in job.spec.pytorch_replica_specs.items():
                 elastic_target = None
                 if rtype == constants.REPLICA_TYPE_WORKER and \
-                        self.disruption_handling_enabled():
+                        self._disruption_machinery_enabled():
                     elastic_target = self.elastic_worker_target(job)
                 self.reconcile_pods(job, job_dict, pods, rtype, spec,
                                     gang_enabled=gang,

@@ -158,6 +158,12 @@ class DisruptionHandlingMixin:
     def disruption_handling_enabled(self) -> bool:
         return self.config.enable_disruption_handling
 
+    def _admission_grow_allowed(self, job: PyTorchJob) -> bool:
+        """Hook for the admission subsystem: False holds a shrunken
+        elastic job at its floor because its grow-back entry still waits
+        in the fair-share queue.  Default (no admission) never blocks."""
+        return True
+
     # -- detection intake --------------------------------------------------
     def _note_disruption(self, job_key: str, reason: str, source: str,
                          uid: Optional[str] = None,
@@ -737,6 +743,16 @@ class DisruptionHandlingMixin:
         goal = min(configured, policy.max_replicas or configured)
         current = self.elastic_worker_target(job) or 0
         if current >= goal:
+            return False
+        if not self._admission_grow_allowed(job):
+            # The freed chips belong to a higher-priority waiter: a
+            # preempted-by-priority job stays shrunken until the
+            # admission queue re-releases its grow-back entry (which
+            # re-arms a grow note and re-enqueues the key).  Declining
+            # here drops the note like a capacity shortfall would.
+            logger_for_job(self.logger, job).info(
+                "grow of %s deferred: its grow-back entry still waits "
+                "in the admission queue", job.key)
             return False
         existing = sum(
             1 for p in pods

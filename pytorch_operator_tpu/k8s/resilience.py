@@ -57,6 +57,13 @@ class ResilienceConfig:
     deadline: float = 30.0
     breaker_threshold: int = 5
     breaker_reset: float = 5.0
+    # Per-tenant fairness in front of the shared endpoint breaker
+    # (--tenant-qps/--tenant-burst): requests scoped to a namespace
+    # additionally acquire that namespace's own token bucket, so one
+    # tenant's retry storm cannot consume another tenant's API quota
+    # (nor trip the shared breaker alone).  0 disables (default).
+    tenant_qps: float = 0.0
+    tenant_burst: int = 10
 
 
 class RetryPolicy:
@@ -375,6 +382,32 @@ def reset_endpoint_breakers() -> None:
         _endpoint_breakers.clear()
 
 
+#: Process-wide per-tenant token buckets, keyed exactly like the
+#: endpoint breakers ((tenant, qps, burst) — config in the key so a
+#: test with different pacing never inherits another test's bucket
+#: state).  Every RestClient in the process shares one bucket per
+#: tenant: that is the point — a tenant's aggregate request rate is
+#: capped no matter how many clients/threads issue on its behalf.
+_tenant_buckets: dict = {}
+_tenant_buckets_lock = make_lock("resilience.tenant-buckets")
+
+
+def bucket_for_tenant(tenant: str, qps: float, burst: int) -> TokenBucket:
+    key = (tenant, float(qps), int(burst))
+    with _tenant_buckets_lock:
+        bucket = _tenant_buckets.get(key)
+        if bucket is None:
+            bucket = TokenBucket(qps, burst)
+            _tenant_buckets[key] = bucket
+        return bucket
+
+
+def reset_tenant_buckets() -> None:
+    """Drop every shared per-tenant bucket (test isolation hook)."""
+    with _tenant_buckets_lock:
+        _tenant_buckets.clear()
+
+
 def build(config: Optional[ResilienceConfig], registry=None,
           endpoint: Optional[str] = None,
           clock: Optional[Callable[[], float]] = None,
@@ -426,6 +459,8 @@ __all__ = [
     "RetryPolicy",
     "TokenBucket",
     "breaker_for_endpoint",
+    "bucket_for_tenant",
     "build",
     "reset_endpoint_breakers",
+    "reset_tenant_buckets",
 ]

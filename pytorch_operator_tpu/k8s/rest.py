@@ -18,6 +18,7 @@ import http.client
 from http.client import HTTPException
 import json
 import os
+import re
 import ssl
 import tempfile
 import threading
@@ -42,6 +43,12 @@ from . import resilience as _resilience
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+#: Tenant extraction for the per-tenant token buckets: the namespace
+#: segment of a namespaced API path.  Cluster-scoped requests (node
+#: lists, CRD reads, the namespace-less job LIST a cluster-wide
+#: operator issues) carry no tenant and ride only the shared limiter.
+_NAMESPACE_RE = re.compile(r"/namespaces/([^/]+)(?:/|$)")
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -169,7 +176,8 @@ class RestClient:
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0, *,
                  retry_policy=None, rate_limiter=None, breaker=None,
-                 metrics=None):
+                 metrics=None, tenant_qps: float = 0.0,
+                 tenant_burst: int = 10):
         """``retry_policy``/``rate_limiter``/``breaker``/``metrics`` are
         the resilience layer (k8s/resilience.py), each independently
         optional: transient failures retried with jittered backoff under
@@ -177,13 +185,22 @@ class RestClient:
         QPS/burst token bucket, and a consecutive-failure circuit
         breaker that fails fast while the apiserver is down.  Watch
         streams and the log endpoints bypass all three — they have their
-        own reconnect loop and must not drain the request budget."""
+        own reconnect loop and must not drain the request budget.
+
+        ``tenant_qps`` > 0 additionally paces namespaced requests
+        through a per-namespace token bucket (shared process-wide via
+        resilience.bucket_for_tenant, keyed like the endpoint breaker),
+        so one tenant's create storm queues behind its own bucket
+        instead of draining the shared limiter ahead of everyone else's
+        requests.  Off by default; cluster-scoped paths are exempt."""
         self.config = config
         self.timeout = timeout
         self.retry_policy = retry_policy
         self.rate_limiter = rate_limiter
         self.breaker = breaker
         self.metrics = metrics
+        self.tenant_qps = float(tenant_qps)
+        self.tenant_burst = int(tenant_burst)
         # Closed-client guard (PR 5/7 residue): the breaker is shared
         # per ENDPOINT across every client in the process, and a client
         # being torn down (sockets closing under in-flight requests)
@@ -304,6 +321,19 @@ class RestClient:
                 waited = self.rate_limiter.acquire()
                 if waited > 0 and self.metrics is not None:
                     self.metrics.observe_throttle_wait(waited)
+            if self.tenant_qps > 0:
+                # per-tenant pacing sits IN FRONT of the shared breaker
+                # strike logic but behind the shared limiter: a hostile
+                # namespace waits on its own bucket (acquired fresh per
+                # attempt — retries are requests too) while
+                # cluster-scoped traffic never pays the tenant toll
+                m = _NAMESPACE_RE.search(path)
+                if m is not None:
+                    waited = _resilience.bucket_for_tenant(
+                        m.group(1), self.tenant_qps,
+                        self.tenant_burst).acquire()
+                    if waited > 0 and self.metrics is not None:
+                        self.metrics.observe_throttle_wait(waited)
             err: Exception
             try:
                 status, data, retry_after = self._send_once(
@@ -851,7 +881,9 @@ class RestCluster:
         self.breaker = breaker
         self.client = RestClient(config, retry_policy=policy,
                                  rate_limiter=limiter, breaker=breaker,
-                                 metrics=metrics)
+                                 metrics=metrics,
+                                 tenant_qps=self.resilience.tenant_qps,
+                                 tenant_burst=self.resilience.tenant_burst)
         self.request_latency = registry.histogram_vec(
             "pytorch_operator_rest_request_duration_seconds",
             "Kubernetes API request latency, by verb and resource "

@@ -186,7 +186,14 @@ def merge_jobs(replica_payloads: List[dict]) -> dict:
         for rec in snap.get("jobs") or []:
             key = rec.get("job", "")
             merged = jobs.setdefault(
-                key, {"job": key, "milestones": {}, "segments": [],
+                key, {"job": key,
+                      # the tenant dimension survives the merge: the
+                      # replica payload carries it (lifecycle.to_dict),
+                      # with the key split as a fallback for payloads
+                      # captured before the field existed
+                      "namespace": rec.get("namespace")
+                      or (key.split("/", 1)[0] if "/" in key else ""),
+                      "milestones": {}, "segments": [],
                       "syncs": [], "replicas": set()})
             merged["replicas"].add(replica)
             for entry in rec.get("milestones") or []:

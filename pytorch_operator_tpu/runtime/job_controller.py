@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.witness import make_lock
 from ..api.v1 import constants
@@ -70,6 +70,12 @@ class JobControllerConfig:
         clock: Optional[Callable[[], float]] = None,
         push_token_secret: str = "",
         job_timeline_max_jobs: int = 2048,
+        enable_admission: bool = False,
+        quota_jobs: int = 0,
+        quota_chips: int = 0,
+        quota_overrides: Optional[Dict[str, Tuple[int, int]]] = None,
+        cluster_max_jobs: int = 0,
+        cluster_max_chips: int = 0,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -128,6 +134,19 @@ class JobControllerConfig:
         # per-job milestone/segment records kept for /debug/jobs before
         # LRU eviction.
         self.job_timeline_max_jobs = max(1, int(job_timeline_max_jobs))
+        # Multi-tenant admission (--enable-admission): per-namespace
+        # quotas (jobs + aggregate google.com/tpu chips, 0 = unlimited;
+        # quota_overrides carves per-namespace exceptions as
+        # {ns: (jobs, chips)}) and cluster-wide ceilings, enforced by a
+        # fair-share DRR queue in front of the reconciler (admission/).
+        # Off by default: the gate is pass-through and no Queued
+        # conditions are ever written.
+        self.enable_admission = enable_admission
+        self.quota_jobs = max(0, int(quota_jobs))
+        self.quota_chips = max(0, int(quota_chips))
+        self.quota_overrides = dict(quota_overrides or {})
+        self.cluster_max_jobs = max(0, int(cluster_max_jobs))
+        self.cluster_max_chips = max(0, int(cluster_max_chips))
 
 
 def _make_runtime_core(clock=None):

@@ -52,6 +52,8 @@ from ..analysis.witness import make_lock
 MILESTONES = (
     "submitted",
     "shard_stamped",
+    "queued",
+    "admitted",
     "first_reconcile",
     "first_pod_created",
     "all_pods_bound",
@@ -98,6 +100,10 @@ class _JobRecord:
         return {
             "job": self.key,
             "uid": self.uid,
+            # the tenant dimension: "who waited, and behind whom" is
+            # queryable straight off /debug/jobs and the stitched view
+            "namespace": self.key.split("/", 1)[0] if "/" in self.key
+            else "",
             "milestones": [dict(e) for e in self.milestones.values()],
             "segments": [dict(s) for s in self.segments],
             "syncs": [dict(s) for s in self.syncs],

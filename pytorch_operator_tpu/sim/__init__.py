@@ -22,14 +22,24 @@ this package grows them into a deliberate one (ROADMAP direction 2):
 
 from .clock import VirtualClock, VirtualTimer
 from .fleet import NodeFleet, NodeProfile
-from .scale import ScaleConfig, run_scale, run_scenario
+from .scale import (
+    ScaleConfig,
+    TenancyConfig,
+    run_scale,
+    run_scenario,
+    run_tenancy,
+    run_tenancy_scenario,
+)
 
 __all__ = [
     "NodeFleet",
     "NodeProfile",
     "ScaleConfig",
+    "TenancyConfig",
     "VirtualClock",
     "VirtualTimer",
     "run_scale",
     "run_scenario",
+    "run_tenancy",
+    "run_tenancy_scenario",
 ]

@@ -2266,6 +2266,155 @@ def render_scale_md(res: dict, jobs: int, workers: int, nodes: int,
     ])
 
 
+TENANCY_BEGIN = "<!-- tenancy:begin -->"
+TENANCY_END = "<!-- tenancy:end -->"
+
+
+def run_tenancy_tier(namespaces: int, jobs_per_ns: int,
+                     hostile_factor: int, quota_jobs: int,
+                     cluster_max_jobs: int, workers: int, nodes: int,
+                     seed: int, arrival_s: float,
+                     max_virtual_s: float) -> dict:
+    """The multi-tenant admission fairness tier: ``namespaces``
+    compliant tenants trickle jobs over the arrival window while one
+    hostile tenant bursts ``hostile_factor`` x a compliant tenant's
+    load at t~0, all through the REAL admission gate
+    (enable_admission=True on the controller under the virtual clock).
+    Runs the scenario twice at the same seed; the committed verdict
+    requires identical fingerprints, zero starvation, a degraded
+    hostile p99 and a bounded compliant p99 (sim.run_tenancy)."""
+    from pytorch_operator_tpu.sim import TenancyConfig
+    from pytorch_operator_tpu.sim.scale import run_tenancy
+
+    cfg = TenancyConfig(
+        namespaces=namespaces, jobs_per_namespace=jobs_per_ns,
+        hostile_factor=hostile_factor, quota_jobs=quota_jobs,
+        cluster_max_jobs=cluster_max_jobs, workers=workers,
+        nodes=nodes, seed=seed, arrival_seconds=arrival_s,
+        max_virtual_seconds=max_virtual_s)
+    return run_tenancy(cfg)
+
+
+def _tenancy_strip(run: dict) -> dict:
+    """Run dict without the full per-namespace table (hundreds of rows;
+    the fingerprint comparison already consumed it and the rendered
+    table keeps the informative extremes)."""
+    return {k: v for k, v in run.items() if k != "per_namespace"}
+
+
+def _tenancy_reading(res: dict) -> str:
+    first = res["runs"][0]
+    if not first["converged"]:
+        return (f"  **Tenancy verdict: did not converge inside the "
+                f"virtual deadline ({first['succeeded']}/"
+                f"{first['jobs_total']} succeeded)** — raise "
+                f"--tenancy-max-virtual or shrink the tier before "
+                f"citing any number here.")
+    if not res["deterministic"]:
+        return ("  **Tenancy verdict: NOT deterministic** — two "
+                "same-seed runs diverged in release order or wait "
+                "quantiles.  A wall-clock or iteration-order dependency "
+                "leaked into the admission queue; find it before "
+                "trusting any fairness number.")
+    if not res["no_tenant_starved"]:
+        return ("  **Tenancy verdict: STARVATION** — at least one "
+                "namespace has submitted jobs that never ran to "
+                "completion.  The DRR pump is not draining every "
+                "flow; this is the exact failure the queue exists to "
+                "prevent.")
+    if not res["fair"]:
+        return ("  **Tenancy verdict: converged but UNFAIR** — the "
+                "hostile tenant's p99 wait is not sufficiently above "
+                "the compliant tenants' (hostile_degraded="
+                f"{res['hostile_degraded']}, compliant_bounded="
+                f"{res['compliant_bounded']}); the flood is leaking "
+                "into everyone's admission latency.")
+    return (
+        f"  **Tenancy verdict: FAIR at {first['jobs_total']} jobs "
+        f"across {first['namespaces']}+1 namespaces** — the hostile "
+        f"tenant's 10x flood queued behind its own quota (p99 wait "
+        f"{first['hostile_wait_p99_s']:.0f}s virtual) while the worst "
+        f"compliant tenant stayed at "
+        f"{first['compliant_wait_p99_max_s']:.0f}s (median "
+        f"{first['compliant_wait_p99_median_s']:.0f}s); every "
+        f"namespace's every job ran to completion, and two same-seed "
+        f"runs fingerprint identically (release order is seeded DRR, "
+        f"not scheduling luck).")
+
+
+def render_tenancy_md(res: dict, seed: int) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    first = res["runs"][0]
+
+    def run_row(label, r):
+        return (f"| {label} | {'yes' if r['converged'] else '**NO**'} | "
+                f"{r['virtual_wall_s']} | {r['real_wall_s']} | "
+                f"{r['succeeded']}/{r['jobs_total']} | "
+                f"{r['hostile_wait_p99_s']} | "
+                f"{r['compliant_wait_p99_max_s']} |")
+
+    def tenant_row(name, s):
+        return (f"| {name} | {s['submitted']} | {s['admitted']} | "
+                f"{s['wait_p50_s']} | {s['wait_p99_s']} | "
+                f"{s['wait_max_s']} |")
+
+    per_ns = first["per_namespace"]
+    worst = sorted(per_ns.items(),
+                   key=lambda kv: -kv[1]["wait_p99_s"])[:5]
+    lines = [
+        TENANCY_BEGIN,
+        f"## Multi-tenant admission fairness ({first['namespaces']} "
+        f"compliant namespaces + 1 hostile, {first['jobs_total']} jobs; "
+        f"quota {first['quota_jobs']} jobs/ns, cluster ceiling "
+        f"{first['cluster_max_jobs']}; deterministic virtual time)",
+        "",
+        f"Generated {now} by `python scripts/bench_control_plane.py "
+        f"--tenancy`.  Every job passes through the real admission "
+        f"gate: it enters Pending with a Queued condition and is "
+        f"released by weighted deficit-round-robin over namespaces.  "
+        f"The hostile namespace submits "
+        f"{first['hostile_jobs']} jobs (10x a compliant tenant) in a "
+        f"burst at t~0; waits are exact per-release observations on "
+        f"the virtual clock, p99 by nearest rank.  Both runs share "
+        f"seed {seed}.",
+        "",
+        "| run | converged | virtual wall s | real wall s | succeeded "
+        "| hostile p99 wait s | worst compliant p99 wait s |",
+        "|---|---|---|---|---|---|---|",
+        run_row("run 1", res["runs"][0]),
+        run_row("run 2", res["runs"][1]),
+        "",
+        "Per-tenant admission waits, run 1 (hostile + the 5 worst "
+        "compliant tenants of "
+        f"{first['namespaces']}; seconds virtual):",
+        "",
+        "| tenant | submitted | admitted | wait p50 | wait p99 "
+        "| wait max |",
+        "|---|---|---|---|---|---|",
+        tenant_row(f"**{first['hostile_namespace']}**",
+                   first["hostile"]),
+    ]
+    lines += [tenant_row(name, stats) for name, stats in worst]
+    lines += [
+        "",
+        _tenancy_reading(res),
+        "",
+        "```json",
+        json.dumps({
+            "deterministic": res["deterministic"],
+            "no_tenant_starved": res["no_tenant_starved"],
+            "hostile_degraded": res["hostile_degraded"],
+            "compliant_bounded": res["compliant_bounded"],
+            "fair": res["fair"],
+            "runs": [_tenancy_strip(r) for r in res["runs"]],
+        }, indent=2),
+        "```",
+        TENANCY_END,
+    ]
+    return "\n".join(lines)
+
+
 def update_md_section(path: str, begin: str, end: str,
                       content: str) -> None:
     """Replace (or append) the delimited section of ``path`` — the
@@ -2891,6 +3040,35 @@ def main() -> None:
                     help="virtual window the job arrivals spread over")
     ap.add_argument("--scale-max-virtual", type=float, default=7200.0,
                     help="virtual-time convergence deadline per run")
+    ap.add_argument("--tenancy", action="store_true",
+                    help="run ONLY the multi-tenant admission fairness "
+                         "tier (hundreds of namespaces churning jobs "
+                         "through the real admission gate on the "
+                         "virtual clock, one hostile tenant bursting "
+                         "10x its quota; two same-seed runs must "
+                         "fingerprint identically) and update the "
+                         "tenancy section of --out")
+    ap.add_argument("--tenancy-namespaces", type=int, default=199,
+                    help="compliant tenant count (the hostile "
+                         "namespace is one more)")
+    ap.add_argument("--tenancy-jobs-per-ns", type=int, default=48)
+    ap.add_argument("--tenancy-hostile-factor", type=int, default=10,
+                    help="hostile namespace submits this many times a "
+                         "compliant tenant's job count, at t~0")
+    ap.add_argument("--tenancy-quota-jobs", type=int, default=4,
+                    help="per-namespace admitted-jobs quota (doubles "
+                         "as the DRR weight)")
+    ap.add_argument("--tenancy-cluster-max-jobs", type=int, default=300,
+                    help="cluster-wide admitted-jobs ceiling (the "
+                         "binding shared constraint)")
+    ap.add_argument("--tenancy-workers", type=int, default=1)
+    ap.add_argument("--tenancy-nodes", type=int, default=500)
+    ap.add_argument("--tenancy-seed", type=int, default=7)
+    ap.add_argument("--tenancy-arrival-s", type=float, default=600.0,
+                    help="compliant arrivals spread over this virtual "
+                         "window (the hostile burst lands in its head)")
+    ap.add_argument("--tenancy-max-virtual", type=float, default=360000.0,
+                    help="virtual-seconds convergence deadline")
     ap.add_argument("--churn-pods", action="store_true",
                     help="run ONLY the pod-informer MODIFIED-burst "
                          "measurement (delivered vs coalescible) and "
@@ -2977,6 +3155,36 @@ def main() -> None:
                                 args.scale_workers, args.scale_nodes,
                                 args.scale_seed, args.scale_alt_seed))
             print(f"[bench_cp] updated scale section of {args.out}",
+                  file=sys.stderr)
+        return
+
+    if args.tenancy:
+        total = (args.tenancy_namespaces * args.tenancy_jobs_per_ns
+                 + args.tenancy_hostile_factor * args.tenancy_jobs_per_ns)
+        print(f"[bench_cp] tenancy ({args.tenancy_namespaces}+1 "
+              f"namespaces, {total} jobs, hostile x"
+              f"{args.tenancy_hostile_factor} burst; two runs at seed "
+              f"{args.tenancy_seed})...", file=sys.stderr)
+        res = run_tenancy_tier(
+            args.tenancy_namespaces, args.tenancy_jobs_per_ns,
+            args.tenancy_hostile_factor, args.tenancy_quota_jobs,
+            args.tenancy_cluster_max_jobs, args.tenancy_workers,
+            args.tenancy_nodes, args.tenancy_seed,
+            args.tenancy_arrival_s, args.tenancy_max_virtual)
+        for i, run in enumerate(res["runs"]):
+            print(json.dumps({"tier": f"tenancy_run{i}",
+                              **_tenancy_strip(run)}))
+        print(json.dumps({"tier": "tenancy",
+                          "deterministic": res["deterministic"],
+                          "no_tenant_starved": res["no_tenant_starved"],
+                          "hostile_degraded": res["hostile_degraded"],
+                          "compliant_bounded": res["compliant_bounded"],
+                          "fair": res["fair"]}))
+        if args.out:
+            update_md_section(
+                args.out, TENANCY_BEGIN, TENANCY_END,
+                render_tenancy_md(res, args.tenancy_seed))
+            print(f"[bench_cp] updated tenancy section of {args.out}",
                   file=sys.stderr)
         return
 

@@ -26,11 +26,15 @@ cd "$(dirname "$0")/.."
 # --fleetview additionally runs the fleet-observability stitching tier
 # (slow: a real subprocess fleet with a SIGKILL handoff, the collector
 # asserting one contiguous per-job timeline across replicas).
+# --tenancy additionally runs the multi-tenant admission fairness tier
+# (slow: the hostile-tenant churn scenario through the real admission
+# gate on the virtual clock, two same-seed runs fingerprint-compared).
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
 RUN_MULTICORE=0
 RUN_FLEETVIEW=0
+RUN_TENANCY=0
 WITNESS_ARGS=()
 DETECTOR_ARGS=()
 for arg in "$@"; do
@@ -40,9 +44,10 @@ for arg in "$@"; do
     --tsan) RUN_TSAN=1 ;;
     --multicore) RUN_MULTICORE=1 ;;
     --fleetview) RUN_FLEETVIEW=1 ;;
+    --tenancy) RUN_TENANCY=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
     --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --witness --mutation-detector)" >&2; exit 2 ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --tenancy --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
@@ -141,6 +146,11 @@ fi
 if [ "$RUN_FLEETVIEW" = 1 ]; then
   echo "=== fleetview: cross-replica timeline stitching tier ==="
   python -m pytest tests/test_fleetview.py -q -m slow
+fi
+
+if [ "$RUN_TENANCY" = 1 ]; then
+  echo "=== tenancy: multi-tenant admission fairness tier ==="
+  python -m pytest tests/test_admission.py -q -m slow
 fi
 
 echo "all checks passed"

@@ -1,0 +1,633 @@
+"""Multi-tenant admission (ISSUE 17): per-namespace quotas, integer
+job priorities, the weighted deficit-round-robin release queue,
+priority preemption through the elastic checkpoint-drain path, and the
+condition-rebuild durability that survives a SIGKILL of the owning
+replica.
+
+Acceptance: a hostile tenant submitting 10x its quota degrades only
+its own admission latency (the small hostile-tenant scenario here, the
+full churn tier under ``@pytest.mark.slow`` via
+``scripts/run-tests.sh --tenancy``); a preempted elastic victim
+checkpoints before any delete with zero duplicate creates while a
+non-elastic victim takes the unchanged legacy restart; and a rebuilt
+admission ledger (fresh controller over the same job objects) loses no
+queued job and admits none twice.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_operator_tpu.admission import (
+    AdmissionController,
+    KIND_GROW,
+    KIND_RESTART,
+    QuotaPolicy,
+    job_chips,
+    job_min_chips,
+    job_priority,
+    parse_quota_overrides,
+)
+from pytorch_operator_tpu.api.v1 import constants
+from pytorch_operator_tpu.api.v1.defaults import set_defaults
+from pytorch_operator_tpu.api.v1.types import ElasticPolicy, PyTorchJob
+from pytorch_operator_tpu.controller import PyTorchController
+from pytorch_operator_tpu.controller import status as status_machine
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.runtime import (
+    FakePodControl,
+    FakeServiceControl,
+    JobControllerConfig,
+)
+from pytorch_operator_tpu.sim import TenancyConfig, run_tenancy
+
+from testutil import new_job, wait_for
+
+
+class FakeClock:
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def admission_job(name, namespace="team-a", workers=2, tpu_chips=4,
+                  priority=None, elastic_min=None) -> PyTorchJob:
+    job = new_job(workers=workers, name=name, namespace=namespace,
+                  tpu_chips=tpu_chips)
+    if elastic_min is not None:
+        job.spec.elastic_policy = ElasticPolicy(min_replicas=elastic_min)
+    if priority is not None:
+        job.spec.priority = priority
+    set_defaults(job)
+    return job
+
+
+# ---------------------------------------------------------------------------
+# Quota accounting (admission/quota.py)
+# ---------------------------------------------------------------------------
+
+
+class TestQuotaAccounting:
+    def test_job_chips_counts_the_whole_gang(self):
+        # master (1x4) + 8 workers (8x4)
+        job = admission_job("j", workers=8, tpu_chips=4)
+        assert job_chips(job) == 36
+
+    def test_job_min_chips_is_the_elastic_floor(self):
+        job = admission_job("j", workers=8, tpu_chips=4, elastic_min=4)
+        # master + minReplicas workers
+        assert job_min_chips(job) == 20
+        # non-elastic jobs have no floor below full size
+        plain = admission_job("p", workers=8, tpu_chips=4)
+        assert job_min_chips(plain) == job_chips(plain) == 36
+
+    def test_job_priority_spec_wins_over_annotation(self):
+        job = admission_job("j", priority=7)
+        job.metadata.annotations = {constants.ANNOTATION_PRIORITY: "3"}
+        assert job_priority(job) == 7
+
+    def test_job_priority_annotation_fallback(self):
+        job = admission_job("j")
+        job.metadata.annotations = {constants.ANNOTATION_PRIORITY: " 5 "}
+        assert job_priority(job) == 5
+
+    def test_job_priority_garbage_annotation_is_unset(self):
+        job = admission_job("j")
+        job.metadata.annotations = {constants.ANNOTATION_PRIORITY: "urgent"}
+        assert job_priority(job) == 0
+
+    def test_job_priority_bool_spec_is_not_one(self):
+        job = admission_job("j")
+        job.spec.priority = True  # bypasses validation, as tests do
+        assert job_priority(job) == 0
+
+    def test_parse_quota_overrides_roundtrip(self):
+        got = parse_quota_overrides("team-a=4:64, team-b=2:0")
+        assert got == {"team-a": (4, 64), "team-b": (2, 0)}
+        assert parse_quota_overrides("") == {}
+        assert parse_quota_overrides(None) == {}
+
+    def test_parse_quota_overrides_rejects_malformed(self):
+        # quota config is security config: never silently dropped
+        with pytest.raises(ValueError):
+            parse_quota_overrides("team-a")
+        with pytest.raises(ValueError):
+            parse_quota_overrides("team-a=4")
+        with pytest.raises(ValueError):
+            parse_quota_overrides("team-a=four:64")
+
+    def test_quota_policy_overrides_and_weight_floor(self):
+        policy = QuotaPolicy(default_jobs=2, default_chips=32,
+                             overrides={"big": (8, 256), "zero": (0, 0)})
+        assert policy.quota_jobs("anyone") == 2
+        assert policy.quota_jobs("big") == 8
+        assert policy.quota_chips("big") == 256
+        assert policy.weight("big") == 8
+        # unlimited namespaces weigh 1, never 0
+        assert policy.weight("zero") == 1
+
+
+# ---------------------------------------------------------------------------
+# DRR fairness under a fake clock (admission/queue.py, no controller)
+# ---------------------------------------------------------------------------
+
+
+def _drr(policy=None, clock=None, preempt=None, **kw):
+    released = []
+    adm = AdmissionController(
+        policy, clock=(clock or FakeClock()).now if clock is None else
+        clock.now, preempt=preempt,
+        on_release=lambda key, kind: released.append((key, kind)), **kw)
+    return adm, released
+
+
+class TestDRRFairness:
+    def _hostile_world(self):
+        clock = FakeClock()
+        adm, released = _drr(QuotaPolicy(default_jobs=1),
+                             clock=clock, cluster_max_jobs=1)
+        jobs = []
+        # the hostile backlog arrives FIRST — a pure-FIFO queue would
+        # drain all 10 before any compliant tenant runs
+        for i in range(10):
+            jobs.append(admission_job(f"h-{i}", namespace="tenant-hostile",
+                                      workers=1, tpu_chips=0))
+        for ns in ("team-a", "team-b"):
+            for i in range(2):
+                jobs.append(admission_job(f"{ns}-{i}", namespace=ns,
+                                          workers=1, tpu_chips=0))
+        for job in jobs:
+            adm.offer(job, has_pods=False)
+        return clock, adm, released, jobs
+
+    def _drain(self, clock, adm, released, total):
+        done = 0
+        while len(released) < total:
+            clock.advance(1.0)
+            adm.note_terminal(released[done][0])
+            done += 1
+        return [key for key, _ in released]
+
+    def test_hostile_backlog_cannot_starve_compliant_tenants(self):
+        clock, adm, released, jobs = self._hostile_world()
+        order = self._drain(clock, adm, released, len(jobs))
+        assert len(order) == 14
+        compliant = {f"team-a/team-a-{i}" for i in range(2)} | {
+            f"team-b/team-b-{i}" for i in range(2)}
+        # one hostile job held the single slot at submit time; every
+        # compliant job is released before the rest of the flood drains
+        assert set(order[1:5]) == compliant
+        assert all(key.startswith("tenant-hostile/") for key in order[5:])
+
+    def test_release_order_is_deterministic(self):
+        first = self._drain(*self._hostile_world()[:3], total=14)
+        repeat = self._drain(*self._hostile_world()[:3], total=14)
+        assert first == repeat
+
+    def test_priority_orders_within_namespace(self):
+        clock = FakeClock()
+        adm, released = _drr(QuotaPolicy(default_jobs=1), clock=clock)
+        low = admission_job("low", workers=1, tpu_chips=0)
+        mid = admission_job("mid", workers=1, tpu_chips=0)
+        high = admission_job("high", workers=1, tpu_chips=0, priority=5)
+        assert adm.offer(low, has_pods=False) is True
+        assert adm.offer(mid, has_pods=False) is False
+        assert adm.offer(high, has_pods=False) is False
+        adm.note_terminal(low.key)
+        # the later-enqueued high-priority job jumps its sibling
+        assert released[-1] == (high.key, "admit")
+        adm.note_terminal(high.key)
+        assert released[-1] == (mid.key, "admit")
+
+    def test_wait_measured_on_the_injected_clock(self):
+        clock = FakeClock()
+        waits = []
+        adm = AdmissionController(
+            QuotaPolicy(default_jobs=1), clock=clock.now,
+            wait_observer=lambda ns, wait, kind: waits.append(
+                (ns, wait, kind)))
+        adm.offer(admission_job("a", workers=1, tpu_chips=0),
+                  has_pods=False)
+        blocked = admission_job("b", workers=1, tpu_chips=0)
+        adm.offer(blocked, has_pods=False)
+        clock.advance(42.0)
+        adm.note_terminal("team-a/a")
+        assert ("team-a", 42.0, "admit") in waits
+
+    def test_chips_quota_blocks_then_frees(self):
+        adm, released = _drr(QuotaPolicy(default_chips=40),
+                             clock=FakeClock())
+        big = admission_job("big", workers=8, tpu_chips=4)      # 36
+        small = admission_job("small", workers=3, tpu_chips=4)  # 16
+        assert adm.offer(big, has_pods=False) is True
+        assert adm.offer(small, has_pods=False) is False
+        snap = adm.snapshot()
+        assert snap["team-a"] == {"admitted_jobs": 1, "chips": 36,
+                                  "waiting": 1}
+        adm.note_terminal(big.key)
+        assert released[-1] == (small.key, "admit")
+        assert adm.snapshot()["team-a"]["chips"] == 16
+
+
+class TestQueuePreemption:
+    def test_elastic_preemption_frees_chips_and_arms_grow_back(self):
+        clock = FakeClock()
+        decisions = []
+
+        def preempt(victim_key, waiter_key):
+            decisions.append((victim_key, waiter_key))
+            return "elastic"
+
+        adm, released = _drr(QuotaPolicy(default_chips=40), clock=clock,
+                             preempt=preempt)
+        victim = admission_job("victim", workers=8, tpu_chips=4,
+                               elastic_min=4)          # 36, floor 20
+        waiter = admission_job("waiter", workers=3, tpu_chips=4,
+                               priority=10)            # 16
+        assert adm.offer(victim, has_pods=False) is True
+        assert adm.offer(waiter, has_pods=False) is True
+        assert decisions == [(victim.key, waiter.key)]
+        assert adm.waiting_kind(victim.key) == KIND_GROW
+        assert adm.grow_allowed(victim.key) is False
+        # victim keeps its floor; waiter got the shed chips
+        assert adm.snapshot()["team-a"]["chips"] == 20 + 16
+        # the waiter finishing releases the grow-back claim
+        adm.note_terminal(waiter.key)
+        assert released[-1] == (victim.key, KIND_GROW)
+        assert adm.grow_allowed(victim.key) is True
+        assert adm.snapshot()["team-a"]["chips"] == 36
+
+    def test_restart_preemption_frees_the_whole_grant(self):
+        adm, released = _drr(QuotaPolicy(default_jobs=1),
+                             clock=FakeClock(),
+                             preempt=lambda v, w: "restart")
+        victim = admission_job("victim", workers=2, tpu_chips=4)
+        waiter = admission_job("waiter", workers=2, tpu_chips=4,
+                               priority=5)
+        assert adm.offer(victim, has_pods=False) is True
+        assert adm.offer(waiter, has_pods=False) is True
+        assert adm.waiting_kind(victim.key) == KIND_RESTART
+        adm.note_terminal(waiter.key)
+        assert released[-1] == (victim.key, KIND_RESTART)
+
+    def test_refused_preemption_leaves_the_waiter_queued(self):
+        adm, _ = _drr(QuotaPolicy(default_jobs=1), clock=FakeClock(),
+                      preempt=lambda v, w: None)
+        victim = admission_job("victim", workers=1, tpu_chips=0)
+        waiter = admission_job("waiter", workers=1, tpu_chips=0,
+                               priority=5)
+        assert adm.offer(victim, has_pods=False) is True
+        # the callback refuses (e.g. budget exhausted): no ledger change
+        assert adm.offer(waiter, has_pods=False) is False
+        assert adm.is_waiting(waiter.key)
+        assert adm.waiting_kind(victim.key) is None
+
+    def test_equal_priority_never_preempts(self):
+        decisions = []
+
+        def preempt(victim_key, waiter_key):
+            decisions.append(victim_key)
+            return "restart"
+
+        adm, _ = _drr(QuotaPolicy(default_jobs=1), clock=FakeClock(),
+                      preempt=preempt)
+        adm.offer(admission_job("first", workers=1, tpu_chips=0),
+                  has_pods=False)
+        assert adm.offer(admission_job("second", workers=1, tpu_chips=0),
+                         has_pods=False) is False
+        assert decisions == []
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: the gate, elastic drain, legacy restart
+# ---------------------------------------------------------------------------
+
+
+def _admission_world(**cfg_kwargs):
+    cluster = FakeCluster()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(enable_admission=True, **cfg_kwargs),
+        registry=Registry())
+    ctl.pod_control = FakePodControl()
+    ctl.service_control = FakeServiceControl()
+    return cluster, ctl
+
+
+def _bound_pod(ctl, job, name, node, rtype="worker", index="0",
+               phase="Running"):
+    labels = dict(ctl.gen_labels(job.metadata.name))
+    labels[constants.LABEL_REPLICA_TYPE] = rtype
+    labels[constants.LABEL_REPLICA_INDEX] = index
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": job.metadata.namespace,
+            "labels": labels,
+            "ownerReferences": [{
+                "apiVersion": constants.API_VERSION,
+                "kind": constants.KIND,
+                "name": job.metadata.name,
+                "uid": job.metadata.uid, "controller": True}],
+        },
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "pytorch", "image": "i"}]},
+        "status": {"phase": phase},
+    }
+
+
+def _gang_pods(cluster, ctl, job):
+    name = job.metadata.name
+    ns = job.metadata.namespace
+    workers = int(job.spec.pytorch_replica_specs[
+        constants.REPLICA_TYPE_WORKER].replicas or 0)
+    pods = [_bound_pod(ctl, job, f"{name}-master-0", "node-m",
+                       rtype="master")]
+    for i in range(workers):
+        pods.append(_bound_pod(ctl, job, f"{name}-worker-{i}",
+                               f"node-{i}", index=str(i)))
+    for pod in pods:
+        cluster.pods.create(ns, pod)
+    return [cluster.pods.get(ns, p["metadata"]["name"]) for p in pods]
+
+
+def _queued_cond(job):
+    return status_machine.get_condition(job.status, constants.JOB_QUEUED)
+
+
+class TestPriorityPreemption:
+    def test_elastic_victim_checkpoints_before_delete_no_dup_creates(self):
+        cluster, ctl = _admission_world(quota_chips=40)
+        victim = admission_job("victim", namespace="default", workers=8,
+                               tpu_chips=4, elastic_min=4)
+        waiter = admission_job("waiter", namespace="default", workers=3,
+                               tpu_chips=4, priority=10)
+        for job in (victim, waiter):
+            cluster.jobs.create("default", job.to_dict())
+        ctl.start_informers()
+        try:
+            assert wait_for(lambda: ctl._get_job_from_cache(
+                "default", "victim") is not None)
+            assert ctl._admission_gate(victim, []) is True
+            pods = _gang_pods(cluster, ctl, victim)
+
+            # the waiter's own gate call triggers the preemption
+            assert ctl._admission_gate(waiter, []) is True
+            assert ctl.admission.waiting_kind(victim.key) == KIND_GROW
+            assert ctl._admission_grow_allowed(victim) is False
+
+            # phase 1: nothing deleted, nothing created — the doomed
+            # tail (workers above the floor) is signalled to checkpoint
+            assert ctl.maybe_handle_disruption(
+                victim, victim.to_dict(), pods) is True
+            assert ctl.pod_control.delete_pod_names == []
+            assert ctl.pod_control.templates == []
+            doomed = [f"victim-worker-{i}" for i in range(4, 8)]
+            for pod_name in doomed:
+                anns = cluster.pods.get("default", pod_name)[
+                    "metadata"]["annotations"]
+                assert constants.ANNOTATION_CHECKPOINT_REQUESTED in anns
+            survivor = cluster.pods.get("default", "victim-worker-0")
+            assert constants.ANNOTATION_CHECKPOINT_REQUESTED not in (
+                survivor["metadata"].get("annotations") or {})
+
+            # phase 2: acks land -> ONLY the doomed tail is deleted
+            for pod_name in doomed:
+                cluster.pods.patch("default", pod_name, {
+                    "metadata": {"annotations": {
+                        constants.ANNOTATION_CHECKPOINTED: "now"}}})
+            pods = cluster.pods.list("default")
+            assert ctl.maybe_continue_elastic(
+                victim, victim.to_dict(), pods) is True
+            assert sorted(ctl.pod_control.delete_pod_names) == doomed
+            assert ctl.pod_control.templates == []  # zero dup creates
+
+            # the shrunken victim keeps running, condition True with
+            # the preempted reason (this IS the durable grow claim)
+            survivors = [p for p in cluster.pods.list("default")
+                         if p["metadata"]["name"] not in doomed]
+            assert ctl._admission_gate(victim, survivors) is True
+            cond = _queued_cond(victim)
+            assert cond is not None and cond.status == "True"
+            assert cond.reason == constants.ADMISSION_PREEMPTED_REASON
+
+            # waiter finishes -> grow-back released and re-armed
+            ctl.admission.note_terminal(waiter.key)
+            assert ctl._admission_grow_allowed(victim) is True
+            with ctl._disruption_lock:
+                assert victim.key in ctl._pending_grows
+        finally:
+            ctl.shutdown()
+
+    def test_non_elastic_victim_takes_the_legacy_restart(self):
+        cluster, ctl = _admission_world(quota_jobs=1)
+        victim = admission_job("victim", namespace="default", workers=2,
+                               tpu_chips=4)
+        waiter = admission_job("waiter", namespace="default", workers=2,
+                               tpu_chips=4, priority=5)
+        for job in (victim, waiter):
+            cluster.jobs.create("default", job.to_dict())
+        ctl.start_informers()
+        try:
+            assert wait_for(lambda: ctl._get_job_from_cache(
+                "default", "victim") is not None)
+            assert ctl._admission_gate(victim, []) is True
+            pods = _gang_pods(cluster, ctl, victim)
+
+            assert ctl._admission_gate(waiter, []) is True
+            assert ctl.admission.waiting_kind(victim.key) == KIND_RESTART
+
+            # unchanged legacy path: one batched gang delete
+            assert ctl.maybe_handle_disruption(
+                victim, victim.to_dict(), pods) is True
+            assert sorted(ctl.pod_control.delete_pod_names) == sorted(
+                p["metadata"]["name"] for p in pods)
+            conds = {c.type: c for c in victim.status.conditions}
+            assert conds[constants.JOB_RESTARTING].status == "True"
+
+            # recreation is gated until the queue re-releases the victim
+            assert ctl._admission_gate(victim, []) is False
+            cond = _queued_cond(victim)
+            assert cond.status == "True"
+            assert cond.reason == constants.ADMISSION_PREEMPTED_REASON
+
+            ctl.admission.note_terminal(waiter.key)
+            assert ctl._admission_gate(victim, []) is True
+        finally:
+            ctl.shutdown()
+
+    def test_preemption_refused_when_restart_budget_exhausted(self):
+        cluster, ctl = _admission_world(quota_jobs=1)
+        victim = admission_job("victim", namespace="default", workers=2,
+                               tpu_chips=4)
+        victim.status.preemption_restarts = 99
+        waiter = admission_job("waiter", namespace="default", workers=2,
+                               tpu_chips=4, priority=5)
+        for job in (victim, waiter):
+            cluster.jobs.create("default", job.to_dict())
+        ctl.start_informers()
+        try:
+            assert wait_for(lambda: ctl._get_job_from_cache(
+                "default", "victim") is not None)
+            assert ctl._admission_gate(victim, []) is True
+            _gang_pods(cluster, ctl, victim)
+            # killing the gang would strand it at the gate: refuse, the
+            # waiter stays queued rather than wedging the victim
+            assert ctl._admission_gate(waiter, []) is False
+            assert ctl.admission.is_waiting(waiter.key)
+            assert ctl.admission.waiting_kind(victim.key) is None
+        finally:
+            ctl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Handover durability: SIGKILL of the owner loses nothing, doubles nothing
+# ---------------------------------------------------------------------------
+
+
+class TestHandoverDurability:
+    def test_sigkill_rebuild_loses_no_job_and_admits_none_twice(self):
+        cluster, ctl1 = _admission_world(quota_jobs=1)
+        job_a = admission_job("job-a", namespace="team-r", workers=1,
+                              tpu_chips=0)
+        job_b = admission_job("job-b", namespace="team-r", workers=1,
+                              tpu_chips=0)
+        assert ctl1._admission_gate(job_a, []) is True
+        assert ctl1._admission_gate(job_b, []) is False
+        cond = _queued_cond(job_b)
+        assert cond.status == "True"
+        assert cond.reason == constants.ADMISSION_QUEUED_REASON
+        pods_a = [_bound_pod(ctl1, job_a, "job-a-master-0", "n0",
+                             rtype="master")]
+
+        # SIGKILL of the owner: a fresh controller (fresh ledger) sees
+        # the same job objects through its informer LIST
+        _, ctl2 = _admission_world(quota_jobs=1)
+        releases = []
+        ctl2.admission.on_release = lambda key, kind: releases.append(
+            (key, kind))
+        # A rebuilds as already-admitted: no second release event
+        assert ctl2._admission_gate(job_a, pods_a) is True
+        assert releases == []
+        # B rebuilds as waiting: the queued job is not lost...
+        assert ctl2._admission_gate(job_b, []) is False
+        assert ctl2.admission.is_waiting(job_b.key)
+        # ...and a re-offer is idempotent (no duplicate ledger entry)
+        assert ctl2._admission_gate(job_b, []) is False
+        snap = ctl2.admission.snapshot()
+        assert snap["team-r"] == {"admitted_jobs": 1, "chips": 0,
+                                  "waiting": 1}
+        # quota frees -> B admitted EXACTLY once
+        ctl2.admission.note_terminal(job_a.key)
+        assert releases == [(job_b.key, "admit")]
+        assert ctl2._admission_gate(job_b, []) is True
+
+    def test_rebuild_restores_a_shrunken_victims_grow_claim(self):
+        # Queued=True + live pods == elastic preemption victim running
+        # at its floor; the new owner must re-charge the floor and
+        # reinstate the grow-back entry, not admit at full size
+        _, ctl = _admission_world(quota_chips=40)
+        # the preemption beneficiary still holds the shed chips, so the
+        # rebuilt grow-back entry must wait instead of releasing
+        holder = admission_job("holder", namespace="default", workers=3,
+                               tpu_chips=4)  # 16 chips
+        holder_pods = [_bound_pod(ctl, holder, "holder-master-0", "n0",
+                                  rtype="master")]
+        assert ctl._admission_gate(holder, holder_pods) is True
+        victim = admission_job("victim", namespace="default", workers=8,
+                               tpu_chips=4, elastic_min=4)
+        status_machine.update_job_conditions(
+            victim.status, constants.JOB_QUEUED,
+            constants.ADMISSION_PREEMPTED_REASON, "shrunken victim")
+        pods = [_bound_pod(ctl, victim, "victim-master-0", "n1",
+                           rtype="master")]
+        assert ctl._admission_gate(victim, pods) is True
+        assert ctl.admission.waiting_kind(victim.key) == KIND_GROW
+        assert ctl._admission_grow_allowed(victim) is False
+        assert ctl.admission.snapshot()["default"]["chips"] == 16 + 20
+
+    def test_rebuild_restores_a_restart_victims_queue_slot(self):
+        # Queued=True + no pods + preempted reason == non-elastic victim
+        # awaiting recreation: it re-enters the queue as a restart entry
+        def restart_victim():
+            victim = admission_job("victim", namespace="default",
+                                   workers=2, tpu_chips=4)
+            status_machine.update_job_conditions(
+                victim.status, constants.JOB_QUEUED,
+                constants.ADMISSION_PREEMPTED_REASON,
+                "awaiting recreation")
+            return victim
+
+        # on an empty queue the rebuilt entry releases immediately —
+        # but as a RESTART release, not a fresh admit
+        releases = []
+        _, ctl = _admission_world(quota_jobs=1)
+        ctl.admission.on_release = lambda key, kind: releases.append(kind)
+        assert ctl._admission_gate(restart_victim(), []) is True
+        assert releases == [KIND_RESTART]
+
+        # under contention it waits in line like any restart entry
+        releases2 = []
+        _, ctl2 = _admission_world(quota_jobs=1)
+        ctl2.admission.on_release = lambda key, kind: releases2.append(kind)
+        blocker = admission_job("blocker", namespace="default", workers=1,
+                                tpu_chips=0)
+        assert ctl2._admission_gate(blocker, []) is True
+        victim = restart_victim()
+        assert ctl2._admission_gate(victim, []) is False
+        assert ctl2.admission.waiting_kind(victim.key) == KIND_RESTART
+        ctl2.admission.note_terminal(blocker.key)
+        assert releases2[-1] == KIND_RESTART
+
+
+# ---------------------------------------------------------------------------
+# Hostile-tenant simulation e2e (sim/scale.py run_tenancy)
+# ---------------------------------------------------------------------------
+
+
+def _small_tenancy_cfg(**overrides):
+    base = dict(namespaces=4, jobs_per_namespace=3, hostile_factor=10,
+                quota_jobs=2, cluster_max_jobs=5, workers=1, nodes=10,
+                seed=7, arrival_seconds=120.0)
+    base.update(overrides)
+    return TenancyConfig(**base)
+
+
+class TestTenancySim:
+    def test_small_hostile_tenant_scenario_is_fair(self):
+        cfg = _small_tenancy_cfg()
+        res = run_tenancy(cfg)
+        first = res["runs"][0]
+        assert first["converged"] is True
+        assert first["succeeded"] == cfg.total_jobs() == 42
+        assert res["deterministic"] is True
+        assert res["no_tenant_starved"] is True
+        assert res["hostile_degraded"] is True
+        assert res["compliant_bounded"] is True
+        assert res["fair"] is True
+        # the flood queued behind its own quota: every compliant tenant
+        # both submitted and finished its full load
+        for stats in first["per_namespace"].values():
+            assert stats["succeeded"] == stats["submitted"] == 3
+        assert first["hostile"]["succeeded"] == cfg.hostile_jobs() == 30
+
+    @pytest.mark.slow
+    def test_tenancy_tier_fairness_at_scale(self):
+        # the run-tests.sh --tenancy tier: a mid-size slice of the
+        # committed bench scenario (the full 10k-job verdict lives in
+        # BENCH_CONTROL_PLANE.md via bench_control_plane.py --tenancy)
+        cfg = _small_tenancy_cfg(namespaces=16, jobs_per_namespace=8,
+                                 quota_jobs=4, cluster_max_jobs=32,
+                                 nodes=40, arrival_seconds=300.0)
+        res = run_tenancy(cfg)
+        first = res["runs"][0]
+        assert first["succeeded"] == cfg.total_jobs() == 208
+        assert res["fair"] is True
+        assert first["hostile_wait_p99_s"] >= 2.0 * max(
+            first["compliant_wait_p99_max_s"], 0.001)

@@ -671,6 +671,64 @@ def test_bench_fleetview_updater_rewrites_only_its_markers(monkeypatch,
     assert "**Reading.**" in text
 
 
+def test_bench_tenancy_updater_rewrites_only_its_markers(monkeypatch,
+                                                         tmp_path):
+    """ISSUE 17: the --tenancy renderer + section updater must rewrite
+    ONLY the tenancy-delimited region — sibling sections and prose
+    outside the markers stay byte-identical, and re-running replaces
+    rather than duplicates.  (The fairness scenario itself runs in
+    tests/test_admission.py; the slow tier via run-tests.sh --tenancy.)"""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    def fake_run():
+        stats = {"submitted": 3, "succeeded": 3, "admitted": 3,
+                 "wait_p50_s": 12.0, "wait_p99_s": 139.9,
+                 "wait_max_s": 141.2}
+        return {"namespaces": 4, "jobs_per_namespace": 3,
+                "hostile_namespace": "tenant-hostile", "hostile_jobs": 30,
+                "jobs_total": 42, "quota_jobs": 2, "cluster_max_jobs": 5,
+                "seed": 7, "converged": True, "succeeded": 42,
+                "virtual_wall_s": 1247.852, "real_wall_s": 3.1,
+                "speedup_virtual_over_real": 402.5,
+                "verb_counts": {"create": 42},
+                "per_namespace": {f"tenant-00{i}": dict(stats)
+                                  for i in range(4)},
+                "hostile": {"submitted": 30, "succeeded": 30,
+                            "admitted": 30, "wait_p50_s": 580.0,
+                            "wait_p99_s": 1166.9, "wait_max_s": 1201.0},
+                "compliant_wait_p99_max_s": 139.9,
+                "compliant_wait_p99_median_s": 120.0,
+                "hostile_wait_p99_s": 1166.9}
+
+    res = {"runs": [fake_run(), fake_run()], "deterministic": True,
+           "no_tenant_starved": True, "hostile_degraded": True,
+           "compliant_bounded": True, "fair": True}
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched prose\n"
+                  + bcp.FLEETVIEW_BEGIN + "\nsibling tier\n"
+                  + bcp.FLEETVIEW_END + "\n")
+    section = bcp.render_tenancy_md(res, 7)
+    bcp.update_md_section(str(md), bcp.TENANCY_BEGIN, bcp.TENANCY_END,
+                          section)
+    text = md.read_text()
+    assert "untouched prose" in text and "sibling tier" in text
+    assert text.count(bcp.TENANCY_BEGIN) == 1
+    assert text.count(bcp.FLEETVIEW_BEGIN) == 1
+    assert "Tenancy verdict: FAIR" in text
+    assert "tenant-hostile" in text
+    # the committed JSON blob drops the per-namespace bulk but keeps
+    # the verdict booleans
+    assert '"fair": true' in text
+    assert '"per_namespace"' not in text
+    # re-running replaces, never duplicates — siblings stay intact
+    bcp.update_md_section(str(md), bcp.TENANCY_BEGIN, bcp.TENANCY_END,
+                          section)
+    text = md.read_text()
+    assert text.count(bcp.TENANCY_BEGIN) == 1
+    assert "sibling tier" in text
+
+
 def test_bench_profile_hotpaths_emits_parseable_ranked_table(
         monkeypatch, tmp_path):
     """ISSUE 15: --profile-hotpaths (a small sim under cProfile here)
