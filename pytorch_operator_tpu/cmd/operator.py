@@ -210,6 +210,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-replica bound on job lifecycle timelines "
                         "kept for /debug/jobs and the phase-duration "
                         "histograms (LRU-evicted beyond this)")
+    p.add_argument("--journal-capacity", type=int, default=4096,
+                   help="per-replica bound on flight-recorder events "
+                        "(lease transitions, ring flips, admission "
+                        "verdicts) kept for /debug/events; evictions "
+                        "beyond this are counted in "
+                        "pytorch_operator_journal_dropped_total")
     p.add_argument("--push-series-budget", type=int, default=256,
                    help="max label sets per pushed metric family; "
                         "over-budget sets are counted in "
@@ -518,6 +524,7 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         shard_renew_interval=max(0.02, shard_renew_interval),
         push_token_secret=args.push_token_secret,
         job_timeline_max_jobs=args.job_timeline_max_jobs,
+        journal_capacity=args.journal_capacity,
         enable_admission=args.enable_admission,
         quota_jobs=args.quota_jobs,
         quota_chips=args.quota_chips,
@@ -551,6 +558,53 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
 
     readyz = make_readyz(controller, stop_event, leader_state, cluster)
 
+    # Autoscale provider: built BEFORE the metrics server starts so
+    # /debug/autoscale can serve from the first request (the sharded
+    # run-loop below reuses the same closure for the gauge).  Each call
+    # re-reads the heartbeat Leases — one Lease LIST per scrape, the
+    # same call membership scans make every renew interval.
+    autoscale_provider = None
+    if config.shard_count > 1:
+        from pytorch_operator_tpu.runtime.autoscaler import (
+            AutoscalePolicy, fleet_loads)
+
+        autoscale_policy = AutoscalePolicy(
+            target_depth_per_replica=max(0.001,
+                                         args.autoscale_target_depth),
+            min_replicas=args.autoscale_min_replicas,
+            max_replicas=args.autoscale_max_replicas)
+        autoscale_lease_store = cluster.resource("leases")
+        # last journaled recommendation: the flight recorder keeps
+        # transitions, not every scrape's restatement of the same number
+        autoscale_last = {"replicas": None}
+
+        def _autoscale_payload() -> dict:
+            loads = fleet_loads(autoscale_lease_store,
+                                namespace=args.namespace or "default")
+            rec = autoscale_policy.recommend(
+                loads, current_shard_count=config.shard_count)
+            if autoscale_last["replicas"] != rec.replicas:
+                autoscale_last["replicas"] = rec.replicas
+                controller.journal.record(
+                    "autoscale_recommendation",
+                    replicas=rec.replicas, shard_count=rec.shard_count,
+                    reason=rec.reason)
+            return {
+                "loads": {replica: {str(shard): depth
+                                    for shard, depth in sorted(
+                                        per_shard.items())}
+                          for replica, per_shard in sorted(loads.items())},
+                "total_depth": sum(d for per_shard in loads.values()
+                                   for d in per_shard.values()),
+                "target_depth_per_replica":
+                    autoscale_policy.target_depth_per_replica,
+                "recommended_replicas": rec.replicas,
+                "recommended_shard_count": rec.shard_count,
+                "reason": rec.reason,
+            }
+
+        autoscale_provider = _autoscale_payload
+
     metrics_server = None
     if args.monitoring_port:
         push_gateway = None
@@ -579,13 +633,18 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
                 registry, series_budget=args.push_series_budget,
                 job_validator=controller.job_informer.store.contains,
                 token_resolver=_push_token_for)
+        from pytorch_operator_tpu.metrics.slo import SloEvaluator
+
         metrics_server = start_metrics_server(
             registry, args.monitoring_port, tracer=tracer,
             health_checks={"healthz": healthz, "readyz": readyz},
-            push_gateway=push_gateway, lifecycle=controller.lifecycle)
+            push_gateway=push_gateway, lifecycle=controller.lifecycle,
+            journal=controller.journal, autoscale=autoscale_provider,
+            slo=SloEvaluator(registry))
         port = metrics_server.server_address[1]
         logger.info("metrics on :%d/metrics (traces on /debug/traces, "
-                    "timelines on /debug/jobs%s)",
+                    "timelines on /debug/jobs, events on /debug/events, "
+                    "slo on /debug/slo%s)",
                     port,
                     ", push on /push/v1/metrics" if push_gateway else "")
         if kubelet is not None and push_gateway is not None:
@@ -621,25 +680,9 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         is_leader_gauge.set(1)
         leader_state["leading"] = True
         # queue-depth autoscale recommendation, recomputed at scrape
-        # time from the fleet's heartbeat-Lease load annotations (one
-        # Lease LIST per scrape — the same call membership scans make
-        # every renew interval)
-        from pytorch_operator_tpu.runtime.autoscaler import (
-            AutoscalePolicy, fleet_loads)
-
-        autoscale_policy = AutoscalePolicy(
-            target_depth_per_replica=max(0.001,
-                                         args.autoscale_target_depth),
-            min_replicas=args.autoscale_min_replicas,
-            max_replicas=args.autoscale_max_replicas)
-        lease_store = cluster.resource("leases")
-
+        # time via the same provider /debug/autoscale serves
         def _recommended_replicas() -> int:
-            loads = fleet_loads(lease_store,
-                                namespace=args.namespace or "default")
-            return autoscale_policy.recommend(
-                loads,
-                current_shard_count=config.shard_count).replicas
+            return autoscale_provider()["recommended_replicas"]
 
         registry.gauge(
             "pytorch_operator_autoscale_recommended_replicas",

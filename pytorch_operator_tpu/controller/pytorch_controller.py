@@ -22,6 +22,7 @@ from ..admission import (
     AdmissionController,
     QuotaPolicy,
 )
+from ..analysis.witness import make_lock
 from ..api.v1 import constants
 from ..api.v1.defaults import set_defaults
 from ..api.v1.types import PyTorchJob
@@ -42,14 +43,17 @@ from ..runtime.expectations import (
 )
 from ..runtime import tracing
 from ..runtime.informer import Informer, split_meta_namespace_key
+from ..runtime.journal import EventJournal, StageClock
 from ..runtime.lifecycle import JobLifecycleTracker
 from ..runtime.job_controller import JobController, JobControllerConfig
 from ..runtime.logger import logger_for_job, logger_for_key
 from ..runtime.recorder import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
 from ..runtime.sharding import (
+    SHARD_LEASE_PREFIX,
     EpochFencedSource,
     ShardManager,
     ring_epoch_of,
+    ring_lease_name,
     shard_of,
     sharded_source,
 )
@@ -150,6 +154,36 @@ class PyTorchController(
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                      1.0, 2.5, 5.0, 10.0, 30.0),
         )
+        # Flight recorder: bounded structured journal of control-plane
+        # EVENTS (lease transitions, ring flips, admission verdicts,
+        # disruption detections), served from /debug/events.  Created
+        # before the disruption watcher and the ShardManager so both
+        # (and every elector the manager mints) write here.  Same clock
+        # pair as the tracer/lifecycle: deterministic under the
+        # simulator.
+        self.journal = EventJournal(
+            capacity=self.config.journal_capacity,
+            clock=self.mono_clock,
+            wall=self.config.clock,
+            replica_id=self.config.replica_id or "")
+        self.journal.dropped_counter = registry.counter(
+            "pytorch_operator_journal_dropped_total",
+            "Flight-recorder events evicted from the bounded "
+            "/debug/events ring before being read (journal loss under "
+            "load)")
+        # stage-timestamp ledger for the shard-acquisition path, keyed
+        # by shard Lease name: CAS-acquired seeds it, informer-sync and
+        # first-reconcile observe their deltas from it
+        self._stage_clock = StageClock(clock=self.mono_clock)
+        self.handoff_stage_duration = registry.histogram_vec(
+            "pytorch_operator_shard_handoff_stage_seconds",
+            "Seconds from shard-Lease CAS acquisition to each later "
+            "handoff stage on this replica (informer sync, first "
+            "reconcile)",
+            ("stage",),
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5, 5.0, 10.0, 30.0),
+        )
         # Disruption subsystem (metrics always registered; the watcher
         # only when --enable-disruption-handling built a node informer).
         self.init_disruption_handling(registry)
@@ -170,6 +204,7 @@ class PyTorchController(
 
             self.replica_id = (self.config.replica_id
                                or f"replica-{_uuid.uuid4().hex[:8]}")
+            self.journal.replica_id = self.replica_id  # uuid minted above
             registry.gauge(
                 "pytorch_operator_owned_shards",
                 "Shard Leases this replica currently holds "
@@ -197,7 +232,8 @@ class PyTorchController(
                 on_ring_flipped=self._on_ring_flipped,
                 migration_sweep=self._run_migration_sweep,
                 load_provider=self._shard_loads,
-                clock=self.config.clock or time.monotonic)
+                clock=self.config.clock or time.monotonic,
+                journal=self.journal)
             # live-reshard observability: the 0/1 migration-window gauge
             # plus the ring epoch itself, so a scrape can tell WHICH
             # ring a replica is reconciling for while the window is open
@@ -233,6 +269,9 @@ class PyTorchController(
         # owns, rebuilt lazily from Queued conditions after a handover
         # (_on_shard_released forgets; the new owner's LIST re-offers).
         self.admission = None
+        # job key -> last journaled admission verdict: the gate runs on
+        # every sync, the flight recorder wants TRANSITIONS
+        self._admission_verdicts: dict = {}
         if self.config.enable_admission:
             self.admission = AdmissionController(
                 QuotaPolicy(default_jobs=self.config.quota_jobs,
@@ -480,7 +519,13 @@ class PyTorchController(
             self._stamp_existing_children(meta, shard, new_epoch)
             stamped += 1
             if stamped >= self.MIGRATION_SWEEP_BATCH:
+                self.journal.record("reshard_sweep", epoch=new_epoch,
+                                    stamped=stamped, done=False)
                 return False  # bounded batch; resume next tick
+        if stamped:
+            # full pass with work done: the NEXT clean pass flips
+            self.journal.record("reshard_sweep", epoch=new_epoch,
+                                stamped=stamped, done=False)
         return stamped == 0
 
     def _on_shard_acquired(self, shard: int) -> None:
@@ -810,10 +855,12 @@ class PyTorchController(
                 return
 
     def process_next_work_item(self, timeout: Optional[float] = None,
-                               queue=None) -> bool:
+                               queue=None, runtime=None) -> bool:
         """controller.go:222-274.  ``queue`` selects a shard's
         workqueue (sharded workers pass their own); default is the
-        controller-wide queue."""
+        controller-wide queue.  ``runtime`` is the calling shard
+        runtime, if any — its first completed pass stamps the
+        first-reconcile handoff stage."""
         queue = queue if queue is not None else self.work_queue
         key, shutdown = queue.get(timeout=timeout)
         if shutdown:
@@ -843,6 +890,8 @@ class PyTorchController(
                                   trace_id=tspan.trace_id)
             self.lifecycle.note_sync(key, trace_id=tspan.trace_id,
                                      result=result, ring_epoch=epoch)
+            if runtime is not None:
+                runtime.note_first_reconcile(key=key, result=result)
             if result == "success":
                 # a re-stamped job's first owned sync under the new
                 # ring ends its ownerless window
@@ -895,6 +944,7 @@ class PyTorchController(
             if self.admission is not None:
                 # quota freed by the deletion may unblock queued tenants
                 self.admission.note_deleted(key)
+            self._admission_verdicts.pop(key, None)
             for rtype in constants.VALID_REPLICA_TYPES:
                 self.expectations.delete_expectations(expectation_pods_key(key, rtype))
                 self.expectations.delete_expectations(expectation_services_key(key, rtype))
@@ -960,6 +1010,14 @@ class PyTorchController(
         name = job.metadata.name
         admitted = self.admission.offer(job, has_pods=bool(pods))
         waiting = self.admission.waiting_kind(job_key)
+
+        def _journal_verdict(verdict: str) -> None:
+            if self._admission_verdicts.get(job_key) != verdict:
+                self._admission_verdicts[job_key] = verdict
+                self.journal.record(
+                    "admission_verdict", job=job_key, verdict=verdict,
+                    namespace=job.metadata.namespace or "default")
+
         if admitted and waiting is None:
             cond = status_machine.get_condition(job.status,
                                                 constants.JOB_QUEUED)
@@ -970,6 +1028,7 @@ class PyTorchController(
                     f"PyTorchJob {name} admitted by the fair-share queue")
             self.lifecycle.record(job_key, "admitted", uid=uid,
                                   trace_id=tracing.current_trace_id())
+            _journal_verdict("admitted")
             return True
         if admitted and waiting == KIND_GROW:
             # elastic preemption victim: keeps running at its shrunken
@@ -981,6 +1040,7 @@ class PyTorchController(
                 constants.ADMISSION_PREEMPTED_REASON,
                 f"PyTorchJob {name} shrank for a higher-priority job; "
                 f"its grow-back waits in the admission queue")
+            _journal_verdict("preempted_grow_queued")
             return True
         reason = (constants.ADMISSION_PREEMPTED_REASON
                   if waiting == KIND_RESTART
@@ -991,6 +1051,8 @@ class PyTorchController(
             f"queue (namespace quota / cluster headroom)")
         self.lifecycle.record(job_key, "queued", uid=uid,
                               trace_id=tracing.current_trace_id())
+        _journal_verdict("preempted" if waiting == KIND_RESTART
+                         else "queued")
         return False
 
     def _admission_preempt(self, victim_key: str,
@@ -1408,6 +1470,20 @@ class _ShardRuntime:
         self.epoch = int(epoch)
         self.controller = controller
         self.pod_index = None  # set by the acquire hooks
+        # the shard Lease this runtime serves: the stage-clock /
+        # flight-recorder key that lets fleetview join this replica's
+        # sync/first-reconcile stamps to the Lease's acquire event
+        mgr = controller.shard_manager
+        self.lease_name = ring_lease_name(
+            mgr.lease_prefix if mgr is not None else SHARD_LEASE_PREFIX,
+            shard, self.epoch)
+        # handoff stage latches: informer syncs count down (the three
+        # start() calls run sequentially on the manager's tick thread);
+        # first reconcile races across worker threads, hence the lock
+        self._unsynced_informers = 3
+        self._first_reconcile_done = False
+        self._stage_lock = make_lock(
+            f"shard-runtime.stages.{self.lease_name}")
         self.queue = WorkQueue(clock=controller.mono_clock)
         # epoch >= 1 rings qualify the queue name: during a migration a
         # next-ring runtime for shard i coexists with the old ring's,
@@ -1433,16 +1509,19 @@ class _ShardRuntime:
             coalesce=lambda key, old, new:
                 controller._coalesce_job_event(key, old, new,
                                                queue=self.queue),
-            clock=controller.mono_clock)
+            clock=controller.mono_clock,
+            on_synced=self._informer_synced)
         self.job_informer.add_event_handler(
             on_add=controller.add_job, on_update=controller.update_job,
             on_delete=controller._job_deleted)
-        self.pod_informer = Informer(pods_src, clock=controller.mono_clock)
+        self.pod_informer = Informer(pods_src, clock=controller.mono_clock,
+                                     on_synced=self._informer_synced)
         self.pod_informer.add_event_handler(
             on_add=controller.add_pod, on_update=controller.update_pod,
             on_delete=controller.delete_pod)
         self.service_informer = Informer(services_src,
-                                         clock=controller.mono_clock)
+                                         clock=controller.mono_clock,
+                                         on_synced=self._informer_synced)
         self.service_informer.add_event_handler(
             on_add=controller.add_service,
             on_delete=controller.delete_service)
@@ -1450,6 +1529,9 @@ class _ShardRuntime:
         self._threads: List[threading.Thread] = []
 
     def start(self, stop_event: threading.Event) -> None:
+        # CAS-acquired stage stamp: every later stage (informer sync,
+        # first reconcile) is observed as a delta from this mark
+        self.controller._stage_clock.mark(self.lease_name, "acquired")
         for informer in (self.job_informer, self.pod_informer,
                          self.service_informer):
             informer.start()
@@ -1460,10 +1542,44 @@ class _ShardRuntime:
             t.start()
             self._threads.append(t)
 
+    def _informer_synced(self) -> None:
+        """One of the trio finished its initial LIST replay; the third
+        completes the ListWatch-synced handoff stage."""
+        with self._stage_lock:
+            self._unsynced_informers -= 1
+            if self._unsynced_informers != 0:
+                return
+        controller = self.controller
+        dt = controller._stage_clock.since(self.lease_name, "acquired")
+        if dt is not None:
+            controller.handoff_stage_duration.labels(
+                stage="acquire_to_sync").observe(dt)
+        controller.journal.record(
+            "shard_synced", lease=self.lease_name, shard=self.shard,
+            epoch=self.epoch, since_acquire_s=dt if dt is not None else 0.0)
+
+    def note_first_reconcile(self, key: str = "",
+                             result: str = "") -> None:
+        """First completed sync pass on this runtime's queue: the last
+        handoff stage — from here the shard is actually being served."""
+        with self._stage_lock:
+            if self._first_reconcile_done:
+                return
+            self._first_reconcile_done = True
+        controller = self.controller
+        dt = controller._stage_clock.since(self.lease_name, "acquired")
+        if dt is not None:
+            controller.handoff_stage_duration.labels(
+                stage="acquire_to_first_reconcile").observe(dt)
+        controller.journal.record(
+            "shard_first_reconcile", lease=self.lease_name,
+            shard=self.shard, epoch=self.epoch, job=key, result=result,
+            since_acquire_s=dt if dt is not None else 0.0)
+
     def _work(self, stop_event: threading.Event) -> None:
         while not stop_event.is_set():
             if not self.controller.process_next_work_item(
-                    timeout=0.5, queue=self.queue):
+                    timeout=0.5, queue=self.queue, runtime=self):
                 return
 
     def synced(self) -> bool:
@@ -1483,4 +1599,5 @@ class _ShardRuntime:
                 stop_watch = getattr(source, "stop_watch", None)
                 if stop_watch is not None:
                     stop_watch()
+        self.controller._stage_clock.clear(self.lease_name)
         self.queue.shutdown()

@@ -150,7 +150,8 @@ class DisruptionHandlingMixin:
             self.disruption_watcher = DisruptionWatcher(
                 self.cluster, self.node_informer,
                 self._note_node_disruption, kind=self.KIND,
-                pod_index=pod_index)
+                pod_index=pod_index,
+                journal=getattr(self, "journal", None))
             self.capacity_watcher = CapacityWatcher(
                 self.node_informer, self._on_capacity_returned,
                 pod_index=capacity_index, cluster=self.cluster)

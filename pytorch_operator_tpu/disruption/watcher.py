@@ -253,6 +253,7 @@ class DisruptionWatcher:
         on_job_disruption: Callable[..., None],
         kind: str = "PyTorchJob",
         pod_index: Optional[PodNodeIndex] = None,
+        journal=None,
     ):
         """``informer`` is a runtime.Informer over ``cluster.nodes``;
         the watcher registers its handlers but leaves start/stop to the
@@ -265,6 +266,10 @@ class DisruptionWatcher:
         self.on_job_disruption = on_job_disruption
         self.kind = kind
         self.pod_index = pod_index
+        # flight recorder (runtime.journal.EventJournal): one
+        # ``disruption_detected`` event per node transition that flags
+        # at least one job
+        self.journal = journal
         self._lock = make_lock("disruption.watcher")
         self._flagged: Dict[str, str] = {}  # node name -> last fired reason
         informer.add_event_handler(
@@ -330,6 +335,10 @@ class DisruptionWatcher:
             except Exception:
                 _log.exception("disruption callback failed for %s", job_key)
         if fired:
+            if self.journal is not None:
+                self.journal.record("disruption_detected",
+                                    node=node_name, reason=reason,
+                                    jobs=fired)
             _log.info("node %s disrupted (%s): flagged %d job(s)",
                       node_name, reason, fired)
 

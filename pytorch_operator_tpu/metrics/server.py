@@ -23,8 +23,20 @@ The rest is the observability layer's debug/ops surface:
   * ``/debug/jobs`` — the lifecycle tracker's per-job timelines
     (milestones, restart/resize/reshard segments, recent syncs) as
     JSON, newest-touched first (``?limit=N`` truncates, ``?job=ns/name``
-    selects one); milestone entries carry trace ids that cross-link
-    into ``/debug/traces``; 404 without a tracker.
+    selects one, ``?namespace=ns`` keeps one tenant's jobs); milestone
+    entries carry trace ids that cross-link into ``/debug/traces``; 404
+    without a tracker.
+  * ``/debug/events`` — the flight recorder's bounded journal of
+    control-plane events (lease transitions, ring flips, admission
+    verdicts, disruption detections) as JSON, oldest first (``?limit=N``
+    keeps the newest N, ``?kind=`` filters); the envelope carries
+    ``dropped`` so ring loss is visible; 404 without a journal.
+  * ``/debug/autoscale`` — the shard autoscaler's inputs and output:
+    the per-shard load payloads read from the heartbeat Leases plus the
+    current recommendation; 404 when autoscaling isn't wired.
+  * ``/debug/slo`` — the declared objectives' verdicts (burn rates over
+    the existing histograms/counters, freshly evaluated per request);
+    404 without an evaluator.
   * ``/healthz`` — liveness; 200 while the process serves, 503 once the
     registered check fails (e.g. shutdown began).
   * ``/readyz`` — readiness; reflects informer sync and leader state
@@ -62,6 +74,9 @@ def start_metrics_server(
     health_checks: Optional[Dict[str, HealthCheck]] = None,
     push_gateway=None,
     lifecycle=None,
+    journal=None,
+    autoscale=None,
+    slo=None,
 ) -> ThreadingHTTPServer:
     """Serve the operator HTTP surface in a daemon thread.
 
@@ -71,7 +86,11 @@ def start_metrics_server(
     ``"readyz"`` to ``() -> (ok, detail)`` callables; ``push_gateway``
     (telemetry.PushGateway) enables ``POST /push/v1/metrics``;
     ``lifecycle`` (runtime.lifecycle.JobLifecycleTracker) enables
-    /debug/jobs.
+    /debug/jobs; ``journal`` (runtime.journal.EventJournal) enables
+    /debug/events; ``autoscale`` (a zero-arg callable returning the
+    JSON-ready loads+recommendation document) enables /debug/autoscale;
+    ``slo`` (metrics.slo.SloEvaluator) enables /debug/slo and refreshes
+    the SLO gauge series before every /metrics exposition.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -90,6 +109,14 @@ def start_metrics_server(
             url = urllib.parse.urlparse(self.path)
             path = url.path.rstrip("/")
             if path in ("", "/metrics"):
+                if slo is not None:
+                    # refresh the SLO gauges BEFORE rendering (plain
+                    # set() values — a scrape-time set_function calling
+                    # expose() would deadlock on the histogram locks)
+                    try:
+                        slo.evaluate()
+                    except Exception:  # a broken objective must not take /metrics down with it
+                        pass
                 # content negotiation: only an explicit OpenMetrics
                 # Accept gets exemplars; Prometheus < 2.43 and curl
                 # keep receiving the unchanged text 0.0.4 bytes
@@ -123,17 +150,56 @@ def start_metrics_server(
                     return
                 limit = None
                 job = None
+                namespace = None
                 try:
                     q = urllib.parse.parse_qs(url.query)
                     if "limit" in q:
                         limit = max(0, int(q["limit"][0]))
                     if "job" in q:
                         job = q["job"][0]
+                    if "namespace" in q:
+                        namespace = q["namespace"][0]
                 except ValueError:
                     self._send_json(400, {"error": "limit must be an int"})
                     return
-                self._send_json(200, lifecycle.snapshot(limit=limit,
-                                                        job=job))
+                self._send_json(200, lifecycle.snapshot(
+                    limit=limit, job=job, namespace=namespace))
+            elif path == "/debug/events":
+                if journal is None:
+                    self._send_json(404, {"error": "journal not enabled"})
+                    return
+                limit = None
+                kind = None
+                try:
+                    q = urllib.parse.parse_qs(url.query)
+                    if "limit" in q:
+                        limit = max(0, int(q["limit"][0]))
+                    if "kind" in q:
+                        kind = q["kind"][0]
+                except ValueError:
+                    self._send_json(400, {"error": "limit must be an int"})
+                    return
+                self._send_json(200, journal.snapshot(limit=limit,
+                                                      kind=kind))
+            elif path == "/debug/autoscale":
+                if autoscale is None:
+                    self._send_json(404,
+                                    {"error": "autoscaling not enabled"})
+                    return
+                try:
+                    self._send_json(200, autoscale())
+                except Exception as e:  # surface, don't crash the server
+                    self._send_json(500, {"error": repr(e)})
+            elif path == "/debug/slo":
+                if slo is None:
+                    self._send_json(404,
+                                    {"error": "slo evaluation not "
+                                              "enabled"})
+                    return
+                try:
+                    self._send_json(200, slo.evaluate())
+                except Exception as e:
+                    self._send_json(500, {"error": repr(e)})
             elif path in ("/healthz", "/readyz"):
                 check = (health_checks or {}).get(path.lstrip("/"))
                 if check is None:

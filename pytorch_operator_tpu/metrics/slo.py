@@ -1,0 +1,268 @@
+"""SLO layer: declared objectives evaluated as burn rates over the
+metrics the operator already exports.
+
+The observability stack measures everything and judges nothing: the
+fairness/capacity work (admission wait histograms), the handoff work
+(stage-resolved acquisition timings) and the push gateway (reject
+counters) all emit series, but "is the fleet meeting its objectives
+RIGHT NOW" still requires a human with a PromQL prompt.  This module
+closes that loop in-process:
+
+  * an :class:`SloObjective` declares a target over an existing family
+    — "99% of shard handoffs reach first reconcile within 5s", "99.9%
+    of reconciles finish within 1s" — either as a histogram threshold
+    or a counter good/bad ratio;
+  * :class:`SloEvaluator` re-reads the registry's own text exposition
+    (one parse per evaluation, no second bookkeeping path that could
+    drift from what operators actually scrape) and reports each
+    objective's **burn rate**: the fraction of events out of objective
+    divided by the error budget (``1 - target``).  Burn 1.0 means the
+    budget is being consumed exactly as provisioned; above it the
+    objective is being missed;
+  * verdicts surface twice — as ``pytorch_operator_slo_burn_rate`` /
+    ``pytorch_operator_slo_ok`` gauge series on ``/metrics``, and as a
+    JSON verdict document on ``/debug/slo``.
+
+Deadlock note: every metric lock in :mod:`metrics.prometheus` is
+non-reentrant, so the SLO gauges are plain ``set()`` values refreshed
+by :meth:`SloEvaluator.evaluate` — NEVER ``set_function`` callbacks
+(a scrape-time callback re-entering ``registry.expose`` would deadlock
+on the histogram locks it is being rendered under).  The metrics
+server calls ``evaluate()`` immediately before ``expose()`` instead.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from ..runtime.fleetview import parse_histograms
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+(\S+)')
+
+
+def counter_total(text: str, name: str) -> float:
+    """Sum every sample of counter ``name`` (all label sets) in a
+    text-0.0.4 exposition."""
+    total = 0.0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None or m.group(1) != name:
+            continue
+        try:
+            total += float(m.group(2))
+        except ValueError:
+            continue
+    return total
+
+
+class SloObjective:
+    """One declared objective.
+
+    ``kind`` selects the evaluation:
+
+    * ``"histogram"`` — ``target`` of ``family`` observations must fall
+      at or under ``threshold`` seconds.  ``threshold`` must sit on a
+      declared bucket bound (cumulative buckets cannot be interpolated
+      honestly; the constructor does not check, the evaluation simply
+      uses the smallest bucket >= threshold).  ``match_labels``
+      restricts to series carrying those label values; ``per_label``
+      names a label to slice by, with the verdict reporting the WORST
+      slice (the per-tenant admission objective uses this — a fleet
+      aggregate would let one starved tenant hide inside nine happy
+      ones).
+    * ``"ratio"`` — bad events ``bad_counter`` over total events
+      ``total_counter``; the bad fraction must stay under
+      ``1 - target``.
+    """
+
+    def __init__(self, name: str, description: str, *, kind: str,
+                 target: float, family: str = "",
+                 threshold: float = 0.0,
+                 match_labels: Optional[Dict[str, str]] = None,
+                 per_label: str = "",
+                 bad_counter: str = "", total_counter: str = ""):
+        if kind not in ("histogram", "ratio"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        if not 0.0 < target < 1.0:
+            raise ValueError("target must be in (0, 1) — the error "
+                             "budget is 1 - target")
+        self.name = name
+        self.description = description
+        self.kind = kind
+        self.target = float(target)
+        self.family = family
+        self.threshold = float(threshold)
+        self.match_labels = dict(match_labels or {})
+        self.per_label = per_label
+        self.bad_counter = bad_counter
+        self.total_counter = total_counter
+
+    # -- evaluation helpers ------------------------------------------------
+
+    def _series_counts(self, series: dict) -> tuple:
+        """(good, total) for one parsed histogram series: good = the
+        cumulative count at the smallest bucket bound >= threshold."""
+        total = float(series.get("count") or 0.0)
+        good = 0.0
+        best = None
+        for le, cumulative in series.get("buckets") or []:
+            try:
+                bound = float(le)
+            except ValueError:  # +Inf
+                bound = float("inf")
+            if bound >= self.threshold and (best is None or bound < best):
+                best = bound
+                good = float(cumulative)
+        return min(good, total), total
+
+    def counts(self, text: str) -> dict:
+        """{"bad", "total", optional "worst"} for this objective over
+        one exposition text."""
+        if self.kind == "ratio":
+            total = counter_total(text, self.total_counter)
+            bad = min(counter_total(text, self.bad_counter), total)
+            return {"bad": bad, "total": total}
+        series_map = parse_histograms(text, (self.family,))[self.family]
+        slices: Dict[str, List[float]] = {}
+        for series in series_map.values():
+            labels = series.get("labels") or {}
+            if any(labels.get(k) != v
+                   for k, v in self.match_labels.items()):
+                continue
+            good, total = self._series_counts(series)
+            key = (labels.get(self.per_label, "")
+                   if self.per_label else "")
+            agg = slices.setdefault(key, [0.0, 0.0])
+            agg[0] += total - good
+            agg[1] += total
+        if not slices:
+            return {"bad": 0.0, "total": 0.0}
+        if not self.per_label:
+            bad, total = slices[""]
+            return {"bad": bad, "total": total}
+        # worst slice governs: rank by bad fraction, break ties by
+        # volume then name so the verdict is deterministic
+        worst = max(sorted(slices),
+                    key=lambda k: ((slices[k][0] / slices[k][1])
+                                   if slices[k][1] else 0.0,
+                                   slices[k][1]))
+        bad, total = slices[worst]
+        return {"bad": bad, "total": total, "worst": worst}
+
+
+def default_objectives() -> List[SloObjective]:
+    """The operator's declared objectives.  Thresholds sit on declared
+    bucket bounds of their families (see each family's constructor)."""
+    return [
+        SloObjective(
+            "handoff_first_reconcile",
+            "99% of shard acquisitions reach their first completed "
+            "reconcile within 5s of the Lease CAS",
+            kind="histogram", target=0.99,
+            family="pytorch_operator_shard_handoff_stage_seconds",
+            match_labels={"stage": "acquire_to_first_reconcile"},
+            threshold=5.0),
+        SloObjective(
+            "admission_wait_per_tenant",
+            "99% of each tenant's admissions wait under 300s in the "
+            "fair-share queue (worst tenant governs)",
+            kind="histogram", target=0.99,
+            family="pytorch_operator_admission_wait_seconds",
+            per_label="namespace", threshold=300.0),
+        SloObjective(
+            "reconcile_duration",
+            "99.9% of sync_job passes finish within 1s",
+            kind="histogram", target=0.999,
+            family="pytorch_operator_reconcile_duration_seconds",
+            threshold=1.0),
+        SloObjective(
+            "push_reject_rate",
+            "99% of telemetry push samples are accepted by the "
+            "gateway (rejects burn the budget)",
+            kind="ratio", target=0.99,
+            bad_counter="pytorch_operator_push_rejected_total",
+            total_counter="pytorch_operator_push_samples_total"),
+    ]
+
+
+class SloEvaluator:
+    """Evaluates declared objectives against ``registry`` and publishes
+    the verdicts.
+
+    ``evaluate()`` is cheap (one exposition render + text parse) and
+    re-entrancy-safe to call from any request thread; the metrics
+    server invokes it on every ``/metrics`` and ``/debug/slo`` hit so
+    the gauge series are at most one scrape stale.
+    """
+
+    def __init__(self, registry, objectives=None):
+        self.registry = registry
+        self.objectives = (list(objectives) if objectives is not None
+                           else default_objectives())
+        self._burn_gauge = registry.gauge_vec(
+            "pytorch_operator_slo_burn_rate",
+            "Lifetime error-budget burn rate per declared objective "
+            "(bad fraction / error budget; 1.0 consumes the budget "
+            "exactly, above it the objective is missed)",
+            ("objective",))
+        self._ok_gauge = registry.gauge_vec(
+            "pytorch_operator_slo_ok",
+            "1 while the objective's burn rate is within budget "
+            "(<= 1.0), 0 while it is being missed",
+            ("objective",))
+        # objective -> (bad, total) at the previous evaluation: the
+        # window burn rate judges only what happened since, so a
+        # long-healed incident stops dominating the verdict
+        self._last: Dict[str, tuple] = {}
+
+    def evaluate(self) -> dict:
+        """Re-read the registry and refresh gauges; returns the
+        ``/debug/slo`` verdict document."""
+        # NOTE: expose() is called here, OUTSIDE any metric lock; the
+        # resulting set() calls below take each gauge's lock briefly
+        text = self.registry.expose()
+        verdicts = []
+        for objective in self.objectives:
+            counts = objective.counts(text)
+            bad, total = counts["bad"], counts["total"]
+            budget = 1.0 - objective.target
+            bad_fraction = (bad / total) if total else 0.0
+            burn = bad_fraction / budget
+            prev_bad, prev_total = self._last.get(
+                objective.name, (0.0, 0.0))
+            dbad = max(0.0, bad - prev_bad)
+            dtotal = max(0.0, total - prev_total)
+            window_burn = ((dbad / dtotal) / budget) if dtotal else 0.0
+            self._last[objective.name] = (bad, total)
+            ok = burn <= 1.0
+            self._burn_gauge.labels(objective=objective.name).set(burn)
+            self._ok_gauge.labels(objective=objective.name).set(
+                1 if ok else 0)
+            verdict = {
+                "objective": objective.name,
+                "description": objective.description,
+                "target": objective.target,
+                "bad": bad,
+                "total": total,
+                "bad_fraction": round(bad_fraction, 9),
+                "burn_rate": round(burn, 6),
+                "window_burn_rate": round(window_burn, 6),
+                "ok": ok,
+            }
+            if objective.kind == "histogram":
+                verdict["threshold_s"] = objective.threshold
+            if "worst" in counts:
+                verdict["worst_" + objective.per_label] = counts["worst"]
+            verdicts.append(verdict)
+        return {
+            "objectives": verdicts,
+            "ok": all(v["ok"] for v in verdicts),
+        }
+
+
+__all__ = ["SloEvaluator", "SloObjective", "counter_total",
+           "default_objectives"]

@@ -19,10 +19,19 @@ that job sit ownerless?".  This module is the merge:
     attached;
   * :func:`phase_stats` — per-phase p50/p99 over the MERGED timelines
     (milestone deltas in wall order, closed segments by span);
-  * :func:`handoff_gaps` — the ownerless window: consecutive sync
-    records for one job coming from DIFFERENT replicas bound the wall
-    time nobody reconciled the key — the fleet-level number the
-    ``--multicore`` SIGKILL and live-reshard rounds commit;
+  * :func:`handoff_gaps` — the sync-gap UPPER BOUND on the ownerless
+    window: consecutive sync records for one job coming from DIFFERENT
+    replicas bound the wall time nobody reconciled the key.  Quiet time
+    before the disruption inflates it (the previous owner's last sync
+    may predate its death by however long the job was idle), so treat
+    it strictly as a bound;
+  * :func:`merge_journals` / :func:`handoff_windows` — the EXACT
+    per-shard ownerless window: flight-recorder events
+    (``/debug/events``) merged across replicas reconstruct each shard
+    Lease's vacancy — anchored at the holder's last renewal (crash),
+    the voluntary release (planned handoff) or the reshard begin (fresh
+    ring) — and decompose it into detection / acquisition /
+    informer-sync / first-reconcile stages;
   * :func:`parse_histograms` / :func:`merge_cost_profile` — the
     text-0.0.4 histogram scrape and its cross-replica sum, serialized
     as the sim-consumable reconcile-cost artifact
@@ -72,6 +81,14 @@ def scrape_replica(base_url: str, timeout: float = 5.0) -> dict:
             _get_text(base + "/debug/traces", timeout))
     except Exception as e:  # noqa: BLE001 — any scrape failure is data
         out["error"] = repr(e)
+        return out
+    try:
+        # its own try: a replica built without the flight recorder
+        # still contributes its other three surfaces
+        out["events"] = json.loads(
+            _get_text(base + "/debug/events", timeout))
+    except Exception:  # noqa: BLE001  # lint: swallowed-except-ok a replica predating the flight recorder still contributes its other surfaces
+        pass
     return out
 
 
@@ -167,7 +184,8 @@ def merge_cost_profile(metrics_texts: List[str],
 
 # -- timeline merge ---------------------------------------------------------
 
-def merge_jobs(replica_payloads: List[dict]) -> dict:
+def merge_jobs(replica_payloads: List[dict],
+               namespace: Optional[str] = None) -> dict:
     """Union the per-replica ``/debug/jobs`` payloads into one
     fleet-wide timeline per job.
 
@@ -176,7 +194,9 @@ def merge_jobs(replica_payloads: List[dict]) -> dict:
     EARLIEST wall timestamp winning — an idempotent milestone recorded
     again by a later owner is the duplicate, the first observation is
     the fact.  Segments and sync records concatenate in wall order,
-    each carrying the replica that recorded it."""
+    each carrying the replica that recorded it.  ``namespace`` keeps
+    one tenant's jobs — the fleet-level twin of
+    ``/debug/jobs?namespace=``."""
     jobs: dict = {}
     for payload in replica_payloads:
         if "error" in payload:
@@ -185,6 +205,11 @@ def merge_jobs(replica_payloads: List[dict]) -> dict:
         replica = snap.get("replica", "")
         for rec in snap.get("jobs") or []:
             key = rec.get("job", "")
+            if namespace is not None:
+                rec_ns = (rec.get("namespace")
+                          or (key.split("/", 1)[0] if "/" in key else ""))
+                if rec_ns != namespace:
+                    continue
             merged = jobs.setdefault(
                 key, {"job": key,
                       # the tenant dimension survives the merge: the
@@ -214,6 +239,176 @@ def merge_jobs(replica_payloads: List[dict]) -> dict:
         merged["syncs"].sort(key=lambda s: s.get("wall", 0.0))
         merged["replicas"] = sorted(merged["replicas"])
     return jobs
+
+
+def merge_journals(replica_payloads: List[dict]) -> dict:
+    """Union the per-replica ``/debug/events`` flight-recorder payloads
+    into one fleet-wide event sequence.
+
+    Events are tagged with the recording replica and ordered by
+    ``(wall, replica, seq)`` — wall clocks across processes on one host
+    are comparable enough for ordering (the windows measured are
+    multi-second; NTP-grade skew is noise), and the replica/seq
+    tiebreak keeps the merge deterministic.  Drop accounting sums
+    across replicas so consumers know when the sequence has holes."""
+    events: List[dict] = []
+    recorded = 0
+    dropped = 0
+    for payload in replica_payloads:
+        if "error" in payload:
+            continue
+        journal = payload.get("events")
+        if not journal:
+            continue
+        replica = journal.get("replica", "")
+        recorded += int(journal.get("recorded") or 0)
+        dropped += int(journal.get("dropped") or 0)
+        for event in journal.get("events") or []:
+            tagged = dict(event)
+            tagged["replica"] = replica
+            events.append(tagged)
+    events.sort(key=lambda e: (e.get("wall", 0.0),
+                               e.get("replica", ""),
+                               e.get("seq", 0)))
+    return {"events": events, "recorded": recorded, "dropped": dropped}
+
+
+def handoff_windows(merged_journal: dict,
+                    lease_prefix: str = "pytorch-operator-shard"
+                    ) -> List[dict]:
+    """The EXACT per-shard ownerless windows, stage-resolved, from the
+    merged flight recorder.
+
+    For every shard-Lease acquisition the window is anchored at the
+    moment the shard actually lost service:
+
+    * **crash** — a ``lease_expiry_observed`` event precedes the
+      acquisition; the vacancy starts at the dead holder's last
+      locally-observed renewal (``event.wall - stale_s``, minimized
+      across observers), NOT at the observation — waiting out the lease
+      is part of the cost being measured;
+    * **planned** — a ``lease_released`` precedes it; the vacancy
+      starts at the release (an empty holder is immediately
+      acquirable);
+    * **reshard** — the lease's first acquisition on a fresh ring
+      (``via=created`` with no prior anchor); the vacancy starts at the
+      matching ``reshard_begin`` — jobs moving onto the new ring are
+      unserved from the moment the migration target was observed.
+
+    Stages: ``detection`` (vacancy start -> first expiry observation;
+    0.0 for planned/reshard — nothing to detect), ``acquisition``
+    (detection end -> CAS acquired), ``informer_sync`` (acquired ->
+    the owner's ``shard_synced``), ``first_reconcile`` (synced -> the
+    owner's ``shard_first_reconcile``).  ``window_s`` is the full
+    vacancy-start -> first-reconcile span — the number the sync-gap
+    estimate (:func:`handoff_gaps`) upper-bounds.  Acquisitions whose
+    later stages never happened (an empty shard reconciles nothing)
+    report the stages they reached and ``window_s`` None.  First-ever
+    epoch-0 acquisitions with no anchor (fleet boot) are skipped: there
+    was no handoff."""
+    by_lease: Dict[str, List[dict]] = {}
+    reshard_begin_wall: Dict[int, float] = {}
+    for event in merged_journal.get("events") or []:
+        kind = event.get("kind", "")
+        if kind == "reshard_begin":
+            epoch = int(event.get("epoch") or 0)
+            wall = event.get("wall", 0.0)
+            # earliest replica to observe the target anchors the epoch
+            if epoch not in reshard_begin_wall \
+                    or wall < reshard_begin_wall[epoch]:
+                reshard_begin_wall[epoch] = wall
+        lease = event.get("lease", "")
+        if lease.startswith(lease_prefix + "-"):
+            by_lease.setdefault(lease, []).append(event)
+
+    windows: List[dict] = []
+    for lease in sorted(by_lease):
+        # anchor state since the previous acquisition of this lease
+        release_wall: Optional[float] = None
+        expiry_start: Optional[float] = None  # min(wall - stale_s)
+        expiry_seen: Optional[float] = None   # min(wall)
+        current: Optional[dict] = None        # the open window
+        for event in by_lease[lease]:
+            kind = event.get("kind", "")
+            wall = event.get("wall", 0.0)
+            if kind == "lease_released":
+                release_wall = wall
+                current = None
+            elif kind == "lease_expiry_observed":
+                start = wall - float(event.get("stale_s") or 0.0)
+                if expiry_start is None or start < expiry_start:
+                    expiry_start = start
+                if expiry_seen is None or wall < expiry_seen:
+                    expiry_seen = wall
+            elif kind == "lease_acquired":
+                current = None
+                epoch = _lease_epoch(lease, lease_prefix)
+                if expiry_start is not None:
+                    handoff_kind = "crash"
+                    start = expiry_start
+                    detection = max(0.0, (expiry_seen or wall) - start)
+                    acq_base = expiry_seen if expiry_seen is not None \
+                        else start
+                elif release_wall is not None:
+                    handoff_kind = "planned"
+                    start = release_wall
+                    detection = 0.0
+                    acq_base = start
+                elif (event.get("via") == "created"
+                        and epoch in reshard_begin_wall):
+                    handoff_kind = "reshard"
+                    start = reshard_begin_wall[epoch]
+                    detection = 0.0
+                    acq_base = start
+                else:
+                    # unanchored (fleet boot): no handoff to measure
+                    release_wall = None
+                    expiry_start = expiry_seen = None
+                    continue
+                current = {
+                    "lease": lease,
+                    "epoch": epoch,
+                    "kind": handoff_kind,
+                    "to_replica": event.get("replica", ""),
+                    "start_wall": start,
+                    "acquired_wall": wall,
+                    "stages": {
+                        "detection": round(detection, 6),
+                        "acquisition": round(
+                            max(0.0, wall - acq_base), 6),
+                    },
+                    "window_s": None,
+                }
+                windows.append(current)
+                release_wall = None
+                expiry_start = expiry_seen = None
+            elif kind == "shard_synced" and current is not None \
+                    and event.get("replica") == current["to_replica"]:
+                current["stages"]["informer_sync"] = round(
+                    max(0.0, wall - current["acquired_wall"]), 6)
+                current["synced_wall"] = wall
+            elif kind == "shard_first_reconcile" and current is not None \
+                    and event.get("replica") == current["to_replica"]:
+                base = current.get("synced_wall",
+                                   current["acquired_wall"])
+                current["stages"]["first_reconcile"] = round(
+                    max(0.0, wall - base), 6)
+                current["window_s"] = round(
+                    max(0.0, wall - current["start_wall"]), 6)
+                current = None
+    windows.sort(key=lambda w: (w["start_wall"], w["lease"]))
+    return windows
+
+
+def _lease_epoch(lease: str, prefix: str) -> int:
+    """Ring epoch encoded in a shard-Lease name (``<prefix>-e<n>-<i>``;
+    the un-suffixed legacy form is epoch 0)."""
+    rest = lease[len(prefix) + 1:]
+    if rest.startswith("e") and "-" in rest:
+        head = rest.split("-", 1)[0][1:]
+        if head.isdigit():
+            return int(head)
+    return 0
 
 
 def percentile(values: List[float], q: float) -> Optional[float]:
@@ -300,15 +495,26 @@ def fleet_view(replica_payloads: List[dict]) -> dict:
             entry["timeline_evicted"] = snap.get("evicted", 0)
             entry["traces_dropped"] = (payload.get("traces")
                                        or {}).get("dropped", 0)
+            entry["journal_dropped"] = (payload.get("events")
+                                        or {}).get("dropped", 0)
         replicas.append(entry)
     gaps = handoff_gaps(merged)
     stitched = sum(1 for rec in merged.values()
                    if len(rec["replicas"]) > 1)
+    journal = merge_journals(replica_payloads)
+    windows = handoff_windows(journal)
+    complete = [w["window_s"] for w in windows
+                if w["window_s"] is not None]
     return {
         "replicas": replicas,
         "jobs": {key: {**rec} for key, rec in merged.items()},
         "phases": phase_stats(merged),
         "handoffs": gaps,
         "stitched_jobs": stitched,
+        # the sync-gap estimate is an UPPER BOUND (idle time before the
+        # disruption inflates it); handoff_windows is the exact number
         "max_handoff_gap_s": gaps[0]["gap_s"] if gaps else None,
+        "handoff_windows": windows,
+        "max_handoff_window_s": max(complete) if complete else None,
+        "journal_dropped": journal["dropped"],
     }

@@ -177,7 +177,8 @@ class Informer:
 
     def __init__(self, source, resync_period: float = 0.0, coalesce=None,
                  name: Optional[str] = None, registry=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_synced: Optional[Callable[[], None]] = None):
         self._source = source
         self._clock = clock
         self.store = _make_store()
@@ -205,6 +206,11 @@ class Informer:
         # per event (pods: expectations observation) never set this.
         self._coalesce = coalesce
         self._handlers = EventHandlers()
+        # fired exactly once, after the initial LIST replay completes
+        # (the moment has_synced() flips True): the shard-acquisition
+        # stage clock stamps its "ListWatch synced" timestamp here.  A
+        # failing callback never blocks the informer.
+        self._on_synced = on_synced
         self._synced = False
         self._started = False
         self._lock = make_lock("informer.state")
@@ -292,6 +298,11 @@ class Informer:
                 self._metrics.added.inc()
             self._dispatch(self._handlers.add_funcs, key, (obj,))
         self._synced = True
+        if self._on_synced is not None:
+            try:
+                self._on_synced()
+            except Exception:  # lint: swallowed-except-ok observability hook; a broken stage stamp must not stop the informer from serving
+                pass
         if self._resync_period > 0 and self._resync_thread is None:
             self._resync_thread = threading.Thread(
                 target=self._resync_loop, daemon=True)

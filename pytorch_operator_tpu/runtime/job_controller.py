@@ -76,6 +76,7 @@ class JobControllerConfig:
         quota_overrides: Optional[Dict[str, Tuple[int, int]]] = None,
         cluster_max_jobs: int = 0,
         cluster_max_chips: int = 0,
+        journal_capacity: int = 4096,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -147,6 +148,11 @@ class JobControllerConfig:
         self.quota_overrides = dict(quota_overrides or {})
         self.cluster_max_jobs = max(0, int(cluster_max_jobs))
         self.cluster_max_chips = max(0, int(cluster_max_chips))
+        # Flight-recorder ring bound (--journal-capacity): structured
+        # control-plane events (lease transitions, ring flips, admission
+        # verdicts, ...) kept for /debug/events before the oldest drop
+        # (dropped events are counted, never silent).
+        self.journal_capacity = max(1, int(journal_capacity))
 
 
 def _make_runtime_core(clock=None):

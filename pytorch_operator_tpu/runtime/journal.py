@@ -1,0 +1,196 @@
+"""Control-plane flight recorder: a bounded, clock-injected journal of
+structured control-plane events.
+
+The observability layer so far records *states* (metrics gauges,
+lifecycle milestones per job); what it cannot answer is "what sequence
+of control-plane decisions led here" — which Lease transitions, ring
+flips, admission verdicts, disruption detections and autoscale
+recommendations happened, in what order, observed by WHICH replica.
+This module is the event side of that story:
+
+  * every producer (ShardManager, LeaderElector, the resharding sweep,
+    the admission gate, the disruption watcher, the autoscale
+    recommender) calls :meth:`EventJournal.record` with a ``kind`` and
+    flat attributes; the journal stamps a monotonically increasing
+    ``seq`` plus the injected mono/wall clock pair and appends to a
+    bounded ring;
+  * the ring drops OLDEST first when full, and every drop is counted —
+    a ``/debug/events`` consumer sees ``dropped`` and the ``seq`` gap,
+    never a silently truncated history;
+  * :meth:`snapshot` serves the whole ring JSON-ready for the metrics
+    server's ``/debug/events`` endpoint; the fleet collector
+    (:mod:`runtime.fleetview`) merges those payloads across replicas to
+    reconstruct cross-process sequences — most importantly the
+    stage-resolved shard-handoff decomposition (lease expiry observed
+    -> CAS acquired -> ListWatch synced -> first reconcile), which
+    turns PR 15's sync-gap UPPER BOUND into an exact per-shard
+    ownerless window.
+
+Timestamps go through the injected ``clock``/``wall`` pair exactly like
+:mod:`runtime.lifecycle` and :mod:`runtime.tracing`: both default to
+the real clocks and accept a VirtualClock's ``now``, so a journal
+captured under the simulator is byte-deterministic — same seed, same
+``/debug/events`` bytes.  Nothing in here reads wall time, samples, or
+branches on anything but the recorded operation count, which is what
+keeps an armed cache-mutation-detector run identical to a bare one.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..analysis.witness import make_lock
+
+#: Default ring capacity: generous for a debugging session (a renew
+#: tick writes nothing in steady state — only transitions record), tiny
+#: against the heap.
+DEFAULT_CAPACITY = 4096
+
+#: Event kinds the shipped producers emit.  The journal itself accepts
+#: any kind string (it is a recorder, not a schema); this tuple is the
+#: vocabulary tests and the fleet collector key on.
+KINDS = (
+    # LeaderElector: lease transitions (never steady-state renewals)
+    "lease_acquired",
+    "lease_released",
+    "lease_expiry_observed",
+    # ShardManager: ownership/ring context around those transitions
+    "lease_renew_miss",
+    "lease_flap",
+    "reshard_begin",
+    "reshard_cancelled",
+    "ring_flipped",
+    "ring_adopted",
+    # controller: shard-acquisition stage stamps + the fenced sweep
+    "shard_synced",
+    "shard_first_reconcile",
+    "reshard_sweep",
+    # admission gate / disruption watcher / autoscale recommender
+    "admission_verdict",
+    "disruption_detected",
+    "autoscale_recommendation",
+)
+
+
+class EventJournal:
+    """Bounded structured event ring with drop accounting.
+
+    ``capacity`` bounds the ring (oldest events drop first, counted);
+    ``clock``/``wall`` are the injected time pair (wall defaults to
+    ``time.time`` next to the real monotonic clock, and to ``clock``
+    itself when a virtual clock is injected — one timeline under the
+    simulator); ``replica_id`` stamps every snapshot so the fleet
+    collector can attribute merged events.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Optional[Callable[[], float]] = None,
+                 replica_id: str = ""):
+        self.capacity = max(1, int(capacity))
+        self._clock = clock
+        self._wall = wall if wall is not None \
+            else (time.time if clock is time.monotonic else clock)
+        self.replica_id = replica_id
+        self._events: deque = deque()
+        self._lock = make_lock("runtime.journal")
+        #: events ever recorded (also the next event's ``seq``)
+        self.recorded = 0
+        #: events evicted from the ring before being read
+        self.dropped = 0
+        #: optional metrics Counter mirroring ``dropped`` (the
+        #: controller wires ``pytorch_operator_journal_dropped_total``)
+        self.dropped_counter = None
+
+    def record(self, kind: str, **attrs: Any) -> dict:
+        """Append one event; returns the recorded entry.  ``attrs``
+        must be JSON-serializable (flat values by convention)."""
+        now_m = self._clock()
+        now_w = self._wall()
+        with self._lock:
+            entry: dict = {"seq": self.recorded, "kind": kind,
+                           "mono": now_m, "wall": now_w}
+            for key in sorted(attrs):
+                entry[key] = attrs[key]
+            self._events.append(entry)
+            self.recorded += 1
+            while len(self._events) > self.capacity:
+                self._events.popleft()
+                self.dropped += 1
+                if self.dropped_counter is not None:
+                    self.dropped_counter.inc()
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        """The ring's events oldest-first (copies), optionally filtered
+        by kind."""
+        with self._lock:
+            entries = [dict(e) for e in self._events]
+        if kind is not None:
+            entries = [e for e in entries if e.get("kind") == kind]
+        return entries
+
+    def snapshot(self, limit: Optional[int] = None,
+                 kind: Optional[str] = None) -> dict:
+        """JSON-ready view for ``/debug/events``: events oldest-first
+        (seq order IS time order under one clock), ``kind`` filters,
+        ``limit`` keeps the NEWEST n after filtering.  The envelope
+        carries the drop accounting: ``recorded`` minus ``dropped``
+        minus what a ``limit``/``kind`` excluded is exactly
+        ``len(events)``, and any ``seq`` gap at the head names how much
+        history the ring already shed."""
+        entries = self.events(kind=kind)
+        if limit is not None and limit >= 0:
+            entries = entries[len(entries) - min(limit, len(entries)):]
+        return {"replica": self.replica_id,
+                "capacity": self.capacity,
+                "recorded": self.recorded,
+                "dropped": self.dropped,
+                "events": entries}
+
+
+class StageClock:
+    """Per-key stage-timestamp ledger over an :class:`EventJournal`:
+    remembers the mono time a named stage was recorded for a key, so a
+    later stage can observe the delta into a histogram without every
+    call site re-deriving 'when did the previous stage happen'.
+
+    The controller uses one per shard acquisition (key = the shard's
+    Lease name): CAS-acquired seeds the ledger, informer-synced and
+    first-reconcile read their deltas from it.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._marks: Dict[tuple, float] = {}
+        self._lock = make_lock("runtime.journal-stages")
+
+    def mark(self, key: str, stage: str,
+             at: Optional[float] = None) -> float:
+        now = self._clock() if at is None else at
+        with self._lock:
+            self._marks[(key, stage)] = now
+        return now
+
+    def since(self, key: str, stage: str,
+              at: Optional[float] = None) -> Optional[float]:
+        """Seconds since ``stage`` was marked for ``key`` (None when it
+        never was)."""
+        now = self._clock() if at is None else at
+        with self._lock:
+            base = self._marks.get((key, stage))
+        return None if base is None else max(0.0, now - base)
+
+    def clear(self, key: str) -> None:
+        with self._lock:
+            for mark in [m for m in self._marks if m[0] == key]:
+                del self._marks[mark]
+
+
+__all__ = ["DEFAULT_CAPACITY", "EventJournal", "KINDS", "StageClock"]

@@ -61,6 +61,7 @@ class LeaderElector:
         labels: Optional[Dict[str, str]] = None,
         annotations: Optional[Callable[[], Dict[str, str]]] = None,
         create_gate: Optional[Callable[[], bool]] = None,
+        journal=None,
     ):
         self.lease_store = lease_store
         self.identity = identity
@@ -88,6 +89,12 @@ class LeaderElector:
         # for leases ALL replicas target at once (migration fence),
         # where unfenced create-on-404 is a guaranteed 409 race.
         self.create_gate = create_gate
+        # flight recorder (runtime.journal.EventJournal): lease
+        # TRANSITIONS only — acquire (create/takeover), voluntary
+        # release, and the first local observation that a foreign
+        # holder's record has gone stale.  Steady-state renewals never
+        # journal; the ring stays quiet unless ownership moves.
+        self.journal = journal
         self.is_leader = False
         self._stop = threading.Event()
         self._active_stop = self._stop
@@ -104,6 +111,13 @@ class LeaderElector:
         # of stepping down — and with --leader-elect, shutting the whole
         # operator down — on a single 500.
         self._last_renew: float = 0.0
+        # last record tuple whose expiry we journaled: observe() runs
+        # every tick, but one dead holder is ONE expiry event
+        self._expiry_journaled: Optional[tuple] = None
+
+    def _journal(self, kind: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.record(kind, lease=self.name, **attrs)
 
     # -- lease record helpers ---------------------------------------------
 
@@ -163,6 +177,8 @@ class LeaderElector:
             try:
                 self.lease_store.create(self.namespace, self._lease_obj())
                 self._last_renew = now
+                self._journal("lease_acquired", via="created",
+                              holder=self.identity)
                 return True
             except AlreadyExistsError:
                 return False
@@ -221,6 +237,10 @@ class LeaderElector:
             self._observed_record = (spec.get("holderIdentity"), spec.get("renewTime"))
             self._observed_at = now
             self._last_renew = now
+            if taking_over:
+                self._journal("lease_acquired", via="takeover",
+                              holder=self.identity,
+                              prev_holder=holder or "")
             return True
         except (ConflictError, NotFoundError):
             return False
@@ -254,7 +274,19 @@ class LeaderElector:
             self._observed_at = now
         if not holder or holder == self.identity:
             return holder, True
-        return holder, now - self._observed_at >= duration
+        stale_s = now - self._observed_at
+        if stale_s < duration:
+            return holder, False
+        # First local observation that this holder's record went a full
+        # leaseDuration without changing: the flight-recorder anchor for
+        # the DETECTION stage of a handoff.  ``stale_s`` lets a journal
+        # consumer back the vacancy start out of the event timestamp
+        # (wall - stale_s = the holder's last observed renewal).
+        if self._expiry_journaled != record:
+            self._expiry_journaled = record
+            self._journal("lease_expiry_observed", holder=holder,
+                          stale_s=stale_s)
+        return holder, True
 
     def release(self) -> None:
         """Voluntarily hand the lease back (client-go ReleaseOnCancel):
@@ -273,6 +305,7 @@ class LeaderElector:
                              renewTime=_micro_time_now())
         try:
             self.lease_store.update(lease)
+            self._journal("lease_released", holder=self.identity)
         except ApiError:
             pass
 

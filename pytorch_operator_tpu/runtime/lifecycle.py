@@ -284,15 +284,22 @@ class JobLifecycleTracker:
     # -- export ------------------------------------------------------------
 
     def snapshot(self, limit: Optional[int] = None,
-                 job: Optional[str] = None) -> dict:
+                 job: Optional[str] = None,
+                 namespace: Optional[str] = None) -> dict:
         """JSON-ready view for ``/debug/jobs``: newest-touched first,
-        ``limit`` truncates, ``job`` selects one key."""
+        ``limit`` truncates, ``job`` selects one key, ``namespace``
+        keeps one tenant's jobs (filtered BEFORE the limit, so
+        ``?namespace=&limit=`` pages within the tenant)."""
         with self._lock:
             if job is not None:
                 recs = [self._jobs[job]] if job in self._jobs else []
             else:
                 recs = list(self._jobs.values())
                 recs.reverse()
+                if namespace is not None:
+                    recs = [rec for rec in recs
+                            if (rec.key.split("/", 1)[0]
+                                if "/" in rec.key else "") == namespace]
                 if limit is not None and limit >= 0:
                     recs = recs[:limit]
             payload = [rec.to_dict() for rec in recs]

@@ -351,6 +351,7 @@ class ShardManager:
         migration_sweep: Optional[Callable[[int, int, int], bool]] = None,
         load_provider: Optional[Callable[[], Dict[int, float]]] = None,
         clock: Callable[[], float] = time.monotonic,
+        journal=None,
     ):
         self.lease_store = lease_store
         self.identity = identity
@@ -379,6 +380,15 @@ class ShardManager:
         # as the heartbeat Lease's shard-load annotation every renewal
         self.load_provider = load_provider
         self.clock = clock
+        # flight recorder, threaded into every elector this manager
+        # mints (shard rings, heartbeat, migration fence) plus the
+        # manager's own ring/flap events
+        self.journal = journal
+        # lease name -> mono time we lost it (renew miss or release):
+        # a re-acquire within one leaseDuration of a loss is a FLAP —
+        # ownership bounced without a real failure, the pathology the
+        # flap event exists to surface
+        self._lost_at: Dict[str, float] = {}
         from ..api.v1 import constants as _constants
 
         # role labels on every Lease we mint: membership scans LIST
@@ -397,7 +407,8 @@ class ShardManager:
             renew_interval=renew_interval, clock=clock,
             labels={_constants.LABEL_LEASE_COMPONENT:
                     _constants.LEASE_COMPONENT_HEARTBEAT},
-            annotations=self._heartbeat_annotations)
+            annotations=self._heartbeat_annotations,
+            journal=journal)
         # replica-lease name -> ((holder, renewTime), locally observed at)
         self._member_obs: Dict[str, Tuple[tuple, float]] = {}
         self._owned: Set[int] = set()
@@ -434,7 +445,7 @@ class ShardManager:
                 namespace=self.namespace,
                 lease_duration=self.lease_duration,
                 renew_interval=self.renew_interval, clock=self.clock,
-                labels=labels)
+                labels=labels, journal=self.journal)
         return electors
 
     def _heartbeat_annotations(self) -> Dict[str, str]:
@@ -470,6 +481,10 @@ class ShardManager:
         exactly the window the ``pytorch_operator_resharding_in_progress``
         gauge exposes."""
         return self.next_shard_count is not None
+
+    def _journal(self, kind: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.record(kind, **attrs)
 
     def _fire(self, hook: Optional[Callable[[int], None]],
               shard: int) -> None:
@@ -594,6 +609,9 @@ class ShardManager:
                 elector.is_leader = False
                 owned.remove(shard)
                 self._mark(owned_set, shard, False)
+                self._lost_at[elector.name] = self.clock()
+                self._journal("lease_renew_miss", lease=elector.name,
+                              shard=shard, holder=self.identity)
                 self._fire(on_released, shard)
 
         # release overage so joining replicas can pick shards up
@@ -601,6 +619,7 @@ class ShardManager:
             shard = owned.pop()  # highest index first: deterministic
             electors[shard].release()
             self._mark(owned_set, shard, False)
+            self._lost_at[electors[shard].name] = self.clock()
             self._fire(on_released, shard)
 
         # observe every foreign shard (expiry clocks keep running even
@@ -618,6 +637,15 @@ class ShardManager:
                 elector.is_leader = True
                 owned.append(shard)
                 self._mark(owned_set, shard, True)
+                lost_at = self._lost_at.pop(elector.name, None)
+                if (lost_at is not None
+                        and self.clock() - lost_at < self.lease_duration):
+                    # we just took BACK a lease we lost less than one
+                    # leaseDuration ago: ownership bounced without a
+                    # real failure (renew starvation, quota churn)
+                    self._journal("lease_flap", lease=elector.name,
+                                  shard=shard, holder=self.identity,
+                                  lost_for_s=self.clock() - lost_at)
                 self._fire(on_acquired, shard)
 
     def tick(self) -> None:
@@ -701,6 +729,8 @@ class ShardManager:
         self._retire_next()  # a re-target supersedes the previous one
         self.next_shard_count = max(1, int(target))
         self.next_ring_epoch = next_epoch
+        self._journal("reshard_begin", target=self.next_shard_count,
+                      epoch=next_epoch, prev_count=self.shard_count)
         self._next_electors = self._make_electors(
             self.next_shard_count, next_epoch)
         self._scan_offset_next = shard_of(
@@ -712,6 +742,7 @@ class ShardManager:
             renew_interval=self.renew_interval, clock=self.clock,
             labels={_constants.LABEL_LEASE_COMPONENT:
                     _constants.LEASE_COMPONENT_MIGRATION},
+            journal=self.journal,
             # same mint fence as the ring record: all migrating
             # replicas race try_acquire_or_renew on this Lease every
             # tick — only the shard-0 owner creates it on 404, everyone
@@ -719,6 +750,10 @@ class ShardManager:
             create_gate=lambda: 0 in self.owned_shards())
 
     def _retire_next(self) -> None:
+        if self.next_shard_count is not None:
+            self._journal("reshard_cancelled",
+                          target=self.next_shard_count,
+                          epoch=int(self.next_ring_epoch or 0))
         with self._lock:
             owned_next = sorted(self._owned_next, reverse=True)
         for shard in owned_next:
@@ -822,6 +857,7 @@ class ShardManager:
         self.next_ring_epoch = None
         self._migration = None
         self._scan_offset = shard_of("", self.identity, new_count)
+        self._journal("ring_flipped", epoch=new_epoch, count=new_count)
         self._fire_flipped(new_epoch, new_count)
 
     def _adopt_ring(self, count: int, epoch: int) -> None:
@@ -847,6 +883,8 @@ class ShardManager:
         self._electors = self._make_electors(self.shard_count,
                                              self.ring_epoch)
         self._scan_offset = shard_of("", self.identity, self.shard_count)
+        self._journal("ring_adopted", epoch=self.ring_epoch,
+                      count=self.shard_count)
         self._fire_flipped(self.ring_epoch, self.shard_count)
 
     # -- lifecycle ---------------------------------------------------------

@@ -29,12 +29,17 @@ cd "$(dirname "$0")/.."
 # --tenancy additionally runs the multi-tenant admission fairness tier
 # (slow: the hostile-tenant churn scenario through the real admission
 # gate on the virtual clock, two same-seed runs fingerprint-compared).
+# --handoff-profile additionally runs the flight-recorder handoff tier
+# (slow: the subprocess fleet's SIGKILL + live-reshard rounds read
+# through merged /debug/events journals — exact stage-resolved
+# ownerless windows checked against the sync-gap upper bound).
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
 RUN_MULTICORE=0
 RUN_FLEETVIEW=0
 RUN_TENANCY=0
+RUN_HANDOFF=0
 WITNESS_ARGS=()
 DETECTOR_ARGS=()
 for arg in "$@"; do
@@ -45,9 +50,10 @@ for arg in "$@"; do
     --multicore) RUN_MULTICORE=1 ;;
     --fleetview) RUN_FLEETVIEW=1 ;;
     --tenancy) RUN_TENANCY=1 ;;
+    --handoff-profile) RUN_HANDOFF=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
     --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --tenancy --witness --mutation-detector)" >&2; exit 2 ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --tenancy --handoff-profile --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
@@ -151,6 +157,11 @@ fi
 if [ "$RUN_TENANCY" = 1 ]; then
   echo "=== tenancy: multi-tenant admission fairness tier ==="
   python -m pytest tests/test_admission.py -q -m slow
+fi
+
+if [ "$RUN_HANDOFF" = 1 ]; then
+  echo "=== handoff-profile: flight-recorder handoff decomposition tier ==="
+  python -m pytest tests/test_handoff_profile.py -q -m slow
 fi
 
 echo "all checks passed"

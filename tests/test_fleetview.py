@@ -138,6 +138,135 @@ def test_merge_jobs_stitches_and_dedups_milestones():
     assert any("error" in r for r in view["replicas"])
 
 
+def test_merge_jobs_namespace_filter_keeps_one_tenant():
+    r0 = _payload("r0", [
+        {"job": "default/a", "uid": "u1", "milestones": [],
+         "segments": [], "syncs": []},
+        {"job": "tenant-a/b", "uid": "u2", "milestones": [],
+         "segments": [], "syncs": []}])
+    assert set(fleetview.merge_jobs([r0])) == {"default/a", "tenant-a/b"}
+    only = fleetview.merge_jobs([r0], namespace="tenant-a")
+    assert set(only) == {"tenant-a/b"}
+    assert fleetview.merge_jobs([r0], namespace="nope") == {}
+
+
+def _jpayload(replica, events, dropped=0):
+    return {"url": f"http://x/{replica}",
+            "metrics_text": "",
+            "traces": {"traces": [], "dropped": 0},
+            "jobs": {"replica": replica, "tracked": 0, "evicted": 0,
+                     "jobs": []},
+            "events": {"replica": replica, "capacity": 4096,
+                       "recorded": len(events) + dropped,
+                       "dropped": dropped,
+                       "events": events}}
+
+
+def test_merge_journals_orders_tags_and_counts_drops():
+    r0 = _jpayload("r0", [
+        {"seq": 0, "kind": "ring_adopted", "mono": 1.0, "wall": 10.0}],
+        dropped=2)
+    r1 = _jpayload("r1", [
+        {"seq": 0, "kind": "lease_acquired", "mono": 0.5, "wall": 9.0,
+         "lease": "pytorch-operator-shard-0", "via": "created",
+         "holder": "r1"}])
+    merged = fleetview.merge_journals(
+        [r0, r1, {"url": "x", "error": "dead"}])
+    assert merged["dropped"] == 2
+    assert merged["recorded"] == 4
+    assert [(e["wall"], e["replica"]) for e in merged["events"]] == [
+        (9.0, "r1"), (10.0, "r0")]
+
+
+def test_handoff_windows_crash_anchor_stage_resolved():
+    """A SIGKILL handoff: the window starts at the dead holder's last
+    observed renewal (wall - stale_s), detection runs to the expiry
+    observation, then acquisition / informer-sync / first-reconcile."""
+    r1 = _jpayload("r1", [
+        {"seq": 0, "kind": "lease_expiry_observed", "mono": 1.0,
+         "wall": 20.0, "lease": "pytorch-operator-shard-0",
+         "holder": "r0", "stale_s": 5.0},
+        {"seq": 1, "kind": "lease_acquired", "mono": 1.2, "wall": 20.2,
+         "lease": "pytorch-operator-shard-0", "via": "takeover",
+         "holder": "r1", "prev_holder": "r0"},
+        {"seq": 2, "kind": "shard_synced", "mono": 1.5, "wall": 20.5,
+         "lease": "pytorch-operator-shard-0", "shard": 0, "epoch": 0,
+         "since_acquire_s": 0.3},
+        {"seq": 3, "kind": "shard_first_reconcile", "mono": 1.8,
+         "wall": 20.8, "lease": "pytorch-operator-shard-0", "shard": 0,
+         "epoch": 0, "job": "default/j", "result": "success",
+         "since_acquire_s": 0.6}])
+    windows = fleetview.handoff_windows(
+        fleetview.merge_journals([r1]))
+    assert len(windows) == 1
+    w = windows[0]
+    assert w["kind"] == "crash"
+    assert w["to_replica"] == "r1"
+    assert w["start_wall"] == pytest.approx(15.0)
+    assert w["stages"]["detection"] == pytest.approx(5.0)
+    assert w["stages"]["acquisition"] == pytest.approx(0.2)
+    assert w["stages"]["informer_sync"] == pytest.approx(0.3)
+    assert w["stages"]["first_reconcile"] == pytest.approx(0.3)
+    assert w["window_s"] == pytest.approx(5.8)
+    # the exact window never exceeds the sum a sync-gap would bound
+    assert w["window_s"] <= 20.8 - 15.0
+
+
+def test_handoff_windows_planned_and_reshard_anchors():
+    events_r0 = [
+        # fleet boot: unanchored epoch-0 creation — NOT a handoff
+        {"seq": 0, "kind": "lease_acquired", "mono": 0.1, "wall": 5.0,
+         "lease": "pytorch-operator-shard-0", "via": "created",
+         "holder": "r0"},
+        {"seq": 1, "kind": "lease_released", "mono": 2.0, "wall": 30.0,
+         "lease": "pytorch-operator-shard-0", "holder": "r0"},
+        {"seq": 2, "kind": "reshard_begin", "mono": 3.0, "wall": 40.0,
+         "lease": "pytorch-operator-migration", "target": 4,
+         "epoch": 1, "prev_count": 2}]
+    events_r1 = [
+        {"seq": 0, "kind": "lease_acquired", "mono": 2.1, "wall": 30.1,
+         "lease": "pytorch-operator-shard-0", "via": "takeover",
+         "holder": "r1", "prev_holder": ""},
+        {"seq": 1, "kind": "shard_first_reconcile", "mono": 2.6,
+         "wall": 30.6, "lease": "pytorch-operator-shard-0", "shard": 0,
+         "epoch": 0, "job": "default/j", "result": "success",
+         "since_acquire_s": 0.5},
+        # new ring: epoch parsed from the lease name, anchored at the
+        # earliest reshard_begin for that epoch
+        {"seq": 2, "kind": "lease_acquired", "mono": 4.0, "wall": 41.0,
+         "lease": "pytorch-operator-shard-e1-0", "via": "created",
+         "holder": "r1"},
+        {"seq": 3, "kind": "shard_synced", "mono": 4.2, "wall": 41.2,
+         "lease": "pytorch-operator-shard-e1-0", "shard": 0,
+         "epoch": 1, "since_acquire_s": 0.2}]
+    merged = fleetview.merge_journals(
+        [_jpayload("r0", events_r0), _jpayload("r1", events_r1)])
+    windows = fleetview.handoff_windows(merged)
+    assert len(windows) == 2
+
+    planned = [w for w in windows if w["kind"] == "planned"][0]
+    assert planned["lease"] == "pytorch-operator-shard-0"
+    assert planned["stages"]["detection"] == 0.0
+    assert planned["stages"]["acquisition"] == pytest.approx(0.1)
+    assert planned["window_s"] == pytest.approx(0.6)
+
+    reshard = [w for w in windows if w["kind"] == "reshard"][0]
+    assert reshard["lease"] == "pytorch-operator-shard-e1-0"
+    assert reshard["epoch"] == 1
+    assert reshard["start_wall"] == pytest.approx(40.0)
+    assert reshard["stages"]["acquisition"] == pytest.approx(1.0)
+    assert reshard["stages"]["informer_sync"] == pytest.approx(0.2)
+    # never reconciled: the stages it reached, window still open
+    assert "first_reconcile" not in reshard["stages"]
+    assert reshard["window_s"] is None
+
+    view = fleetview.fleet_view(
+        [_jpayload("r0", events_r0), _jpayload("r1", events_r1)])
+    assert len(view["handoff_windows"]) == 2
+    assert view["max_handoff_window_s"] == pytest.approx(0.6)
+    assert view["journal_dropped"] == 0
+
+
 def test_percentile_nearest_rank():
     assert fleetview.percentile([], 0.5) is None
     assert fleetview.percentile([3.0], 0.99) == 3.0

@@ -1,0 +1,255 @@
+"""Flight recorder (ISSUE 18 tentpole): the bounded event journal's
+unit contract (seq/drop accounting, kind filter, newest-N limit,
+deterministic attr ordering), journal wiring through LeaderElector and
+ShardManager transitions, the /debug/events and /debug/autoscale
+endpoints, and byte-determinism: the same scripted scenario on the same
+VirtualClock yields byte-identical /debug/events payloads — the
+property that keeps a journal captured under the simulator (mutation
+detector armed or not) reproducible from the seed alone."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.k8s.fake import FakeCluster
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.runtime.journal import (
+    KINDS, EventJournal, StageClock)
+from pytorch_operator_tpu.runtime.leader_election import LeaderElector
+from pytorch_operator_tpu.runtime.sharding import ShardManager
+from pytorch_operator_tpu.sim.clock import VirtualClock
+
+
+def _get(port: int, path: str):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                  timeout=5)
+
+
+# -- unit contract ----------------------------------------------------------
+
+def test_record_seq_and_drop_accounting():
+    registry = Registry()
+    j = EventJournal(capacity=3, clock=lambda: 1.0)
+    j.dropped_counter = registry.counter(
+        "test_journal_dropped_total", "test")
+    for i in range(5):
+        j.record("lease_acquired", holder=f"r{i}")
+    assert len(j) == 3
+    assert j.recorded == 5
+    assert j.dropped == 2
+    # the survivors are the NEWEST, seq identifies the shed history
+    assert [e["seq"] for e in j.events()] == [2, 3, 4]
+    assert "test_journal_dropped_total 2" in registry.expose()
+    snap = j.snapshot()
+    assert snap["recorded"] == 5 and snap["dropped"] == 2
+    assert len(snap["events"]) == 3
+
+
+def test_kind_filter_and_limit_keep_newest():
+    j = EventJournal(clock=lambda: 2.0, replica_id="r0")
+    j.record("lease_acquired", holder="a")
+    j.record("ring_flipped", epoch=1)
+    j.record("lease_acquired", holder="b")
+    snap = j.snapshot(kind="lease_acquired")
+    assert [e["holder"] for e in snap["events"]] == ["a", "b"]
+    snap = j.snapshot(kind="lease_acquired", limit=1)
+    assert [e["holder"] for e in snap["events"]] == ["b"]
+    assert snap["replica"] == "r0"
+    assert j.snapshot(limit=0)["events"] == []
+
+
+def test_attrs_serialize_in_sorted_order():
+    """Entry key order is fixed (seq/kind/mono/wall then sorted attrs)
+    regardless of the call site's kwargs order — /debug/events bytes
+    must not depend on Python dict insertion accidents."""
+    j = EventJournal(clock=lambda: 3.0)
+    entry = j.record("reshard_begin", target=8, epoch=2, prev_count=4)
+    assert list(entry.keys()) == ["seq", "kind", "mono", "wall",
+                                  "epoch", "prev_count", "target"]
+
+
+def test_stage_clock_mark_since_clear():
+    now = [10.0]
+    sc = StageClock(clock=lambda: now[0])
+    sc.mark("lease-a", "acquired")
+    now[0] = 12.5
+    assert sc.since("lease-a", "acquired") == pytest.approx(2.5)
+    assert sc.since("lease-a", "synced") is None
+    assert sc.since("lease-b", "acquired") is None
+    sc.clear("lease-a")
+    assert sc.since("lease-a", "acquired") is None
+
+
+# -- producer wiring --------------------------------------------------------
+
+def test_elector_journals_transitions_not_renewals():
+    now = [0.0]
+    cluster = FakeCluster()
+    leases = cluster.resource("leases")
+    ja = EventJournal(clock=lambda: now[0])
+    jb = EventJournal(clock=lambda: now[0])
+    a = LeaderElector(leases, "a", name="pytorch-operator-shard-0",
+                      lease_duration=5.0, clock=lambda: now[0],
+                      journal=ja)
+    b = LeaderElector(leases, "b", name="pytorch-operator-shard-0",
+                      lease_duration=5.0, clock=lambda: now[0],
+                      journal=jb)
+    assert a.try_acquire_or_renew()
+    assert [e["kind"] for e in ja.events()] == ["lease_acquired"]
+    assert ja.events()[0]["via"] == "created"
+    # steady-state renewals stay silent
+    now[0] += 1.0
+    assert a.try_acquire_or_renew()
+    assert len(ja) == 1
+    # b observes the live holder: nothing journaled yet
+    assert b.observe() == ("a", False)
+    assert len(jb) == 0
+    # a dies; b's first post-expiry observation journals ONE expiry
+    # event (dedup across repeated observes of the same dead record)
+    now[0] += 5.1
+    assert b.observe() == ("a", True)
+    assert b.observe() == ("a", True)
+    expiries = jb.events(kind="lease_expiry_observed")
+    assert len(expiries) == 1
+    assert expiries[0]["holder"] == "a"
+    # wall - stale_s reconstructs the holder's last observed renewal
+    assert expiries[0]["stale_s"] == pytest.approx(5.1)
+    assert b.try_acquire_or_renew()
+    takeover = jb.events(kind="lease_acquired")[-1]
+    assert takeover["via"] == "takeover"
+    assert takeover["prev_holder"] == "a"
+    # voluntary release journals on the releasing side
+    b.is_leader = True
+    b.release()
+    assert [e["kind"] for e in jb.events()][-1] == "lease_released"
+
+
+def test_shard_manager_journals_acquisitions_with_lease_names():
+    clock = [0.0]
+    cluster = FakeCluster()
+    j = EventJournal(clock=lambda: clock[0])
+    m = ShardManager(cluster.resource("leases"), "m1", 2,
+                     lease_duration=5.0, renew_interval=1.0,
+                     clock=lambda: clock[0], journal=j)
+    m.tick()
+    assert m.owned_shards() == {0, 1}
+    acquired = j.events(kind="lease_acquired")
+    names = {e["lease"] for e in acquired}
+    assert {"pytorch-operator-shard-0",
+            "pytorch-operator-shard-1"} <= names
+    assert all(e["kind"] in KINDS for e in j.events())
+    m.stop()
+    released = {e["lease"] for e in j.events(kind="lease_released")}
+    assert {"pytorch-operator-shard-0",
+            "pytorch-operator-shard-1"} <= released
+
+
+# -- determinism (satellite: same seed, same bytes) -------------------------
+
+def _scripted_run() -> bytes:
+    """One fully scripted takeover scenario on a VirtualClock; returns
+    the exact bytes /debug/events would serve (the server renders
+    ``json.dumps(snapshot, indent=1)``)."""
+    clk = VirtualClock(start=100.0)
+    cluster = FakeCluster()
+    journal = EventJournal(clock=clk.now, wall=clk.now,
+                           replica_id="survivor")
+    dead = ShardManager(cluster.resource("leases"), "dead", 2,
+                        lease_duration=5.0, renew_interval=1.0,
+                        clock=clk.now)
+    live = ShardManager(cluster.resource("leases"), "survivor", 2,
+                        lease_duration=5.0, renew_interval=1.0,
+                        clock=clk.now, journal=journal)
+    for _ in range(4):  # converge to 1/1
+        dead.tick()
+        live.tick()
+        clk.advance(1.0)
+    # dead stops ticking; survivor detects expiry and takes over
+    for _ in range(8):
+        live.tick()
+        clk.advance(1.0)
+    assert live.owned_shards() == {0, 1}
+    return json.dumps(journal.snapshot(), indent=1).encode()
+
+
+def test_virtual_clock_journal_is_byte_deterministic():
+    a = _scripted_run()
+    b = _scripted_run()
+    assert a == b
+    events = json.loads(a)["events"]
+    kinds = [e["kind"] for e in events]
+    assert "lease_expiry_observed" in kinds
+    assert "lease_acquired" in kinds
+
+
+# -- endpoints --------------------------------------------------------------
+
+def test_debug_events_endpoint_serves_filters_and_404():
+    registry = Registry()
+    j = EventJournal(clock=lambda: 5.0, replica_id="ep")
+    j.record("lease_acquired", lease="pytorch-operator-shard-0",
+             holder="ep", via="created")
+    j.record("ring_flipped", epoch=1, count=4)
+    server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                  journal=j)
+    try:
+        port = server.server_address[1]
+        snap = json.loads(_get(port, "/debug/events").read().decode())
+        assert snap["replica"] == "ep"
+        assert [e["kind"] for e in snap["events"]] == [
+            "lease_acquired", "ring_flipped"]
+        assert snap["dropped"] == 0
+        one = json.loads(
+            _get(port, "/debug/events?kind=ring_flipped&limit=5")
+            .read().decode())
+        assert [e["kind"] for e in one["events"]] == ["ring_flipped"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/debug/events?limit=bogus")
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+
+    bare = start_metrics_server(Registry(), 0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(bare.server_address[1], "/debug/events")
+        assert err.value.code == 404
+    finally:
+        bare.shutdown()
+
+
+def test_debug_autoscale_endpoint_provider_and_errors():
+    registry = Registry()
+    payload = {"loads": {"r0": {"0": 3.0}}, "recommended_replicas": 2}
+    state = {"boom": False}
+
+    def provider():
+        if state["boom"]:
+            raise RuntimeError("lease store down")
+        return payload
+
+    server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                  autoscale=provider)
+    try:
+        port = server.server_address[1]
+        got = json.loads(_get(port, "/debug/autoscale").read().decode())
+        assert got == payload
+        state["boom"] = True
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/debug/autoscale")
+        assert err.value.code == 500
+    finally:
+        server.shutdown()
+
+    bare = start_metrics_server(Registry(), 0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(bare.server_address[1], "/debug/autoscale")
+        assert err.value.code == 404
+    finally:
+        bare.shutdown()

@@ -150,6 +150,31 @@ def test_debug_jobs_endpoint_limit_select_and_errors(world):
     assert err.value.code == 400
 
 
+def test_debug_jobs_namespace_filter_keeps_one_tenant(world):
+    cluster, ctl, registry, kubelet, port = world
+    cluster.jobs.create("default",
+                        new_job(workers=1, name="ns-a").to_dict())
+    job_b = new_job(workers=1, name="ns-b").to_dict()
+    job_b["metadata"]["namespace"] = "tenant-b"
+    cluster.jobs.create("tenant-b", job_b)
+    assert wait_for(
+        lambda: _job_succeeded(cluster, "ns-a")
+        and any(c.get("type") == "Succeeded" and c.get("status") == "True"
+                for c in (cluster.jobs.get("tenant-b", "ns-b")
+                          .get("status") or {}).get("conditions") or []),
+        timeout=30)
+
+    snap = json.loads(
+        _get(port, "/debug/jobs?namespace=tenant-b").read().decode())
+    assert [r["job"] for r in snap["jobs"]] == ["tenant-b/ns-b"]
+    # tracked reports the tracker's population, not the filtered view
+    assert snap["tracked"] >= 2
+
+    empty = json.loads(
+        _get(port, "/debug/jobs?namespace=nobody").read().decode())
+    assert empty["jobs"] == []
+
+
 def test_debug_jobs_404_without_tracker():
     registry = Registry()
     server = start_metrics_server(registry, 0, host="127.0.0.1")

@@ -671,6 +671,78 @@ def test_bench_fleetview_updater_rewrites_only_its_markers(monkeypatch,
     assert "**Reading.**" in text
 
 
+def test_bench_handoff_updater_rewrites_only_its_markers(monkeypatch,
+                                                         tmp_path):
+    """ISSUE 18: the --handoff-profile renderer + section updater must
+    rewrite ONLY the handoff-delimited region — sibling sections
+    (fleetview included: the tier it refines) and prose outside the
+    markers stay byte-identical, and re-running replaces rather than
+    duplicates.  (The subprocess rounds run under @pytest.mark.slow in
+    tests/test_handoff_profile.py; the tier via run-tests.sh
+    --handoff-profile.)"""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    def fake_round(mode):
+        crash = mode == "sigkill"
+        return {"variant": f"fleetview_{mode}", "jobs": 8, "workers": 3,
+                "shard_count": 2, "replicas": 2, "converged": True,
+                "convergence_wall_s": 30.0, "acted_at_s": 12.0,
+                "max_handoff_gap_s": 9.5 if crash else 2.0,
+                "max_handoff_window_s": 5.8 if crash else 0.6,
+                "window_within_bound": True, "journal_dropped": 0,
+                "handoff_windows": [{
+                    "lease": "pytorch-operator-shard-0", "epoch": 0,
+                    "kind": "crash" if crash else "reshard",
+                    "to_replica": "fv-r1", "start_wall": 100.0,
+                    "acquired_wall": 105.2,
+                    "stages": {"detection": 5.0 if crash else 0.0,
+                               "acquisition": 0.2,
+                               "informer_sync": 0.3,
+                               "first_reconcile": 0.3},
+                    "window_s": 5.8 if crash else 0.6}],
+                "slo": {"objectives": [
+                    {"objective": "handoff_first_reconcile",
+                     "bad": 0.0, "total": 2.0, "burn_rate": 0.0,
+                     "ok": True}], "ok": True}}
+
+    res = {"handoff_sigkill": fake_round("sigkill"),
+           "handoff_reshard": fake_round("reshard")}
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched prose\n"
+                  + bcp.FLEETVIEW_BEGIN + "\nsync-gap sibling tier\n"
+                  + bcp.FLEETVIEW_END + "\n")
+    section = bcp.render_handoff_md(res, 8, 3, 2)
+    bcp.update_md_section(str(md), bcp.HANDOFF_BEGIN,
+                          bcp.HANDOFF_END, section)
+    text = md.read_text()
+    assert "untouched prose" in text
+    assert "sync-gap sibling tier" in text
+    assert text.count(bcp.HANDOFF_BEGIN) == 1
+    assert text.count(bcp.FLEETVIEW_BEGIN) == 1
+    assert "window <= bound: yes" in text
+    assert "| detection s |" in text.replace("acquisition s ", "")
+    # re-running replaces, never duplicates — siblings stay intact
+    bcp.update_md_section(str(md), bcp.HANDOFF_BEGIN,
+                          bcp.HANDOFF_END, section)
+    text = md.read_text()
+    assert text.count(bcp.HANDOFF_BEGIN) == 1
+    assert "sync-gap sibling tier" in text
+    assert "**Reading.**" in text
+
+
+def test_run_tests_sh_advertises_the_handoff_knob():
+    """scripts/run-tests.sh must accept --handoff-profile and name it
+    in the supported-arguments error line (the CI entry point for the
+    slow tier)."""
+    with open(os.path.join(REPO, "scripts", "run-tests.sh")) as f:
+        sh = f.read()
+    assert "--handoff-profile) RUN_HANDOFF=1 ;;" in sh
+    assert "--handoff-profile" in [
+        line for line in sh.splitlines() if "supported:" in line][0]
+    assert "tests/test_handoff_profile.py" in sh
+
+
 def test_bench_tenancy_updater_rewrites_only_its_markers(monkeypatch,
                                                          tmp_path):
     """ISSUE 17: the --tenancy renderer + section updater must rewrite

@@ -1,0 +1,171 @@
+"""SLO layer (ISSUE 18): burn-rate evaluation over the registry's own
+exposition — histogram thresholds, good/bad counter ratios, worst-slice
+per-tenant verdicts, the window burn between evaluations, and the
+serving surfaces (pytorch_operator_slo_* gauges on /metrics, verdict
+document on /debug/slo)."""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_operator_tpu.metrics.prometheus import Registry
+from pytorch_operator_tpu.metrics.server import start_metrics_server
+from pytorch_operator_tpu.metrics.slo import (
+    SloEvaluator, SloObjective, counter_total, default_objectives)
+
+
+def _get(port: int, path: str):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                  timeout=5)
+
+
+def _reconcile_objective() -> SloObjective:
+    return SloObjective(
+        "reconcile_duration", "test", kind="histogram", target=0.999,
+        family="pytorch_operator_reconcile_duration_seconds",
+        threshold=1.0)
+
+
+def test_counter_total_sums_all_label_sets():
+    registry = Registry()
+    c = registry.counter_vec("test_events_total", "t", ("kind",))
+    c.labels(kind="a").inc(3)
+    c.labels(kind="b").inc(2)
+    assert counter_total(registry.expose(), "test_events_total") == 5.0
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        SloObjective("x", "d", kind="nonsense", target=0.5)
+    with pytest.raises(ValueError):
+        SloObjective("x", "d", kind="ratio", target=1.0)
+
+
+def test_histogram_objective_burn_rate_and_verdict():
+    registry = Registry()
+    hist = registry.histogram_vec(
+        "pytorch_operator_reconcile_duration_seconds", "t", ("result",),
+        buckets=(0.5, 1.0, 2.5))
+    for _ in range(99):
+        hist.labels(result="ok").observe(0.2)
+    hist.labels(result="ok").observe(2.0)  # one blown budget
+    ev = SloEvaluator(registry, objectives=[_reconcile_objective()])
+    doc = ev.evaluate()
+    v = doc["objectives"][0]
+    # 1 bad / 100 total against a 0.1% budget: burn 10x, missed
+    assert v["bad"] == 1 and v["total"] == 100
+    assert v["burn_rate"] == pytest.approx(10.0)
+    assert v["ok"] is False and doc["ok"] is False
+    assert v["threshold_s"] == 1.0
+
+
+def test_window_burn_rate_judges_only_the_delta():
+    registry = Registry()
+    hist = registry.histogram_vec(
+        "pytorch_operator_reconcile_duration_seconds", "t", ("result",),
+        buckets=(0.5, 1.0, 2.5))
+    hist.labels(result="ok").observe(2.0)  # lifetime blemish
+    ev = SloEvaluator(registry, objectives=[_reconcile_objective()])
+    assert ev.evaluate()["objectives"][0]["ok"] is False
+    # a healed incident: 1000 new good observations since last eval
+    for _ in range(1000):
+        hist.labels(result="ok").observe(0.2)
+    v = ev.evaluate()["objectives"][0]
+    assert v["window_burn_rate"] == 0.0  # no NEW bad events
+    assert v["burn_rate"] > 0.0  # lifetime number still remembers
+
+
+def test_ratio_objective_over_push_counters():
+    registry = Registry()
+    total = registry.counter("pytorch_operator_push_samples_total", "t")
+    bad = registry.counter_vec("pytorch_operator_push_rejected_total",
+                               "t", ("reason",))
+    total.inc(200)
+    bad.labels(reason="unknown_job").inc(1)
+    ev = SloEvaluator(registry, objectives=[SloObjective(
+        "push_reject_rate", "test", kind="ratio", target=0.99,
+        bad_counter="pytorch_operator_push_rejected_total",
+        total_counter="pytorch_operator_push_samples_total")])
+    v = ev.evaluate()["objectives"][0]
+    assert v["bad"] == 1 and v["total"] == 200
+    assert v["burn_rate"] == pytest.approx(0.5)
+    assert v["ok"] is True
+
+
+def test_per_label_worst_slice_governs():
+    """A starved tenant must not hide inside the fleet aggregate: the
+    per_label objective reports the WORST namespace's numbers."""
+    registry = Registry()
+    hist = registry.histogram_vec(
+        "pytorch_operator_admission_wait_seconds", "t", ("namespace",),
+        buckets=(30.0, 300.0, 3000.0))
+    for _ in range(100):
+        hist.labels(namespace="happy").observe(1.0)
+    hist.labels(namespace="starved").observe(1.0)
+    hist.labels(namespace="starved").observe(1000.0)
+    ev = SloEvaluator(registry, objectives=[SloObjective(
+        "admission_wait_per_tenant", "test", kind="histogram",
+        target=0.99,
+        family="pytorch_operator_admission_wait_seconds",
+        per_label="namespace", threshold=300.0)])
+    v = ev.evaluate()["objectives"][0]
+    assert v["worst_namespace"] == "starved"
+    assert v["bad"] == 1 and v["total"] == 2  # the slice, not the fleet
+    assert v["ok"] is False
+
+
+def test_empty_registry_burns_nothing_and_covers_four_objectives():
+    """Before any traffic every declared objective must evaluate (zero
+    events, zero burn, ok) — /debug/slo answers from boot."""
+    registry = Registry()
+    ev = SloEvaluator(registry)
+    doc = ev.evaluate()
+    assert len(doc["objectives"]) >= 4
+    assert doc["ok"] is True
+    assert all(v["burn_rate"] == 0.0 for v in doc["objectives"])
+    names = {v["objective"] for v in doc["objectives"]}
+    assert {"handoff_first_reconcile", "admission_wait_per_tenant",
+            "reconcile_duration", "push_reject_rate"} <= names
+    assert {o.name for o in default_objectives()} == names
+
+
+def test_slo_gauges_on_metrics_and_debug_slo_endpoint():
+    registry = Registry()
+    hist = registry.histogram_vec(
+        "pytorch_operator_reconcile_duration_seconds", "t", ("result",),
+        buckets=(0.5, 1.0, 2.5))
+    hist.labels(result="ok").observe(0.2)
+    server = start_metrics_server(registry, 0, host="127.0.0.1",
+                                  slo=SloEvaluator(registry))
+    try:
+        port = server.server_address[1]
+        doc = json.loads(_get(port, "/debug/slo").read().decode())
+        assert len(doc["objectives"]) >= 4
+        assert doc["ok"] is True
+        # the gauges refresh BEFORE exposition (plain set(), no
+        # scrape-time callback — see the deadlock note in metrics/slo)
+        text = _get(port, "/metrics").read().decode()
+        for name in ("pytorch_operator_slo_burn_rate",
+                     "pytorch_operator_slo_ok"):
+            series = re.findall(
+                rf'^{name}\{{objective="([^"]+)"\}} ', text,
+                re.MULTILINE)
+            assert len(series) >= 4, (name, series)
+        assert re.search(
+            r'pytorch_operator_slo_ok\{objective="reconcile_duration"\}'
+            r' 1(\.0)?$', text, re.MULTILINE)
+    finally:
+        server.shutdown()
+
+    bare = start_metrics_server(Registry(), 0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(bare.server_address[1], "/debug/slo")
+        assert err.value.code == 404
+    finally:
+        bare.shutdown()
