@@ -232,6 +232,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "breakdown (duration string; 0 disables)")
     p.add_argument("--resync-period", "--resyc-period", dest="resync_period",
                    default="12h", help="informer resync period")
+    p.add_argument("--informer-job-resync", default="30s",
+                   help="cap on the JOB informer's relist-and-diff "
+                        "cadence (reference hard-codes 30s; the "
+                        "effective period is min(this, --resync-period) "
+                        "and 0 disables) — a latency-budget sweep knob")
+    p.add_argument("--worker-poll-interval", default="0.5s",
+                   help="how long a sync worker blocks in the workqueue "
+                        "get before re-checking for shutdown; pure "
+                        "queue_idle time in /debug/timebudget and the "
+                        "floor on worker teardown latency")
     p.add_argument("--init-container-image", default="alpine:3.10",
                    help="image for the worker DNS-wait init container")
     p.add_argument("--qps", "--kube-api-qps", dest="qps", type=float,
@@ -531,6 +541,8 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
         quota_overrides=quota_overrides,
         cluster_max_jobs=args.cluster_max_jobs,
         cluster_max_chips=args.cluster_max_chips,
+        informer_job_resync=parse_duration(args.informer_job_resync),
+        worker_poll_interval=parse_duration(args.worker_poll_interval),
     )
     try:
         slow_threshold = parse_duration(args.slow_reconcile_threshold)
@@ -640,11 +652,12 @@ def run(args, stop_event: threading.Event | None = None, cluster=None) -> int:
             health_checks={"healthz": healthz, "readyz": readyz},
             push_gateway=push_gateway, lifecycle=controller.lifecycle,
             journal=controller.journal, autoscale=autoscale_provider,
-            slo=SloEvaluator(registry))
+            slo=SloEvaluator(registry),
+            timebudget=controller.timebudget_snapshot)
         port = metrics_server.server_address[1]
         logger.info("metrics on :%d/metrics (traces on /debug/traces, "
                     "timelines on /debug/jobs, events on /debug/events, "
-                    "slo on /debug/slo%s)",
+                    "slo on /debug/slo, budget on /debug/timebudget%s)",
                     port,
                     ", push on /push/v1/metrics" if push_gateway else "")
         if kubelet is not None and push_gateway is not None:

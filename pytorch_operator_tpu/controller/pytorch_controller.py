@@ -99,7 +99,9 @@ class PyTorchController(
         # When resync is disabled (0, the unit-test default) the job
         # informer follows suit so tests stay deterministic.
         factory_resync = self.config.resync_period_seconds
-        job_resync = min(30.0, factory_resync) if factory_resync > 0 else 0.0
+        job_cap = self.config.informer_job_resync
+        job_resync = (min(job_cap, factory_resync)
+                      if factory_resync > 0 and job_cap > 0 else 0.0)
         # key -> UID of the incarnation whose sync last ran; lets sync_job
         # detect expectations raised by a dead incarnation (see sync_job)
         self._synced_uid: dict = {}
@@ -107,7 +109,9 @@ class PyTorchController(
                                      coalesce=self._coalesce_job_event,
                                      name="pytorchjobs",
                                      registry=registry or default_registry,
-                                     clock=self.mono_clock)
+                                     clock=self.mono_clock,
+                                     propagation=self.propagation,
+                                     budget=self.timebudget)
         self.job_informer.add_event_handler(
             on_add=self.add_job, on_update=self.update_job, on_delete=self._job_deleted
         )
@@ -233,7 +237,8 @@ class PyTorchController(
                 migration_sweep=self._run_migration_sweep,
                 load_provider=self._shard_loads,
                 clock=self.config.clock or time.monotonic,
-                journal=self.journal)
+                journal=self.journal,
+                budget=self.timebudget)
             # live-reshard observability: the 0/1 migration-window gauge
             # plus the ring epoch itself, so a scrape can tell WHICH
             # ring a replica is reconciling for while the window is open
@@ -761,6 +766,7 @@ class PyTorchController(
             except ConflictError:
                 self.status_conflicts_counter.inc()
                 raise
+            self.propagation.note_commit(f"{namespace}/{name}")
 
         def refetch_base(_err, _attempt):
             # conflict: re-read the authoritative base so the next
@@ -824,6 +830,14 @@ class PyTorchController(
             informers.append(self.node_informer)
         return all(i.has_synced() for i in informers)
 
+    def timebudget_snapshot(self) -> dict:
+        """/debug/timebudget payload: this replica's time-bucket
+        accounting plus the propagation ledger's recent per-event stage
+        decompositions.  Byte-deterministic under the simulator."""
+        snap = self.timebudget.snapshot()
+        snap["propagation"] = self.propagation.snapshot()
+        return snap
+
     def run(self, threadiness: int = 1, stop_event: Optional[threading.Event] = None):
         """controller.go:185-213.  Sharded mode starts the admission
         informer + shard manager instead of the global informers and
@@ -851,7 +865,8 @@ class PyTorchController(
 
     def _run_worker(self, stop_event: threading.Event) -> None:
         while not stop_event.is_set():
-            if not self.process_next_work_item(timeout=0.5):
+            if not self.process_next_work_item(
+                    timeout=self.config.worker_poll_interval):
                 return
 
     def process_next_work_item(self, timeout: Optional[float] = None,
@@ -862,24 +877,30 @@ class PyTorchController(
         runtime, if any — its first completed pass stamps the
         first-reconcile handoff stage."""
         queue = queue if queue is not None else self.work_queue
-        key, shutdown = queue.get(timeout=timeout)
+        with self.timebudget.measure("queue_idle"):
+            key, shutdown = queue.get(timeout=timeout)
         if shutdown:
             return False
         if key is None:
             return True
         try:
             start = self.mono_clock()
+            self.propagation.note_reconcile_start(key)
             epoch = (self.shard_manager.ring_epoch
                      if self.shard_manager is not None else 0)
             # replica + ring epoch on the ROOT span: the fleet collector
             # stitches one job's traces across replicas by these attrs
-            with self.tracer.trace("reconcile", key=key,
-                                   replica=self.replica_id,
-                                   ring_epoch=epoch) as tspan:
+            with self.timebudget.measure("reconcile"), \
+                    self.tracer.trace("reconcile", key=key,
+                                      replica=self.replica_id,
+                                      ring_epoch=epoch) as tspan:
                 forget, err = self.sync_job(key)
                 result = ("error" if err is not None
                           else "success" if forget else "requeue")
                 tspan.set_attr("result", result)
+            # close this key's propagation record: the patch the sync
+            # issued (if any) already stamped the commit
+            self.propagation.complete(key, result=result)
             # exemplar: the duration sample remembers which trace filled
             # its bucket, so a slow bucket on an OpenMetrics scrape
             # resolves directly to its /debug/traces entry
@@ -1494,6 +1515,7 @@ class _ShardRuntime:
         self.queue.set_metrics(WorkQueueMetrics(
             controller.registry, queue_name,
             clock=controller.mono_clock))
+        self.queue.set_propagation(controller.propagation)
         cluster = controller.cluster
         self._sources = [sharded_source(cluster, plural, shard, epoch)
                          for plural in ("pytorchjobs", "pods", "services")]
@@ -1510,7 +1532,9 @@ class _ShardRuntime:
                 controller._coalesce_job_event(key, old, new,
                                                queue=self.queue),
             clock=controller.mono_clock,
-            on_synced=self._informer_synced)
+            on_synced=self._informer_synced,
+            propagation=controller.propagation,
+            budget=controller.timebudget)
         self.job_informer.add_event_handler(
             on_add=controller.add_job, on_update=controller.update_job,
             on_delete=controller._job_deleted)
@@ -1532,9 +1556,13 @@ class _ShardRuntime:
         # CAS-acquired stage stamp: every later stage (informer sync,
         # first reconcile) is observed as a delta from this mark
         self.controller._stage_clock.mark(self.lease_name, "acquired")
-        for informer in (self.job_informer, self.pod_informer,
-                         self.service_informer):
-            informer.start()
+        # shard_sync self-time nests inside the manager's lease_tick
+        # span (acquisition runs on the tick thread) and subtracts
+        # itself out of it, keeping the two buckets disjoint
+        with self.controller.timebudget.measure("shard_sync"):
+            for informer in (self.job_informer, self.pod_informer,
+                             self.service_informer):
+                informer.start()
         for n in range(self.workers):
             t = threading.Thread(
                 target=self._work, args=(stop_event,), daemon=True,
@@ -1579,7 +1607,8 @@ class _ShardRuntime:
     def _work(self, stop_event: threading.Event) -> None:
         while not stop_event.is_set():
             if not self.controller.process_next_work_item(
-                    timeout=0.5, queue=self.queue, runtime=self):
+                    timeout=self.controller.config.worker_poll_interval,
+                    queue=self.queue, runtime=self):
                 return
 
     def synced(self) -> bool:

@@ -37,6 +37,7 @@ from .errors import (
     transient_reason,
 )
 from ..analysis.witness import make_lock
+from ..runtime.propagation import set_event_birth
 from .resilience import ResilienceConfig
 from . import resilience as _resilience
 
@@ -764,8 +765,16 @@ class RestResourceStore:
         if new_rv:
             rv = new_rv
         if etype in (ADDED, MODIFIED, DELETED):
-            for fn in list(self._listeners):
-                fn(etype, obj)
+            # relay the sender's birth stamp (stub server's sentWall;
+            # absent on real apiservers) to the propagation ledger via
+            # the thread-local side channel — never by mutating obj,
+            # which listeners treat as shared read-only
+            prior = set_event_birth(event.get("sentWall"))
+            try:
+                for fn in list(self._listeners):
+                    fn(etype, obj)
+            finally:
+                set_event_birth(prior)
         return rv
 
     def _watch_once(self, rv: str) -> str:

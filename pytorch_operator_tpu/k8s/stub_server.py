@@ -370,8 +370,13 @@ class StubApiServer:
                             et, obj = events.get(timeout=0.2)
                         except queue.Empty:
                             continue
+                        # sentWall: birth stamp for the propagation
+                        # ledger's apiserver_to_informer stage (real
+                        # apiservers don't send it; clients treat it
+                        # as optional)
                         line = json.dumps(
-                            {"type": et, "object": obj}).encode() + b"\n"
+                            {"type": et, "object": obj,
+                             "sentWall": time.time()}).encode() + b"\n"
                         plan = outer.fault_plan
                         if plan is not None and plan.on_watch_event():
                             # mid-event reset: declare the full chunk,

@@ -23,9 +23,9 @@ The rest is the observability layer's debug/ops surface:
   * ``/debug/jobs`` — the lifecycle tracker's per-job timelines
     (milestones, restart/resize/reshard segments, recent syncs) as
     JSON, newest-touched first (``?limit=N`` truncates, ``?job=ns/name``
-    selects one, ``?namespace=ns`` keeps one tenant's jobs); milestone
-    entries carry trace ids that cross-link into ``/debug/traces``; 404
-    without a tracker.
+    selects one, ``?namespace=ns`` keeps one tenant's jobs, ``?shard=I``
+    one shard's); milestone entries carry trace ids that cross-link
+    into ``/debug/traces``; 404 without a tracker.
   * ``/debug/events`` — the flight recorder's bounded journal of
     control-plane events (lease transitions, ring flips, admission
     verdicts, disruption detections) as JSON, oldest first (``?limit=N``
@@ -37,6 +37,11 @@ The rest is the observability layer's debug/ops surface:
   * ``/debug/slo`` — the declared objectives' verdicts (burn rates over
     the existing histograms/counters, freshly evaluated per request);
     404 without an evaluator.
+  * ``/debug/timebudget`` — the replica's steady-state latency budget:
+    wall time classified into activity buckets (reconcile, queue idle,
+    informer resync/idle, lease tick/idle, shard sync) plus the
+    propagation ledger's recent per-event stage decompositions; 404
+    when the process runs without a controller.
   * ``/healthz`` — liveness; 200 while the process serves, 503 once the
     registered check fails (e.g. shutdown began).
   * ``/readyz`` — readiness; reflects informer sync and leader state
@@ -77,6 +82,7 @@ def start_metrics_server(
     journal=None,
     autoscale=None,
     slo=None,
+    timebudget=None,
 ) -> ThreadingHTTPServer:
     """Serve the operator HTTP surface in a daemon thread.
 
@@ -90,7 +96,9 @@ def start_metrics_server(
     /debug/events; ``autoscale`` (a zero-arg callable returning the
     JSON-ready loads+recommendation document) enables /debug/autoscale;
     ``slo`` (metrics.slo.SloEvaluator) enables /debug/slo and refreshes
-    the SLO gauge series before every /metrics exposition.
+    the SLO gauge series before every /metrics exposition; ``timebudget``
+    (a zero-arg callable returning the JSON-ready budget document, e.g.
+    the controller's ``timebudget_snapshot``) enables /debug/timebudget.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -151,19 +159,27 @@ def start_metrics_server(
                 limit = None
                 job = None
                 namespace = None
+                shard = None
+                q = urllib.parse.parse_qs(url.query)
                 try:
-                    q = urllib.parse.parse_qs(url.query)
                     if "limit" in q:
                         limit = max(0, int(q["limit"][0]))
-                    if "job" in q:
-                        job = q["job"][0]
-                    if "namespace" in q:
-                        namespace = q["namespace"][0]
                 except ValueError:
                     self._send_json(400, {"error": "limit must be an int"})
                     return
+                try:
+                    if "shard" in q:
+                        shard = int(q["shard"][0])
+                except ValueError:
+                    self._send_json(400, {"error": "shard must be an int"})
+                    return
+                if "job" in q:
+                    job = q["job"][0]
+                if "namespace" in q:
+                    namespace = q["namespace"][0]
                 self._send_json(200, lifecycle.snapshot(
-                    limit=limit, job=job, namespace=namespace))
+                    limit=limit, job=job, namespace=namespace,
+                    shard=shard))
             elif path == "/debug/events":
                 if journal is None:
                     self._send_json(404, {"error": "journal not enabled"})
@@ -188,6 +204,15 @@ def start_metrics_server(
                     return
                 try:
                     self._send_json(200, autoscale())
+                except Exception as e:  # surface, don't crash the server
+                    self._send_json(500, {"error": repr(e)})
+            elif path == "/debug/timebudget":
+                if timebudget is None:
+                    self._send_json(404,
+                                    {"error": "time budget not enabled"})
+                    return
+                try:
+                    self._send_json(200, timebudget())
                 except Exception as e:  # surface, don't crash the server
                     self._send_json(500, {"error": repr(e)})
             elif path == "/debug/slo":

@@ -186,6 +186,15 @@ def default_objectives() -> List[SloObjective]:
             kind="ratio", target=0.99,
             bad_counter="pytorch_operator_push_rejected_total",
             total_counter="pytorch_operator_push_samples_total"),
+        SloObjective(
+            "event_propagation",
+            "99% of job watch events reach reconcile start within 1s "
+            "of the apiserver send (the propagation ledger's "
+            "watch_to_reconcile_start stage)",
+            kind="histogram", target=0.99,
+            family="pytorch_operator_event_propagation_seconds",
+            match_labels={"stage": "watch_to_reconcile_start"},
+            threshold=1.0),
     ]
 
 

@@ -238,6 +238,7 @@ class NativeWorkQueue:
         self._lib = lib
         self._q = lib.wq_new(base_delay, max_delay)
         self._metrics = None
+        self._propagation = None
 
     def set_metrics(self, metrics) -> None:
         """Attach a runtime.workqueue.WorkQueueMetrics.  Queue state
@@ -250,12 +251,21 @@ class NativeWorkQueue:
         self._metrics = metrics
         metrics.set_depth_function(self.__len__)
 
+    def set_propagation(self, ledger) -> None:
+        """Attach a runtime.propagation.PropagationLedger; stamps mirror
+        set_metrics placement — at the FFI boundary, since queue state
+        lives in C++.  The ledger's first-stamp-wins semantics absorb
+        the dirty-dedupe the C++ side applies after this stamp."""
+        self._propagation = ledger
+
     def add(self, item: str) -> None:
         q = self._q
         if q:
             if self._metrics is not None and not self.is_dirty(item):
                 self._metrics.on_add(item)
             self._lib.wq_add(q, item.encode())
+            if self._propagation is not None:
+                self._propagation.note_enqueue(item)
 
     def add_after(self, item: str, delay: float) -> None:
         q = self._q
@@ -285,6 +295,8 @@ class NativeWorkQueue:
                 item = buf.value.decode()
                 if self._metrics is not None:
                     self._metrics.on_get(item)
+                if self._propagation is not None:
+                    self._propagation.note_get(item)
                 return item, False
             if rc == -1:
                 return None, True

@@ -89,6 +89,12 @@ def scrape_replica(base_url: str, timeout: float = 5.0) -> dict:
             _get_text(base + "/debug/events", timeout))
     except Exception:  # noqa: BLE001  # lint: swallowed-except-ok a replica predating the flight recorder still contributes its other surfaces
         pass
+    try:
+        # same deal for the latency budget: optional, never fatal
+        out["timebudget"] = json.loads(
+            _get_text(base + "/debug/timebudget", timeout))
+    except Exception:  # noqa: BLE001  # lint: swallowed-except-ok a replica predating the time budget still contributes its other surfaces
+        pass
     return out
 
 
@@ -185,7 +191,8 @@ def merge_cost_profile(metrics_texts: List[str],
 # -- timeline merge ---------------------------------------------------------
 
 def merge_jobs(replica_payloads: List[dict],
-               namespace: Optional[str] = None) -> dict:
+               namespace: Optional[str] = None,
+               shard: Optional[int] = None) -> dict:
     """Union the per-replica ``/debug/jobs`` payloads into one
     fleet-wide timeline per job.
 
@@ -195,8 +202,8 @@ def merge_jobs(replica_payloads: List[dict],
     again by a later owner is the duplicate, the first observation is
     the fact.  Segments and sync records concatenate in wall order,
     each carrying the replica that recorded it.  ``namespace`` keeps
-    one tenant's jobs — the fleet-level twin of
-    ``/debug/jobs?namespace=``."""
+    one tenant's jobs, ``shard`` one shard's — the fleet-level twins
+    of ``/debug/jobs?namespace=`` and ``?shard=``."""
     jobs: dict = {}
     for payload in replica_payloads:
         if "error" in payload:
@@ -210,6 +217,8 @@ def merge_jobs(replica_payloads: List[dict],
                           or (key.split("/", 1)[0] if "/" in key else ""))
                 if rec_ns != namespace:
                     continue
+            if shard is not None and rec.get("shard") != shard:
+                continue
             merged = jobs.setdefault(
                 key, {"job": key,
                       # the tenant dimension survives the merge: the
@@ -218,8 +227,11 @@ def merge_jobs(replica_payloads: List[dict],
                       # captured before the field existed
                       "namespace": rec.get("namespace")
                       or (key.split("/", 1)[0] if "/" in key else ""),
+                      "shard": rec.get("shard"),
                       "milestones": {}, "segments": [],
                       "syncs": [], "replicas": set()})
+            if rec.get("shard") is not None:
+                merged["shard"] = rec.get("shard")
             merged["replicas"].add(replica)
             for entry in rec.get("milestones") or []:
                 name = entry.get("milestone", "")
@@ -478,6 +490,44 @@ def handoff_gaps(merged_jobs: dict, min_gap_s: float = 0.0) -> List[dict]:
     return gaps
 
 
+def merge_timebudgets(replica_payloads: List[dict]) -> dict:
+    """Fold the per-replica ``/debug/timebudget`` payloads into one
+    fleet table: per-replica rows (uptime, accounted seconds, coverage,
+    bucket split) plus fleet-wide per-bucket sums and the propagation
+    ledger rollup (completed/open/folded event records).  Replicas
+    scraped without the surface simply contribute nothing."""
+    rows = []
+    fleet_buckets: Dict[str, float] = {}
+    propagation = {"completed": 0, "open": 0, "folded": 0}
+    for payload in replica_payloads:
+        budget = payload.get("timebudget")
+        if not isinstance(budget, dict):
+            continue
+        buckets = {name: (entry or {}).get("seconds", 0.0)
+                   for name, entry in (budget.get("buckets")
+                                       or {}).items()}
+        for name, seconds in buckets.items():
+            fleet_buckets[name] = fleet_buckets.get(name, 0.0) + seconds
+        rows.append({
+            "replica": budget.get("replica", ""),
+            "url": payload.get("url", ""),
+            "uptime_s": budget.get("uptime_s", 0.0),
+            "accounted_s": budget.get("accounted_s", 0.0),
+            "coverage": budget.get("coverage", 0.0),
+            "buckets": buckets,
+        })
+        ledger = budget.get("propagation") or {}
+        for field in propagation:
+            propagation[field] += int(ledger.get(field, 0) or 0)
+    rows.sort(key=lambda r: (r["replica"], r["url"]))
+    return {
+        "replicas": rows,
+        "buckets": {name: round(seconds, 6)
+                    for name, seconds in sorted(fleet_buckets.items())},
+        "propagation": propagation,
+    }
+
+
 def fleet_view(replica_payloads: List[dict]) -> dict:
     """The whole pipeline: merge scraped payloads, derive per-phase
     percentiles and handoff gaps, and carry per-replica trace-drop
@@ -517,4 +567,5 @@ def fleet_view(replica_payloads: List[dict]) -> dict:
         "handoff_windows": windows,
         "max_handoff_window_s": max(complete) if complete else None,
         "journal_dropped": journal["dropped"],
+        "timebudget": merge_timebudgets(replica_payloads),
     }

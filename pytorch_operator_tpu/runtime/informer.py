@@ -20,10 +20,12 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from contextlib import nullcontext
 from typing import Callable, Dict, List, Optional
 
 from ..analysis import ownership as _ownership
 from ..analysis.witness import make_lock, make_rlock
+from .propagation import get_event_birth
 
 _log = logging.getLogger(__name__)
 
@@ -178,10 +180,19 @@ class Informer:
     def __init__(self, source, resync_period: float = 0.0, coalesce=None,
                  name: Optional[str] = None, registry=None,
                  clock: Callable[[], float] = time.monotonic,
-                 on_synced: Optional[Callable[[], None]] = None):
+                 on_synced: Optional[Callable[[], None]] = None,
+                 propagation=None, budget=None):
         self._source = source
         self._clock = clock
         self.store = _make_store()
+        # propagation: a runtime.propagation.PropagationLedger — receive
+        # stamps fire only for events that actually dispatch handlers
+        # (dropped stale replays / unknown deletes would open ledger
+        # records nothing ever completes).  budget: a
+        # runtime.timebudget.ReplicaTimeBudget classifying the resync
+        # thread's time into informer_idle / informer_resync.
+        self._propagation = propagation
+        self._budget = budget
         # ``name`` opts into per-informer metrics (events by type,
         # coalesced count, resyncs, watch lag, store size) on
         # ``registry`` (the shared default when None) — unnamed
@@ -333,10 +344,24 @@ class Informer:
         return round(self._clock() - last, 6)
 
     # -- resync ------------------------------------------------------------
+    def _measured(self, bucket: str):
+        if self._budget is None:
+            return nullcontext()
+        return self._budget.measure(bucket)
+
+    def _note_receive(self, key: str) -> None:
+        if self._propagation is not None:
+            self._propagation.note_receive(key, birth=get_event_birth())
+
     def _resync_loop(self) -> None:
-        while not self._resync_stop.wait(self._resync_period):
+        while True:
+            with self._measured("informer_idle"):
+                stopped = self._resync_stop.wait(self._resync_period)
+            if stopped:
+                return
             try:
-                self.resync()
+                with self._measured("informer_resync"):
+                    self.resync()
             except Exception:
                 # transient LIST failure or a handler bug mid-diff; the
                 # next tick retries either way, but never silently
@@ -538,12 +563,17 @@ class Informer:
                     # the add handlers (expectations observation!) a
                     # second time for one creation
                     return
+                self._note_receive(key)
                 self.store.add(obj)
                 if self._metrics is not None:
                     self._metrics.added.inc()
                 self._dispatch(self._handlers.add_funcs, key, (obj,))
             elif event_type == "MODIFIED":
                 old = self.store.get_by_key(key)
+                # stamped before the coalesce gate: a coalesced event's
+                # key is dirty in the workqueue, so a pending sync WILL
+                # consume (or fold) the record
+                self._note_receive(key)
                 self.store.update(obj)
                 if (self._coalesce is not None and old is not None
                         and self._coalesce(key, old, obj)):
@@ -565,6 +595,7 @@ class Informer:
                     # on every non-owning runtime at each migration
                     # re-stamp.
                     return
+                self._note_receive(key)
                 self.store.delete(obj)
                 if self._metrics is not None:
                     self._metrics.deleted.inc()

@@ -37,7 +37,9 @@ from .expectations import (
     expectation_services_key,
 )
 from .informer import Informer, meta_namespace_key
+from .propagation import PropagationLedger
 from .recorder import EventRecorder
+from .timebudget import ReplicaTimeBudget
 from .workqueue import WorkQueue, WorkQueueMetrics
 
 
@@ -77,6 +79,8 @@ class JobControllerConfig:
         cluster_max_jobs: int = 0,
         cluster_max_chips: int = 0,
         journal_capacity: int = 4096,
+        informer_job_resync: float = 30.0,
+        worker_poll_interval: float = 0.5,
     ):
         self.enable_gang_scheduling = enable_gang_scheduling
         self.gang_scheduler_name = gang_scheduler_name
@@ -153,6 +157,17 @@ class JobControllerConfig:
         # verdicts, ...) kept for /debug/events before the oldest drop
         # (dropped events are counted, never silent).
         self.journal_capacity = max(1, int(journal_capacity))
+        # Steady-state cadences, promoted from hard-coded constants so
+        # the latency-budget bench can sweep them.  informer_job_resync
+        # (--informer-job-resync) caps the JOB informer's resync period
+        # (reference informer.go:24 hard-codes 30s; the effective value
+        # is still min(cap, --resync-period) and 0 disables).
+        # worker_poll_interval (--worker-poll-interval) is how long a
+        # sync worker blocks in WorkQueue.get before re-checking for
+        # shutdown — the floor on worker teardown latency, and pure
+        # queue_idle time in the replica budget.
+        self.informer_job_resync = max(0.0, float(informer_job_resync))
+        self.worker_poll_interval = max(0.01, float(worker_poll_interval))
 
 
 def _make_runtime_core(clock=None):
@@ -236,14 +251,30 @@ class JobController:
         self.work_queue_metrics = WorkQueueMetrics(registry, "pytorchjob",
                                                    clock=self.mono_clock)
         self.work_queue.set_metrics(self.work_queue_metrics)
+        # Steady-state latency instrumentation: the propagation ledger
+        # stamps each job event's journey (informer receive -> enqueue
+        # -> get -> reconcile -> commit; the ledger's wall clock rides
+        # the virtual clock in sim runs so snapshots stay
+        # byte-deterministic), the time budget classifies this replica's
+        # wall time into activity buckets.  Both serve /debug/timebudget.
+        self.timebudget = ReplicaTimeBudget(
+            registry=registry, clock=self.mono_clock,
+            replica_id=self.config.replica_id)
+        self.propagation = PropagationLedger(
+            registry=registry, clock=self.mono_clock,
+            wall=self.config.clock,
+            replica_id=self.config.replica_id)
+        self.work_queue.set_propagation(self.propagation)
         resync = self.config.resync_period_seconds
         self.pod_informer = Informer(cluster.pods, resync_period=resync,
                                      name="pods", registry=registry,
-                                     clock=self.mono_clock)
+                                     clock=self.mono_clock,
+                                     budget=self.timebudget)
         self.service_informer = Informer(cluster.services,
                                          resync_period=resync,
                                          name="services", registry=registry,
-                                         clock=self.mono_clock)
+                                         clock=self.mono_clock,
+                                         budget=self.timebudget)
         # Node informer: only materialized when disruption handling is on
         # and the cluster backend models Nodes (FakeCluster/RestCluster
         # both do; bare test doubles may not).  The concrete controller's
@@ -255,7 +286,8 @@ class JobController:
                 self.node_informer = Informer(nodes, resync_period=resync,
                                               name="nodes",
                                               registry=registry,
-                                              clock=self.mono_clock)
+                                              clock=self.mono_clock,
+                                              budget=self.timebudget)
         self._stop = threading.Event()
 
         self.pod_informer.add_event_handler(

@@ -78,11 +78,14 @@ DEFAULT_SYNCS_PER_JOB = 64
 
 class _JobRecord:
     __slots__ = ("key", "uid", "milestones", "segments", "syncs",
-                 "last_mono")
+                 "last_mono", "shard")
 
     def __init__(self, key: str, uid: str, syncs_per_job: int):
         self.key = key
         self.uid = uid
+        # latched from the first milestone/segment attrs carrying a
+        # shard index (shard_stamped, reshard); None in unsharded mode
+        self.shard: Optional[int] = None
         # milestone name -> entry dict; insertion order IS timeline order
         self.milestones: "OrderedDict[str, dict]" = OrderedDict()
         self.segments: List[dict] = []
@@ -104,6 +107,7 @@ class _JobRecord:
             # queryable straight off /debug/jobs and the stitched view
             "namespace": self.key.split("/", 1)[0] if "/" in self.key
             else "",
+            "shard": self.shard,
             "milestones": [dict(e) for e in self.milestones.values()],
             "segments": [dict(s) for s in self.segments],
             "syncs": [dict(s) for s in self.syncs],
@@ -169,6 +173,15 @@ class JobLifecycleTracker:
 
     # -- recording ---------------------------------------------------------
 
+    @staticmethod
+    def _latch_shard(rec: _JobRecord, attrs: Dict[str, Any]) -> None:
+        """Keep the record's shard current with the newest shard-bearing
+        attrs (shard_stamped at admission, reshard re-stamps migrate it)
+        so ``?shard=`` filters reflect present ownership."""
+        shard = attrs.get("shard")
+        if isinstance(shard, int):
+            rec.shard = shard
+
     def record(self, key: str, milestone: str, uid: str = "",
                trace_id: Optional[str] = None,
                attrs: Optional[Dict[str, Any]] = None) -> bool:
@@ -189,6 +202,7 @@ class JobLifecycleTracker:
                 entry["trace_id"] = trace_id
             if attrs:
                 entry["attrs"] = dict(attrs)
+                self._latch_shard(rec, attrs)
             rec.milestones[milestone] = entry
             if rec.last_mono is not None:
                 delta = max(0.0, now_m - rec.last_mono)
@@ -213,6 +227,7 @@ class JobLifecycleTracker:
                          "replica": self.replica_id}
             if attrs:
                 seg["attrs"] = dict(attrs)
+                self._latch_shard(rec, attrs)
             rec.segments.append(seg)
         return True
 
@@ -285,11 +300,13 @@ class JobLifecycleTracker:
 
     def snapshot(self, limit: Optional[int] = None,
                  job: Optional[str] = None,
-                 namespace: Optional[str] = None) -> dict:
+                 namespace: Optional[str] = None,
+                 shard: Optional[int] = None) -> dict:
         """JSON-ready view for ``/debug/jobs``: newest-touched first,
-        ``limit`` truncates, ``job`` selects one key, ``namespace``
-        keeps one tenant's jobs (filtered BEFORE the limit, so
-        ``?namespace=&limit=`` pages within the tenant)."""
+        ``limit`` truncates, ``job`` selects one key, ``namespace`` /
+        ``shard`` keep one tenant's / one shard's jobs (both filtered
+        BEFORE the limit, so ``?namespace=&limit=`` and
+        ``?shard=&limit=`` page within the slice)."""
         with self._lock:
             if job is not None:
                 recs = [self._jobs[job]] if job in self._jobs else []
@@ -300,6 +317,8 @@ class JobLifecycleTracker:
                     recs = [rec for rec in recs
                             if (rec.key.split("/", 1)[0]
                                 if "/" in rec.key else "") == namespace]
+                if shard is not None:
+                    recs = [rec for rec in recs if rec.shard == shard]
                 if limit is not None and limit >= 0:
                     recs = recs[:limit]
             payload = [rec.to_dict() for rec in recs]

@@ -1,0 +1,249 @@
+"""Per-event propagation ledger: where does a watch event's time go?
+
+The steady-state control-plane path — apiserver sends a watch frame,
+the informer receives it, the handler enqueues the job key, a worker
+gets it, reconcile starts, the status commit lands — was only visible
+as aggregate histograms (queue duration, reconcile duration).  This
+module stamps ONE ledger record per in-flight job event and, when the
+reconcile pass that consumed it completes, decomposes the whole journey
+into named stages:
+
+  ``apiserver_to_informer``      wire + delivery (wall-clock domain:
+                                 the sender stamps ``sentWall`` on the
+                                 frame, the informer stamps receipt)
+  ``informer_to_enqueue``        handler dispatch until workqueue add
+  ``enqueue_to_get``             queue wait until a worker popped it
+  ``get_to_reconcile_start``     worker bookkeeping before sync_job
+  ``reconcile_start_to_commit``  sync work until the status patch ack
+  ``watch_to_reconcile_start``   birth -> reconcile start (the SLO
+                                 input: the sum of the first four)
+
+Design constraints, in order:
+
+  * **Never mutate watch objects.**  Delivered objects are shared
+    read-only references (the cache mutation detector enforces it), so
+    stamps live in this side-channel ledger keyed by job key and the
+    cross-process birth stamp travels OUT OF BAND — a ``sentWall``
+    field on the watch frame, relayed to the informer through a
+    thread-local (:func:`set_event_birth`), never written into the
+    object.
+  * **First event wins.**  Watch events coalesce (the informer's burst
+    coalescing, the workqueue's dirty dedupe), so a burst of N events
+    resolves to one reconcile.  The ledger measures the OLDEST
+    unprocessed event: while a record is open for a key, later events
+    fold into it (counted in ``folded`` — loss of per-event resolution
+    is visible, never silent).
+  * **Byte-deterministic under the simulator.**  Every stamp flows
+    through the injected ``clock``/``wall`` pair; with both bound to a
+    VirtualClock the snapshot is identical across same-seed runs.  The
+    in-process fake tier sends no ``sentWall`` (its dispatch is
+    synchronous — birth IS receipt), so ``apiserver_to_informer`` is
+    exactly 0.0 there, which is also the honest decomposition.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..analysis.witness import make_lock
+
+#: Stage order is the pipeline order; renderers should preserve it.
+STAGES = (
+    "apiserver_to_informer",
+    "informer_to_enqueue",
+    "enqueue_to_get",
+    "get_to_reconcile_start",
+    "reconcile_start_to_commit",
+    "watch_to_reconcile_start",
+)
+
+# -- birth-stamp channel ------------------------------------------------------
+#
+# The watch dispatcher (k8s/rest.py) sets the frame's sentWall here
+# around its synchronous listener fan-out; the informer's receive hook
+# reads it on the same thread.  A thread-local (not an argument) because
+# the listener signature ``fn(event_type, obj)`` is a wide contract —
+# every source wrapper (EpochFencedSource, LabelFilteredSource, the
+# fake store) forwards it untouched, and none of them need to know
+# about propagation for the stamp to survive the chain.
+
+_birth = threading.local()
+
+
+def set_event_birth(wall: Optional[float]) -> Optional[float]:
+    """Install the in-flight event's birth wall-time for this thread;
+    returns the prior value so dispatchers can restore it (nested
+    dispatch: a handler mutating the source re-enters delivery)."""
+    prior = getattr(_birth, "wall", None)
+    _birth.wall = wall
+    return prior
+
+
+def get_event_birth() -> Optional[float]:
+    """The birth wall-time of the event currently being dispatched on
+    this thread, or None (in-process tiers, resync-synthesized events)."""
+    return getattr(_birth, "wall", None)
+
+
+class PropagationLedger:
+    """Side-channel stage stamps for in-flight job events.
+
+    One open record per job key from ``note_receive`` until the
+    consuming reconcile calls ``complete``; completed records keep
+    their stage decomposition in a bounded ring for
+    ``/debug/timebudget``, and each stage observes into
+    ``pytorch_operator_event_propagation_seconds{stage}`` when a
+    registry is attached.
+    """
+
+    #: must contain the 1.0 bound the event_propagation SLO sits on
+    BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+    def __init__(self, registry=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 wall: Optional[Callable[[], float]] = None,
+                 replica_id: str = "", max_records: int = 256):
+        self._clock = clock or time.monotonic
+        self._wall = wall or time.time
+        self.replica_id = replica_id
+        self._lock = make_lock("runtime.propagation")
+        self._open: Dict[str, dict] = {}
+        self._records: deque = deque(maxlen=max(1, int(max_records)))
+        self.folded = 0
+        self.completed_total = 0
+        self._stage_hist = None
+        if registry is not None:
+            self._stage_hist = registry.histogram_vec(
+                "pytorch_operator_event_propagation_seconds",
+                "Per-stage latency of a job watch event's journey from "
+                "apiserver send to status-commit ack (first event of a "
+                "coalesced burst; later events fold into the open "
+                "record)",
+                ("stage",), buckets=self.BUCKETS)
+
+    # -- stamps (pipeline order) -------------------------------------------
+    def note_receive(self, key: str,
+                     birth: Optional[float] = None) -> None:
+        """Informer received a watch event for ``key``.  Opens the
+        record; while one is already open the event folds into it."""
+        now = self._clock()
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None:
+                rec["folded"] += 1
+                self.folded += 1
+                return
+            self._open[key] = {
+                "key": key,
+                "birth_wall": birth,
+                "receive_wall": self._wall(),
+                "receive": now,
+                "folded": 0,
+            }
+
+    def note_enqueue(self, key: str) -> None:
+        """The key landed in a workqueue (first landing wins)."""
+        now = self._clock()
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None and "enqueue" not in rec:
+                rec["enqueue"] = now
+
+    def note_get(self, key: str) -> None:
+        """A worker popped the key."""
+        now = self._clock()
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None and "get" not in rec:
+                rec["get"] = now
+
+    def note_reconcile_start(self, key: str) -> None:
+        now = self._clock()
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None and "start" not in rec:
+                rec["start"] = now
+
+    def note_commit(self, key: str) -> None:
+        """A status patch for the key actually landed on the apiserver."""
+        now = self._clock()
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None:
+                rec["commit"] = now
+
+    def complete(self, key: str, result: str = "") -> Optional[dict]:
+        """Close the key's record at the end of its reconcile pass,
+        derive the stage decomposition, observe the histogram series
+        and retain the record for the debug surface.  No-op (returns
+        None) when no record is open — pod-driven requeues never opened
+        one."""
+        with self._lock:
+            rec = self._open.pop(key, None)
+        if rec is None:
+            return None
+        stages: Dict[str, float] = {}
+        receive = rec["receive"]
+        # wall-clock domain stage: only measurable when the sender
+        # stamped the frame; in-process dispatch is synchronous, 0.0
+        birth = rec.get("birth_wall")
+        stages["apiserver_to_informer"] = (
+            max(0.0, rec["receive_wall"] - birth)
+            if birth is not None else 0.0)
+        prev = receive
+        for stamp, stage in (("enqueue", "informer_to_enqueue"),
+                             ("get", "enqueue_to_get"),
+                             ("start", "get_to_reconcile_start"),
+                             ("commit", "reconcile_start_to_commit")):
+            at = rec.get(stamp)
+            if at is None:
+                break
+            stages[stage] = max(0.0, at - prev)
+            prev = at
+        if "start" in rec:
+            stages["watch_to_reconcile_start"] = (
+                stages["apiserver_to_informer"]
+                + max(0.0, rec["start"] - receive))
+        done = {
+            "key": key,
+            "result": result,
+            "wall": round(rec["receive_wall"], 6),
+            "folded": rec["folded"],
+            "stages": {s: round(stages[s], 6)
+                       for s in STAGES if s in stages},
+        }
+        if self._stage_hist is not None:
+            for stage, seconds in done["stages"].items():
+                self._stage_hist.labels(stage=stage).observe(seconds)
+        with self._lock:
+            self._records.append(done)
+            self.completed_total += 1
+        return done
+
+    # -- debug surface ------------------------------------------------------
+    def snapshot(self, limit: Optional[int] = None) -> dict:
+        """JSON-ready ledger state, newest record first; byte-stable
+        across same-seed virtual-clock runs."""
+        with self._lock:
+            records = list(self._records)
+            open_count = len(self._open)
+            folded = self.folded
+            completed = self.completed_total
+        records.reverse()
+        if limit is not None:
+            records = records[:max(0, limit)]
+        return {
+            "replica": self.replica_id,
+            "open": open_count,
+            "completed": completed,
+            "folded": folded,
+            "records": records,
+        }
+
+
+__all__ = ["PropagationLedger", "STAGES", "set_event_birth",
+           "get_event_birth"]

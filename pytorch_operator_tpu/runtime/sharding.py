@@ -352,6 +352,7 @@ class ShardManager:
         load_provider: Optional[Callable[[], Dict[int, float]]] = None,
         clock: Callable[[], float] = time.monotonic,
         journal=None,
+        budget=None,
     ):
         self.lease_store = lease_store
         self.identity = identity
@@ -384,6 +385,11 @@ class ShardManager:
         # mints (shard rings, heartbeat, migration fence) plus the
         # manager's own ring/flap events
         self.journal = journal
+        # replica time budget: run() classifies the manager thread's
+        # time into lease_tick (renew/acquire/migration CAS work — any
+        # shard_sync measured inside a tick subtracts itself out) and
+        # lease_idle (dozing between ticks)
+        self.budget = budget
         # lease name -> mono time we lost it (renew miss or release):
         # a re-acquire within one leaseDuration of a loss is a FLAP —
         # ownership bounced without a real failure, the pathology the
@@ -892,7 +898,11 @@ class ShardManager:
         stop = stop_event or self._stop
         while not stop.is_set() and not self._stop.is_set():
             try:
-                self.tick()
+                if self.budget is not None:
+                    with self.budget.measure("lease_tick"):
+                        self.tick()
+                else:
+                    self.tick()
             except Exception:
                 import logging
 
@@ -902,7 +912,11 @@ class ShardManager:
             # the thread immediately — a graceful release that dozes a
             # full renew_interval is a takeover delay for the survivors);
             # an external stop_event is noticed within one interval
-            self._stop.wait(self.renew_interval)
+            if self.budget is not None:
+                with self.budget.measure("lease_idle"):
+                    self._stop.wait(self.renew_interval)
+            else:
+                self._stop.wait(self.renew_interval)
         self._shutdown_leases()
 
     def _shutdown_leases(self) -> None:

@@ -1,0 +1,163 @@
+"""Replica time accounting: classify a replica's wall time into buckets.
+
+The propagation ledger (``runtime/propagation.py``) answers "where did
+THIS event's latency go"; this module answers the dual question —
+"where did THIS REPLICA's seconds go" — by wrapping the long-running
+loops (worker get/sync, informer resync cadence, lease renew cadence,
+shard acquisition) in ``measure()`` spans that accumulate into named
+buckets:
+
+  ``reconcile``        worker executing sync_job + bookkeeping
+  ``queue_idle``       worker blocked in WorkQueue.get
+  ``informer_resync``  periodic full-store redelivery work
+  ``informer_idle``    resync-loop sleeping between cadences
+  ``lease_tick``       ShardManager renew/acquire/migration CAS work
+  ``lease_idle``       ShardManager sleeping between ticks
+  ``shard_sync``       informer start + initial LIST on shard acquire
+
+Spans nest (a shard acquisition inside a lease tick starts informers):
+a nested span's duration is SUBTRACTED from its enclosing span, so
+buckets are disjoint self-times and per-thread bucket sums compare
+meaningfully against that thread's lifetime — ``/debug/timebudget``
+reports the coverage ratio so unattributed time is visible, never
+silently absorbed.
+
+All stamps flow through the injected monotonic clock; under a
+VirtualClock the snapshot is byte-deterministic across same-seed runs
+(thread attribution uses thread names, which the sim keeps stable).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+from ..analysis.witness import make_lock
+
+#: Bucket order is the display order for debug payloads and docs.
+BUCKETS = (
+    "reconcile",
+    "queue_idle",
+    "informer_resync",
+    "informer_idle",
+    "lease_tick",
+    "lease_idle",
+    "shard_sync",
+)
+
+
+class ReplicaTimeBudget:
+    """Accumulates wall time per named bucket with nesting-aware
+    self-time attribution; exported as
+    ``pytorch_operator_replica_time_seconds{bucket}`` (computed at
+    scrape time) and the ``/debug/timebudget`` payload."""
+
+    BUCKETS = BUCKETS
+
+    def __init__(self, registry=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 replica_id: str = ""):
+        self._clock = clock or time.monotonic
+        self.replica_id = replica_id
+        self._lock = make_lock("runtime.timebudget")
+        self._seconds = {b: 0.0 for b in BUCKETS}
+        self._counts = {b: 0 for b in BUCKETS}
+        self._started = self._clock()
+        # per-thread span bookkeeping: first/last stamp bound the
+        # thread's instrumented lifetime, accounted sums its self-times
+        self._threads: dict = {}
+        # per-thread stack of open measure() frames for nesting
+        self._local = threading.local()
+        if registry is not None:
+            vec = registry.gauge_vec(
+                "pytorch_operator_replica_time_seconds",
+                "Cumulative wall seconds this replica spent per "
+                "activity bucket (disjoint self-times; nested spans "
+                "subtract from their parent)",
+                ("bucket",))
+            for b in BUCKETS:
+                # bind at scrape time so the series needs no push path
+                vec.labels(bucket=b).set_function(
+                    lambda b=b: self.total(b))
+
+    # -- accounting ---------------------------------------------------------
+    def account(self, bucket: str, seconds: float,
+                thread: Optional[str] = None) -> None:
+        """Credit ``seconds`` of self-time to ``bucket``; unknown
+        buckets are dropped rather than inventing series."""
+        if bucket not in self._seconds or seconds < 0.0:
+            return
+        name = thread or threading.current_thread().name
+        now = self._clock()
+        with self._lock:
+            self._seconds[bucket] += seconds
+            self._counts[bucket] += 1
+            rec = self._threads.get(name)
+            if rec is None:
+                rec = self._threads[name] = {
+                    "first": now - seconds, "last": now, "accounted": 0.0}
+            rec["last"] = now
+            rec["first"] = min(rec["first"], now - seconds)
+            rec["accounted"] += seconds
+
+    @contextmanager
+    def measure(self, bucket: str):
+        """Context manager crediting the enclosed duration to
+        ``bucket``, minus any nested ``measure`` spans opened inside
+        it (buckets stay disjoint)."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        frame = {"start": self._clock(), "child": 0.0}
+        stack.append(frame)
+        try:
+            yield
+        finally:
+            stack.pop()
+            duration = max(0.0, self._clock() - frame["start"])
+            if stack:
+                stack[-1]["child"] += duration
+            self.account(bucket, max(0.0, duration - frame["child"]))
+
+    def total(self, bucket: str) -> float:
+        with self._lock:
+            return self._seconds.get(bucket, 0.0)
+
+    # -- debug surface ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-ready budget: per-bucket seconds/spans, per-thread
+        coverage (accounted self-time over instrumented span), and the
+        replica-level rollup."""
+        now = self._clock()
+        with self._lock:
+            buckets = {b: {"seconds": round(self._seconds[b], 6),
+                           "spans": self._counts[b]}
+                       for b in BUCKETS}
+            accounted = sum(self._seconds.values())
+            threads = []
+            span_total = 0.0
+            for name in sorted(self._threads):
+                rec = self._threads[name]
+                span = max(0.0, rec["last"] - rec["first"])
+                span_total += span
+                threads.append({
+                    "thread": name,
+                    "span_s": round(span, 6),
+                    "accounted_s": round(rec["accounted"], 6),
+                    "coverage": round(rec["accounted"] / span, 4)
+                    if span > 0 else 1.0,
+                })
+        return {
+            "replica": self.replica_id,
+            "uptime_s": round(max(0.0, now - self._started), 6),
+            "accounted_s": round(accounted, 6),
+            "coverage": round(accounted / span_total, 4)
+            if span_total > 0 else 1.0,
+            "buckets": buckets,
+            "threads": threads,
+        }
+
+
+__all__ = ["ReplicaTimeBudget", "BUCKETS"]

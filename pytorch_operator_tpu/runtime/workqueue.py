@@ -184,6 +184,7 @@ class WorkQueue:
         self._pending_retry: Dict[Any, int] = {}
         self.rate_limiter = rate_limiter or RateLimiter()
         self._metrics: Optional[WorkQueueMetrics] = None
+        self._propagation = None
 
     def set_metrics(self, metrics: WorkQueueMetrics) -> None:
         """Attach a :class:`WorkQueueMetrics`; hook placement mirrors
@@ -191,6 +192,14 @@ class WorkQueue:
         measured add->get, work duration get->done)."""
         self._metrics = metrics
         metrics.set_depth_function(self.__len__)
+
+    def set_propagation(self, ledger) -> None:
+        """Attach a :class:`~..runtime.propagation.PropagationLedger`;
+        enqueue is stamped wherever an item lands on the live queue
+        (add, delayed drain, done-requeue) and get when a worker pops
+        it.  The ledger's first-stamp-wins semantics make the extra
+        landings from requeues harmless."""
+        self._propagation = ledger
 
     # -- core queue --------------------------------------------------------
     def add(self, item: Any) -> None:
@@ -203,6 +212,8 @@ class WorkQueue:
             if item in self._processing:
                 return
             self._queue.append(item)
+            if self._propagation is not None:
+                self._propagation.note_enqueue(item)
             self._lock.notify()
 
     def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
@@ -217,6 +228,8 @@ class WorkQueue:
                     self._dirty.discard(item)
                     if self._metrics is not None:
                         self._metrics.on_get(item)
+                    if self._propagation is not None:
+                        self._propagation.note_get(item)
                     return item, False
                 if self._shutdown:
                     return None, True
@@ -253,6 +266,8 @@ class WorkQueue:
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
+                if self._propagation is not None:
+                    self._propagation.note_enqueue(item)
 
     def done(self, item: Any) -> None:
         with self._lock:
@@ -261,6 +276,8 @@ class WorkQueue:
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                if self._propagation is not None:
+                    self._propagation.note_enqueue(item)
                 self._lock.notify()
 
     def shutdown(self) -> None:

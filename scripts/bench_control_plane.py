@@ -1161,10 +1161,11 @@ def _free_port() -> int:
 
 
 def _spawn_replica(url: str, replica_id: str, shard_count: int,
-                   threadiness: int) -> dict:
+                   threadiness: int, extra_args=()) -> dict:
     """Launch one operator replica as a true subprocess with its own
     /metrics port; stderr is drained to a bounded buffer so the child
-    never blocks on a full pipe."""
+    never blocks on a full pipe.  ``extra_args`` appends further
+    operator flags (the latency-budget tier sweeps cadences with it)."""
     import collections
     import subprocess
 
@@ -1182,7 +1183,7 @@ def _spawn_replica(url: str, replica_id: str, shard_count: int,
          "--shard-lease-duration", f"{MULTICORE_LEASE_S}s",
          "--shard-renew-interval", f"{MULTICORE_RENEW_S}s",
          "--threadiness", str(threadiness),
-         "--monitoring-port", str(port)],
+         "--monitoring-port", str(port), *extra_args],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
         text=True)
     log = collections.deque(maxlen=200)
@@ -2037,6 +2038,397 @@ def render_handoff_md(res: dict, jobs: int, workers: int,
         HANDOFF_END,
     ]
     return "\n".join(lines)
+
+
+LATENCY_BEGIN = "<!-- latency-budget:begin -->"
+LATENCY_END = "<!-- latency-budget:end -->"
+
+
+def _stage_stats(metrics_texts) -> dict:
+    """Per-stage {count, sum_s, mean_ms} aggregated over every
+    ``pytorch_operator_event_propagation_seconds`` series across the
+    given exposition texts (one per replica)."""
+    from pytorch_operator_tpu.runtime.fleetview import parse_histograms
+
+    family = "pytorch_operator_event_propagation_seconds"
+    agg: dict = {}
+    for text in metrics_texts:
+        for series in parse_histograms(text, (family,))[family].values():
+            stage = (series.get("labels") or {}).get("stage", "")
+            cur = agg.setdefault(stage, {"count": 0.0, "sum_s": 0.0})
+            cur["count"] += float(series.get("count") or 0.0)
+            cur["sum_s"] += float(series.get("sum") or 0.0)
+    for st in agg.values():
+        st["mean_ms"] = (round(st["sum_s"] / st["count"] * 1e3, 3)
+                         if st["count"] else None)
+        st["count"] = int(st["count"])
+        st["sum_s"] = round(st["sum_s"], 6)
+    return agg
+
+
+def run_latency_inproc(jobs: int, workers: int, timeout: float = 120.0,
+                       resync_s: float = 30.0,
+                       poll_s: float = 0.5) -> dict:
+    """In-process tier: the controller against the fake cluster, one
+    process, no serialization.  The propagation ledger stamps every
+    job event informer->enqueue->get->reconcile->commit (there is no
+    apiserver hop: the fake tier dispatches synchronously, so
+    apiserver_to_informer is exactly 0); the replica time budget
+    classifies every worker second."""
+    cluster = FakeCluster()
+    kubelet = FakeKubelet(cluster)
+    kubelet.start()
+    registry = Registry()
+    ctl = PyTorchController(
+        cluster,
+        config=JobControllerConfig(informer_job_resync=resync_s,
+                                   worker_poll_interval=poll_s),
+        registry=registry)
+    stop = threading.Event()
+    ctl.run(threadiness=4, stop_event=stop)
+    out: dict = {"variant": "inproc", "jobs": jobs, "workers": workers,
+                 "resync_s": resync_s, "poll_s": poll_s}
+    t0 = time.perf_counter()
+    try:
+        res = bench_tier(cluster, cluster, jobs, workers,
+                         timeout=timeout)
+        out["wall_s"] = round(time.perf_counter() - t0, 3)
+        # let trailing status commits land before reading the ledger
+        time.sleep(min(2 * poll_s, 1.0))
+        out["converged"] = res["succeeded"]["n"] == jobs
+        out["succeeded"] = res["succeeded"]
+        out["stages"] = _stage_stats([registry.expose()])
+        snap = ctl.timebudget_snapshot()
+        out["timebudget"] = {
+            "uptime_s": snap["uptime_s"],
+            "accounted_s": snap["accounted_s"],
+            "coverage": snap["coverage"],
+            "buckets": snap["buckets"],
+            "threads": snap["threads"],
+        }
+        out["propagation"] = {
+            k: snap["propagation"][k]
+            for k in ("completed", "open", "folded")}
+        return out
+    finally:
+        stop.set()
+        ctl.work_queue.shutdown()
+        kubelet.stop()
+
+
+def run_latency_subproc(jobs: int, workers: int, replicas: int = 2,
+                        timeout: float = 240.0, threadiness: int = 2,
+                        resync_s: float = 30.0,
+                        poll_s: float = 0.5) -> dict:
+    """Subprocess tier: ``replicas`` real operator processes against
+    the stub apiserver over sockets — the deployment path, where the
+    apiserver_to_informer stage measures a genuine wire hop (the stub
+    stamps sentWall on every watch frame).  Per-replica budgets come
+    back over ``/debug/timebudget`` and are merged by
+    ``fleetview.merge_timebudgets`` — the same fleet table
+    ``fleet_view`` serves in production."""
+    from pytorch_operator_tpu.runtime import fleetview
+
+    srv = StubApiServer().start()
+    kubelet = FakeKubelet(srv.cluster)
+    kubelet.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    shards = max(replicas, 2)
+    sweep = ["--informer-job-resync", f"{resync_s}s",
+             "--worker-poll-interval", f"{poll_s}s"]
+    fleet = [_spawn_replica(url, f"lb-r{r}", shards, threadiness,
+                            extra_args=sweep)
+             for r in range(replicas)]
+    out: dict = {"variant": "subproc", "jobs": jobs, "workers": workers,
+                 "replicas": replicas, "shard_count": shards,
+                 "threadiness": threadiness,
+                 "resync_s": resync_s, "poll_s": poll_s}
+
+    def total_owned() -> int:
+        return sum(len(v)
+                   for v in _shard_lease_holders(srv.cluster).values())
+
+    def succeeded() -> int:
+        n = 0
+        for j in range(jobs):
+            try:
+                job = srv.cluster.jobs.get("default", f"lb-job-{j}")
+            except NotFoundError:
+                continue
+            if _condition_true(job, "Succeeded"):
+                n += 1
+        return n
+
+    try:
+        deadline = time.perf_counter() + 90.0
+        while total_owned() < shards:
+            if time.perf_counter() > deadline or any(
+                    f["proc"].poll() is not None for f in fleet):
+                out["converged"] = False
+                out["error"] = ("fleet never owned the ring: " + str(
+                    [list(f["log"])[-3:] for f in fleet]))
+                return out
+            time.sleep(0.05)
+        post409_baseline = srv.counters.get("POST 409", 0)
+
+        t0 = time.perf_counter()
+        for j in range(jobs):
+            srv.cluster.jobs.create("default",
+                                    new_job(f"lb-job-{j}", workers))
+        deadline = t0 + timeout
+        while succeeded() < jobs:
+            if time.perf_counter() > deadline:
+                out["converged"] = False
+                out["error"] = f"{succeeded()}/{jobs} Succeeded at timeout"
+                return out
+            time.sleep(0.02)
+        out["converged"] = True
+        out["wall_s"] = round(time.perf_counter() - t0, 3)
+        time.sleep(2 * MULTICORE_RENEW_S)  # let final commits land
+
+        payloads = []
+        for f in fleet:
+            payload = fleetview.scrape_replica(
+                f"http://127.0.0.1:{f['port']}")
+            if "error" not in payload:
+                payloads.append(payload)
+        out["replicas_scraped"] = len(payloads)
+        out["stages"] = _stage_stats(
+            [p["metrics_text"] for p in payloads])
+        out["timebudget"] = fleetview.merge_timebudgets(payloads)
+        out["duplicate_create_conflicts"] = (
+            srv.counters.get("POST 409", 0) - post409_baseline)
+        return out
+    finally:
+        import signal as _signal
+
+        for f in fleet:
+            if f["proc"].poll() is None:
+                f["proc"].send_signal(_signal.SIGTERM)
+        deadline = time.perf_counter() + 10.0
+        for f in fleet:
+            while (f["proc"].poll() is None
+                   and time.perf_counter() < deadline):
+                time.sleep(0.05)
+            if f["proc"].poll() is None:
+                f["proc"].kill()
+                f["proc"].wait(timeout=5.0)
+        kubelet.stop()
+        srv.stop()
+
+
+def run_latency_determinism(jobs: int = 24, workers: int = 2,
+                            seed: int = 7) -> dict:
+    """Same-seed double run on the virtual clock: the ledger and the
+    time budget read ONLY injected clocks, so two runs must serialize
+    the whole /debug/timebudget payload byte-identically.  This is the
+    bench-level twin of
+    tests/test_propagation.py::test_ledger_virtual_clock_byte_determinism,
+    run at bench scale with the seeded kubelet fleet."""
+    from pytorch_operator_tpu.sim.clock import VirtualClock
+    from pytorch_operator_tpu.sim.fleet import NodeFleet
+    from pytorch_operator_tpu.sim.scale import new_scale_job, pump
+
+    def one_run() -> str:
+        clock = VirtualClock()
+        cluster = FakeCluster()
+        fleet = NodeFleet(10, seed=seed)
+        kubelet = FakeKubelet(cluster, fleet=fleet, clock=clock)
+        ctl = PyTorchController(
+            cluster,
+            config=JobControllerConfig(clock=clock.now,
+                                       create_fanout_width=1),
+            registry=Registry())
+        done: set = set()
+
+        def _ev(et, obj):
+            if et != "MODIFIED":
+                return
+            if _condition_true(obj, "Succeeded"):
+                done.add((obj.get("metadata") or {}).get("name"))
+
+        cluster.jobs.add_listener(_ev)
+        kubelet.start()
+        ctl.start_informers()
+        for j in range(jobs):
+            clock.call_at(float(j), cluster.jobs.create, "default",
+                          new_scale_job(f"lb-{j:03d}", workers))
+        try:
+            converged = pump(ctl, clock,
+                             until=lambda: len(done) >= jobs,
+                             max_virtual_seconds=3600.0)
+        finally:
+            cluster.jobs.remove_listener(_ev)
+            kubelet.stop()
+            ctl.shutdown()
+        return json.dumps({"converged": converged,
+                           "virtual_wall_s": round(clock.now(), 6),
+                           "budget": ctl.timebudget_snapshot()},
+                          sort_keys=True)
+
+    first, repeat = one_run(), one_run()
+    payload = json.loads(first)
+    return {"variant": "determinism", "jobs": jobs, "workers": workers,
+            "seed": seed,
+            "converged": payload["converged"],
+            "virtual_wall_s": payload["virtual_wall_s"],
+            "completed": payload["budget"]["propagation"]["completed"],
+            "fingerprint_match": first == repeat}
+
+
+def run_latency_budget(jobs: int, workers: int, replicas: int = 2,
+                       timeout: float = 240.0, resync_s: float = 30.0,
+                       poll_s: float = 0.5) -> dict:
+    return {
+        "latency_inproc": run_latency_inproc(
+            jobs, workers, timeout=min(timeout, 120.0),
+            resync_s=resync_s, poll_s=poll_s),
+        "latency_subproc": run_latency_subproc(
+            jobs, workers, replicas=replicas, timeout=timeout,
+            resync_s=resync_s, poll_s=poll_s),
+        "latency_determinism": run_latency_determinism(),
+    }
+
+
+def _latency_reading(res: dict) -> str:
+    inproc = res.get("latency_inproc") or {}
+    sub = res.get("latency_subproc") or {}
+    det = res.get("latency_determinism") or {}
+    if not (inproc.get("converged") and sub.get("converged")):
+        return ("**Reading.** A latency-budget round FAILED to "
+                f"converge — inproc: {inproc.get('error', 'ok')}; "
+                f"subproc: {sub.get('error', 'ok')} — re-run before "
+                "citing the decomposition.")
+    ratio = (round(sub["wall_s"] / inproc["wall_s"], 1)
+             if inproc.get("wall_s") else None)
+
+    def mean(r, stage):
+        return ((r.get("stages") or {}).get(stage) or {}).get("mean_ms")
+
+    in_e2e = mean(inproc, "watch_to_reconcile_start")
+    sub_e2e = mean(sub, "watch_to_reconcile_start")
+    sub_wire = mean(sub, "apiserver_to_informer")
+    clean = sub.get("duplicate_create_conflicts") == 0
+    det_ok = det.get("fingerprint_match")
+    return (
+        "**Reading.** The ledger turns the in-process-vs-subprocess "
+        f"wall gap ({inproc.get('wall_s')}s vs {sub.get('wall_s')}s, "
+        f"{ratio}x) from one number into a staged account.  Per-event "
+        "watch->reconcile-start is "
+        f"{in_e2e} ms in-process vs {sub_e2e} ms across processes "
+        f"(of which {sub_wire} ms is the apiserver->informer wire "
+        "hop the in-process tier doesn't pay — JSON serde + socket + "
+        "watch dispatch); the rest of the wall gap is NOT per-event "
+        "latency but idle cadence, which the bucket table pins: the "
+        "subprocess fleet's seconds sit overwhelmingly in "
+        "`queue_idle`/`lease_idle` (workers parked on their "
+        "poll-interval waits, Lease threads on renew cadence), so "
+        "convergence wall is dominated by subprocess startup + "
+        "scheduling quanta, not reconcile cost.  Both cadences are "
+        "now flags (`--worker-poll-interval`, "
+        "`--informer-job-resync`) precisely so this table can be "
+        "re-cut under different sweeps.  Bucket sums stay within "
+        "each thread's span (coverage <= 1 by construction, "
+        "unattributed time visible as the remainder), duplicate "
+        f"creates {'stayed 0' if clean else 'were NONZERO — '}"
+        f"{'' if clean else 'INVESTIGATE'}, and the same-seed "
+        "virtual-clock double run serialized "
+        f"{'byte-identically' if det_ok else 'DIFFERENTLY — the '}"
+        f"{'' if det_ok else 'ledger leaked wall time; INVESTIGATE'}"
+        " (the ledger reads only injected clocks).")
+
+
+def render_latency_md(res: dict, jobs: int, workers: int,
+                      replicas: int) -> str:
+    from pytorch_operator_tpu.runtime.propagation import STAGES
+    from pytorch_operator_tpu.runtime.timebudget import BUCKETS
+
+    stamp = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%d %H:%M UTC")
+    inproc = res.get("latency_inproc") or {}
+    sub = res.get("latency_subproc") or {}
+    det = res.get("latency_determinism") or {}
+
+    def stage_cell(r, stage):
+        st = ((r.get("stages") or {}).get(stage)) or {}
+        if not st.get("count"):
+            return "—", "—"
+        return str(st["count"]), f"{st['mean_ms']}"
+
+    stage_rows = []
+    for stage in STAGES:
+        n_in, m_in = stage_cell(inproc, stage)
+        n_sub, m_sub = stage_cell(sub, stage)
+        stage_rows.append(
+            f"| `{stage}` | {n_in} | {m_in} | {n_sub} | {m_sub} |")
+
+    def bucket_cell(r, bucket):
+        buckets = ((r.get("timebudget") or {}).get("buckets")) or {}
+        entry = buckets.get(bucket)
+        if entry is None:
+            return "—"
+        if isinstance(entry, dict):  # inproc snapshot keeps spans too
+            return str(entry.get("seconds", "—"))
+        return str(entry)
+
+    bucket_rows = [
+        f"| `{b}` | {bucket_cell(inproc, b)} | {bucket_cell(sub, b)} |"
+        for b in BUCKETS]
+
+    in_tb = inproc.get("timebudget") or {}
+    sub_tb = sub.get("timebudget") or {}
+    sub_cov = "; ".join(
+        f"{r.get('replica') or r.get('url')}: {r.get('coverage')}"
+        for r in sub_tb.get("replicas") or [])
+    return "\n".join([
+        LATENCY_BEGIN,
+        f"## Steady-state latency budget ({jobs} jobs x (1 Master + "
+        f"{workers} Workers); in-process vs {replicas} operator "
+        f"subprocesses) ({stamp})",
+        "",
+        f"`scripts/bench_control_plane.py --latency-budget` — the same "
+        "workload on both tiers, decomposed by the propagation ledger "
+        "(`pytorch_operator_event_propagation_seconds`, one stamp per "
+        "hop of every job event) and the replica time budget "
+        "(`pytorch_operator_replica_time_seconds`, every worker "
+        "second classified into a named bucket; raw payload on "
+        "`/debug/timebudget`, fleet merge via "
+        "`fleetview.merge_timebudgets`).  Stages are per-event "
+        "means; buckets are cumulative thread-seconds.  "
+        "`apiserver_to_informer` is 0 in-process by construction "
+        "(synchronous fake dispatch, no wire).",
+        "",
+        "| stage | in-process n | mean ms | subprocess n | mean ms |",
+        "|---|---|---|---|---|",
+        *stage_rows,
+        "",
+        "| bucket | in-process s | subprocess fleet s |",
+        "|---|---|---|",
+        *bucket_rows,
+        "",
+        f"- walls: in-process {inproc.get('wall_s')}s vs subprocess "
+        f"{sub.get('wall_s')}s; events completed "
+        f"{(inproc.get('propagation') or {}).get('completed')} / "
+        f"{(sub_tb.get('propagation') or {}).get('completed')} "
+        f"(folded {(inproc.get('propagation') or {}).get('folded')} / "
+        f"{(sub_tb.get('propagation') or {}).get('folded')})",
+        f"- budget coverage: in-process {in_tb.get('coverage')} "
+        f"(accounted {in_tb.get('accounted_s')}s of "
+        f"{in_tb.get('uptime_s')}s thread-time); subprocess per "
+        f"replica {sub_cov}",
+        f"- duplicate-create 409s (subprocess): "
+        f"{sub.get('duplicate_create_conflicts')}",
+        f"- same-seed virtual-clock double run: fingerprint match = "
+        f"{det.get('fingerprint_match')} ({det.get('completed')} "
+        f"events over {det.get('virtual_wall_s')}s virtual)",
+        "",
+        _latency_reading(res),
+        "",
+        "```json",
+        json.dumps(res, indent=2),
+        "```",
+        LATENCY_END,
+    ])
 
 
 def run_profile_hotpaths(jobs: int, workers: int, nodes: int,
@@ -3248,6 +3640,26 @@ def main() -> None:
     ap.add_argument("--handoff-workers", type=int, default=3)
     ap.add_argument("--handoff-replicas", type=int, default=2)
     ap.add_argument("--handoff-timeout", type=float, default=240.0)
+    ap.add_argument("--latency-budget", action="store_true",
+                    help="run the steady-state latency-budget tier "
+                    "(ISSUE 19): the same workload in-process (fake "
+                    "cluster, no wire) and as operator SUBPROCESSES "
+                    "(stub apiserver over sockets), decomposed per "
+                    "event by the propagation ledger and per second by "
+                    "the replica time budget (/debug/timebudget), plus "
+                    "a same-seed virtual-clock determinism double run; "
+                    "--out rewrites only the delimited latency-budget "
+                    "section")
+    ap.add_argument("--latency-budget-jobs", type=int, default=12)
+    ap.add_argument("--latency-budget-workers", type=int, default=3)
+    ap.add_argument("--latency-budget-replicas", type=int, default=2)
+    ap.add_argument("--latency-budget-timeout", type=float, default=240.0)
+    ap.add_argument("--latency-budget-resync", type=float, default=30.0,
+                    help="job-informer resync cap swept into both tiers "
+                    "(--informer-job-resync on the subprocesses)")
+    ap.add_argument("--latency-budget-poll", type=float, default=0.5,
+                    help="worker poll interval swept into both tiers "
+                    "(--worker-poll-interval on the subprocesses)")
     ap.add_argument("--profile-hotpaths", action="store_true",
                     help="run the cluster-scale sim ONCE under cProfile "
                     "and print the ranked hot-path table (ROADMAP "
@@ -3367,6 +3779,30 @@ def main() -> None:
                                   args.handoff_replicas))
             print(f"[bench_cp] updated handoff section of {args.out}",
                   file=sys.stderr)
+        return
+
+    if args.latency_budget:
+        print(f"[bench_cp] latency-budget ({args.latency_budget_jobs} "
+              f"jobs x (1+{args.latency_budget_workers}); in-process + "
+              f"{args.latency_budget_replicas} subprocesses + "
+              f"virtual-clock determinism double run)...",
+              file=sys.stderr)
+        res = run_latency_budget(
+            args.latency_budget_jobs, args.latency_budget_workers,
+            replicas=args.latency_budget_replicas,
+            timeout=args.latency_budget_timeout,
+            resync_s=args.latency_budget_resync,
+            poll_s=args.latency_budget_poll)
+        for tier, r in res.items():
+            print(json.dumps({"tier": tier, **r}))
+        if args.out:
+            update_md_section(
+                args.out, LATENCY_BEGIN, LATENCY_END,
+                render_latency_md(res, args.latency_budget_jobs,
+                                  args.latency_budget_workers,
+                                  args.latency_budget_replicas))
+            print(f"[bench_cp] updated latency-budget section of "
+                  f"{args.out}", file=sys.stderr)
         return
 
     if args.profile_hotpaths:

@@ -33,6 +33,9 @@ cd "$(dirname "$0")/.."
 # (slow: the subprocess fleet's SIGKILL + live-reshard rounds read
 # through merged /debug/events journals — exact stage-resolved
 # ownerless windows checked against the sync-gap upper bound).
+# --latency-budget additionally runs the propagation-ledger tier
+# (slow: a real subprocess fleet scraped over /debug/timebudget, the
+# per-event stage decomposition checked against the in-process run).
 RUN_SCALE=0
 LINT_ONLY=0
 RUN_TSAN=0
@@ -40,6 +43,7 @@ RUN_MULTICORE=0
 RUN_FLEETVIEW=0
 RUN_TENANCY=0
 RUN_HANDOFF=0
+RUN_LATENCY=0
 WITNESS_ARGS=()
 DETECTOR_ARGS=()
 for arg in "$@"; do
@@ -51,9 +55,10 @@ for arg in "$@"; do
     --fleetview) RUN_FLEETVIEW=1 ;;
     --tenancy) RUN_TENANCY=1 ;;
     --handoff-profile) RUN_HANDOFF=1 ;;
+    --latency-budget) RUN_LATENCY=1 ;;
     --witness) WITNESS_ARGS=(--lock-witness) ;;
     --mutation-detector) DETECTOR_ARGS=(--cache-mutation-detector) ;;
-    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --tenancy --handoff-profile --witness --mutation-detector)" >&2; exit 2 ;;
+    *) echo "unknown argument: $arg (supported: --scale --lint --tsan --multicore --fleetview --tenancy --handoff-profile --latency-budget --witness --mutation-detector)" >&2; exit 2 ;;
   esac
 done
 
@@ -162,6 +167,11 @@ fi
 if [ "$RUN_HANDOFF" = 1 ]; then
   echo "=== handoff-profile: flight-recorder handoff decomposition tier ==="
   python -m pytest tests/test_handoff_profile.py -q -m slow
+fi
+
+if [ "$RUN_LATENCY" = 1 ]; then
+  echo "=== latency-budget: propagation-ledger subprocess tier ==="
+  python -m pytest tests/test_propagation.py -q -m slow
 fi
 
 echo "all checks passed"

@@ -832,3 +832,97 @@ def test_bench_profile_hotpaths_emits_parseable_ranked_table(
     assert all(re.search(r":\d+:", r[4]) for r in rows), rows
     # the profiled run covers the operator package itself
     assert any("pytorch_operator_tpu/" in r[4] for r in rows), rows
+
+
+def test_bench_latency_updater_rewrites_only_its_markers(monkeypatch,
+                                                         tmp_path):
+    """ISSUE 19: the --latency-budget renderer + section updater must
+    rewrite ONLY the latency-delimited region — sibling sections and
+    prose outside the markers stay byte-identical, and re-running
+    replaces rather than duplicates.  (The subprocess round runs under
+    @pytest.mark.slow in tests/test_propagation.py; the tier via
+    run-tests.sh --latency-budget.)"""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_control_plane as bcp
+
+    def stages(scale):
+        return {s: {"count": 12, "sum_s": round(0.01 * scale, 6),
+                    "mean_ms": round(0.8 * scale, 3)}
+                for s in ("apiserver_to_informer", "informer_to_enqueue",
+                          "enqueue_to_get", "get_to_reconcile_start",
+                          "reconcile_start_to_commit",
+                          "watch_to_reconcile_start")}
+
+    res = {
+        "latency_inproc": {
+            "variant": "inproc", "jobs": 12, "workers": 3,
+            "resync_s": 30.0, "poll_s": 0.5, "wall_s": 1.1,
+            "converged": True,
+            "succeeded": {"median_ms": 80.0, "p95_ms": 95.0, "n": 12},
+            "stages": stages(1),
+            "timebudget": {
+                "uptime_s": 2.0, "accounted_s": 7.9, "coverage": 0.98,
+                "buckets": {"reconcile": {"seconds": 0.35, "spans": 140},
+                            "queue_idle": {"seconds": 7.5, "spans": 150}},
+                "threads": []},
+            "propagation": {"completed": 72, "open": 0, "folded": 24}},
+        "latency_subproc": {
+            "variant": "subproc", "jobs": 12, "workers": 3,
+            "replicas": 2, "shard_count": 2, "threadiness": 2,
+            "resync_s": 30.0, "poll_s": 0.5, "converged": True,
+            "wall_s": 60.0, "replicas_scraped": 2,
+            "stages": stages(100),
+            "timebudget": {
+                "replicas": [
+                    {"replica": "lb-r0", "url": "http://a",
+                     "uptime_s": 62.0, "accounted_s": 123.0,
+                     "coverage": 1.0,
+                     "buckets": {"reconcile": 46.0, "queue_idle": 14.0}},
+                    {"replica": "lb-r1", "url": "http://b",
+                     "uptime_s": 63.0, "accounted_s": 124.0,
+                     "coverage": 1.0,
+                     "buckets": {"reconcile": 61.0, "queue_idle": 0.1}}],
+                "buckets": {"reconcile": 107.0, "queue_idle": 14.1},
+                "propagation": {"completed": 24, "open": 0,
+                                "folded": 26}},
+            "duplicate_create_conflicts": 0},
+        "latency_determinism": {
+            "variant": "determinism", "jobs": 24, "workers": 2,
+            "seed": 7, "converged": True, "virtual_wall_s": 64.2,
+            "completed": 159, "fingerprint_match": True},
+    }
+    md = tmp_path / "BENCH.md"
+    md.write_text("# header\nuntouched prose\n"
+                  + bcp.HANDOFF_BEGIN + "\nhandoff sibling tier\n"
+                  + bcp.HANDOFF_END + "\n")
+    section = bcp.render_latency_md(res, 12, 3, 2)
+    bcp.update_md_section(str(md), bcp.LATENCY_BEGIN,
+                          bcp.LATENCY_END, section)
+    text = md.read_text()
+    assert "untouched prose" in text
+    assert "handoff sibling tier" in text
+    assert text.count(bcp.LATENCY_BEGIN) == 1
+    assert text.count(bcp.HANDOFF_BEGIN) == 1
+    assert "| `watch_to_reconcile_start` | 12 | 0.8 | 12 | 80.0 |" \
+        in text
+    assert "| `reconcile` | 0.35 | 107.0 |" in text
+    assert "fingerprint match = True" in text
+    # re-running replaces, never duplicates — siblings stay intact
+    bcp.update_md_section(str(md), bcp.LATENCY_BEGIN,
+                          bcp.LATENCY_END, section)
+    text = md.read_text()
+    assert text.count(bcp.LATENCY_BEGIN) == 1
+    assert "handoff sibling tier" in text
+    assert "**Reading.**" in text
+
+
+def test_run_tests_sh_advertises_the_latency_knob():
+    """scripts/run-tests.sh must accept --latency-budget and name it
+    in the supported-arguments error line (the CI entry point for the
+    slow propagation tier)."""
+    with open(os.path.join(REPO, "scripts", "run-tests.sh")) as f:
+        sh = f.read()
+    assert "--latency-budget) RUN_LATENCY=1 ;;" in sh
+    assert "--latency-budget" in [
+        line for line in sh.splitlines() if "supported:" in line][0]
+    assert "tests/test_propagation.py" in sh
